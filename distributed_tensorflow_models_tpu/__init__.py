@@ -1,0 +1,26 @@
+"""distributed_tensorflow_models_tpu — a TPU-native distributed training framework.
+
+A from-scratch, TPU-first rebuild of the capabilities of
+``chenc10/distributed_TensorFlow_models`` (a TF 1.x parameter-server model
+zoo).  Instead of a ps/worker cluster coordinated over gRPC, every process
+runs one SPMD program compiled by XLA over a named device mesh:
+
+- cluster topology (``tf.train.ClusterSpec`` / ``tf.train.Server``) ->
+  :mod:`~distributed_tensorflow_models_tpu.core.mesh`
+- variable placement (``tf.train.replica_device_setter``) ->
+  ``jax.sharding.NamedSharding`` rules in
+  :mod:`~distributed_tensorflow_models_tpu.core.sharding`
+- sync gradient aggregation (``tf.train.SyncReplicasOptimizer`` accumulators
+  + token queues) -> a compiled all-reduce inside the jitted train step in
+  :mod:`~distributed_tensorflow_models_tpu.core.train_loop`
+- the slim model builders -> Flax modules in
+  :mod:`~distributed_tensorflow_models_tpu.models`
+- async parameter-server training -> ``parallel.async_ps`` emulation
+- queue-runner input pipelines -> host-side pipelines in ``data``
+- ``tf.train.Saver`` -> orbax wrappers in ``harness.checkpoint``
+
+See /root/repo/SURVEY.md for the full capability map of the reference and the
+provenance rules for every citation in the docstrings of this package.
+"""
+
+__version__ = "0.1.0"

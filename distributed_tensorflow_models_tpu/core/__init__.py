@@ -1,0 +1,10 @@
+"""Core runtime: mesh construction, sharding rules, train state, train loop."""
+
+from distributed_tensorflow_models_tpu.core import mesh
+from distributed_tensorflow_models_tpu.core import sharding
+from distributed_tensorflow_models_tpu.core.mesh import (
+    AxisNames,
+    MeshSpec,
+    create_mesh,
+)
+from distributed_tensorflow_models_tpu.core.train_state import TrainState

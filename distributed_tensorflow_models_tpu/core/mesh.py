@@ -1,0 +1,157 @@
+"""Device-mesh construction and multi-host bootstrap.
+
+This module replaces the reference's cluster-topology layer.  The reference
+wires N ``ps`` + M ``worker`` Python processes into a ``tf.train.ClusterSpec``
+and starts a gRPC ``tf.train.Server`` in each (SURVEY.md §2.2 F1; TF
+training/server_lib.py:96,242), with parameter placement decided per-op by
+``replica_device_setter`` (TF training/device_setter.py:128-223).
+
+The TPU-native design has no ps/worker asymmetry: every process holds the same
+SPMD program over a single :class:`jax.sharding.Mesh`.  Parallelism is
+expressed by *sharding arrays over named mesh axes* and compiled by XLA into
+ICI/DCN collectives — the "cluster" is just the mesh.
+
+Axis-name discipline (SURVEY.md §7.5): models and train loops never hard-code
+axis strings; they import them from :class:`AxisNames` here so that tensor /
+sequence / pipeline / expert parallelism can be layered on without touching
+model code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class AxisNames:
+    """Canonical mesh axis names, in mesh order.
+
+    ``DATA``    — batch/data parallelism (gradient all-reduce rides this axis).
+    ``MODEL``   — tensor parallelism (weight shards).
+    ``SEQ``     — sequence/context parallelism (ring attention, Ulysses).
+    ``PIPE``    — pipeline stages.
+    ``EXPERT``  — MoE expert parallelism.
+    """
+
+    DATA = "data"
+    MODEL = "model"
+    SEQ = "seq"
+    PIPE = "pipe"
+    EXPERT = "expert"
+
+    ALL: tuple[str, ...] = (DATA, MODEL, SEQ, PIPE, EXPERT)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape.  ``-1`` means "absorb all remaining devices".
+
+    The default is pure data parallelism — the only strategy the reference
+    supports (SURVEY.md §2.4) — with every other axis of size 1 so that
+    ``PartitionSpec``\\ s naming those axes remain valid no-ops until the axis
+    is actually widened.
+    """
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+    pipe: int = 1
+    expert: int = 1
+
+    def sizes(self, num_devices: int) -> tuple[int, ...]:
+        dims = [self.data, self.model, self.seq, self.pipe, self.expert]
+        n_infer = sum(1 for d in dims if d == -1)
+        if n_infer > 1:
+            raise ValueError(f"at most one axis may be -1, got {self}")
+        fixed = math.prod(d for d in dims if d != -1)
+        if n_infer == 1:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes "
+                    f"product {fixed} in {self}"
+                )
+            dims = [num_devices // fixed if d == -1 else d for d in dims]
+        elif fixed != num_devices:
+            raise ValueError(
+                f"mesh {self} wants {fixed} devices, have {num_devices}"
+            )
+        return tuple(dims)
+
+
+def create_mesh(
+    spec: MeshSpec | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named device mesh over ``devices`` (default: all devices).
+
+    Single-chip, N-chip, and multi-host slices all go through this one
+    function — the direct replacement for the per-process ClusterSpec/Server
+    bootstrap in each reference driver (SURVEY.md §3.1 lines 1-3).
+    """
+    spec = spec or MeshSpec()
+    if devices is None:
+        devices = jax.devices()
+    sizes = spec.sizes(len(devices))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, AxisNames.ALL)
+
+
+def data_parallel_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """All devices on the ``data`` axis — the reference's only topology."""
+    return create_mesh(MeshSpec(), devices)
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up the multi-host coordination service.
+
+    Control-plane replacement for the reference's gRPC server bootstrap
+    (TF training/server_lib.py:107-146): the coordination service carries
+    *only* bootstrap/health traffic; the data plane (gradient exchange,
+    parameter reads) is compiled XLA collectives over ICI/DCN, not RPC
+    (SURVEY.md §5.8).
+
+    On managed TPU slices all arguments are auto-detected from the
+    environment; pass them explicitly only for manual/localhost clusters
+    (the analogue of the reference's in-process fake clusters, SURVEY.md §4).
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def local_batch_size(global_batch_size: int, mesh: Mesh) -> int:
+    """Per-process slice of the global batch.
+
+    In the reference, each worker chooses its own ``batch_size`` flag and the
+    effective global batch is ``batch_size * num_workers`` (implicit in the
+    SyncReplicasOptimizer aggregation count, TF sync_replicas_optimizer.py:
+    155-162).  Here the *global* batch is primary and each host feeds its
+    shard of it.
+    """
+    n_data = mesh.shape[AxisNames.DATA]
+    n_proc = jax.process_count()
+    if global_batch_size % n_data != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by data-axis "
+            f"size {n_data}"
+        )
+    if global_batch_size % n_proc != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by process "
+            f"count {n_proc}"
+        )
+    return global_batch_size // n_proc

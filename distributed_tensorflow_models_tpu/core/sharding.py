@@ -1,0 +1,111 @@
+"""Sharding rules: the TPU-native replacement for variable placement.
+
+The reference places every variable on a parameter-server task chosen
+round-robin by ``tf.train.replica_device_setter``
+(TF training/device_setter.py:48-60,92-125,128-223 — SURVEY.md §2.2 F2) and
+replicates compute on each worker, so every step pays PS<->worker network
+transfers for parameter reads and gradient pushes (SURVEY.md §3.1).
+
+Here placement is declarative: a pytree of :class:`jax.sharding.NamedSharding`
+per array, consumed by ``jax.jit``.  Data-parallel training keeps parameters
+*replicated* (each chip holds a copy; the gradient all-reduce is the only
+per-step communication, riding ICI) and shards only the batch.  Tensor
+parallelism is expressed by rules mapping parameter path patterns to
+``PartitionSpec`` entries over the ``model`` axis.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_models_tpu.core.mesh import AxisNames
+
+PyTree = Any
+
+# A rule maps a regex over the '/'-joined parameter path to a PartitionSpec.
+ShardingRule = tuple[str, P]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_spec(ndim: int) -> P:
+    """Leading-axis data sharding for an ``ndim``-rank batch array."""
+    return P(AxisNames.DATA, *([None] * (ndim - 1)))
+
+
+def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(ndim))
+
+
+def tree_batch_shardings(mesh: Mesh, tree: PyTree) -> PyTree:
+    """Per-leaf leading-axis data sharding for an input batch pytree."""
+    return jax.tree.map(lambda x: batch_sharding(mesh, x.ndim), tree)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def tree_param_shardings(
+    mesh: Mesh,
+    params: PyTree,
+    rules: Sequence[ShardingRule] = (),
+) -> PyTree:
+    """Shardings for a parameter pytree: first matching rule wins, else
+    replicated.
+
+    This is the declarative analogue of the reference's round-robin device
+    function (TF training/device_setter.py:48-60): instead of scattering
+    whole variables across PS tasks, rules scatter *dimensions* of weight
+    arrays across the ``model`` axis (tensor parallelism), and everything
+    unmatched is replicated (data parallelism).
+    """
+
+    def one(path, leaf):
+        name = _path_str(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return NamedSharding(mesh, spec)
+        return replicated(mesh)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def shard_batch(mesh: Mesh, batch: PyTree) -> PyTree:
+    """Place a host batch onto the mesh, sharded along the data axis.
+
+    Replaces the dequeue-from-batch-queue boundary of the reference input
+    pipeline (TF training/input.py:933,1089 — SURVEY.md §1 L4→L3): the host
+    pipeline hands a numpy pytree to this function, which lays it out across
+    the mesh's data axis.  Works for both single-host (this process holds the
+    full batch) and multi-host (this process holds its slice) by going
+    through ``jax.make_array_from_process_local_data``.
+    """
+    def one(x):
+        sharding = batch_sharding(mesh, x.ndim)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(one, batch)
+
+
+def head_tensor_parallel_rules() -> list[ShardingRule]:
+    """Default tensor-parallel rules: shard classifier-head matmuls over the
+    ``model`` axis (output-dim sharding for kernels, matching bias)."""
+    return [
+        (r"head/kernel$", P(None, AxisNames.MODEL)),
+        (r"head/bias$", P(AxisNames.MODEL)),
+    ]

@@ -1,0 +1,297 @@
+"""The compiled SPMD train step and its builders.
+
+This single module replaces the reference's entire synchronization stack
+(SURVEY.md §3.1–§3.2): per-variable ``ConditionalAccumulator``s on PS tasks,
+the chief's ``take_grad(N)`` aggregation thread, the token ``FIFOQueue``
+barrier, and ``MonitoredTrainingSession``'s chief/worker session dance
+(TF sync_replicas_optimizer.py:215-338; monitored_session.py:428).
+
+The TPU-native form: the batch is one global array sharded over the ``data``
+mesh axis; parameters are replicated (or sharded over ``model`` for tensor
+parallelism); the loss is a global mean.  ``jax.grad`` of that mean makes XLA
+emit a partial gradient per chip plus an all-reduce over ICI — the whole
+accumulator/token protocol becomes one fused collective inside one compiled
+program, and the barrier is implicit in the collective's semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from distributed_tensorflow_models_tpu.core import sharding as shardlib
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.ops import ema as emalib
+from distributed_tensorflow_models_tpu.ops import losses as losslib
+from distributed_tensorflow_models_tpu.ops import metrics as metriclib
+
+PyTree = Any
+Batch = Mapping[str, jax.Array]
+# loss_fn(params, state, batch, rngs) -> (loss, aux) where aux is a dict
+# that may carry: 'metrics' (dict of scalars), 'batch_stats' (updated BN
+# state), 'carry' (updated recurrent state).  Omitted keys mean "unchanged".
+LossFn = Callable[
+    [PyTree, TrainState, Batch, Mapping[str, jax.Array]],
+    tuple[jax.Array, dict],
+]
+
+
+def classification_loss_fn(
+    apply_fn: Callable,
+    *,
+    label_smoothing: float = 0.0,
+    weight_decay: float = 0.0,
+    aux_loss_weight: float = 0.0,
+) -> LossFn:
+    """Forward + loss for image-classification models.
+
+    Covers every CNN config in the reference zoo (SURVEY.md §2.1 R3-R7):
+    plain softmax cross entropy; slim-style L2 weight decay on kernels;
+    label smoothing and the 0.4-weighted auxiliary-logits head for
+    Inception-v3 (R5).  Models return either ``logits`` or
+    ``(logits, aux_logits)``.
+    """
+
+    def loss_fn(params, state, batch, rngs):
+        batch_stats = state.batch_stats
+        variables = {"params": params}
+        has_bn = bool(jax.tree_util.tree_leaves(batch_stats))
+        if has_bn:
+            variables["batch_stats"] = batch_stats
+            outputs, updated = apply_fn(
+                variables,
+                batch["image"],
+                train=True,
+                rngs=dict(rngs),
+                mutable=["batch_stats"],
+            )
+            new_batch_stats = updated["batch_stats"]
+        else:
+            outputs = apply_fn(
+                variables, batch["image"], train=True, rngs=dict(rngs)
+            )
+            new_batch_stats = batch_stats
+        if isinstance(outputs, (tuple, list)):
+            logits, aux_logits = outputs[0], outputs[1]
+        else:
+            logits, aux_logits = outputs, None
+
+        labels = batch["label"]
+        xent = losslib.mean_softmax_cross_entropy(
+            logits, labels, label_smoothing
+        )
+        loss = xent
+        if aux_logits is not None and aux_loss_weight:
+            loss = loss + aux_loss_weight * losslib.mean_softmax_cross_entropy(
+                aux_logits, labels, label_smoothing
+            )
+        if weight_decay:
+            loss = loss + losslib.l2_weight_decay(params, weight_decay)
+        metrics = {
+            "loss": loss,
+            "xent": xent,
+            "accuracy": metriclib.accuracy(logits, labels),
+        }
+        return loss, {"metrics": metrics, "batch_stats": new_batch_stats}
+
+    return loss_fn
+
+
+def lm_loss_fn(apply_fn: Callable) -> LossFn:
+    """Forward + loss for the PTB LSTM (SURVEY.md §2.1 R8).
+
+    Batch keys: ``inputs`` and ``targets``, both ``[B, T]`` int32 (targets
+    are inputs shifted by one token, the reference PTB reader convention).
+    The model consumes and returns the recurrent carry; the carry is read
+    from ``state.carry`` and the updated value is returned through aux, so
+    truncated-BPTT state threads across segments exactly as the reference
+    threads final LSTM state into the next ``session.run`` (SURVEY.md
+    §7.4.5).  Gradients do not flow into previous segments — the carry
+    enters as a leaf input, which *is* truncation.
+
+    Metrics include ``nll`` (mean per-token negative log-likelihood);
+    perplexity = ``exp(nll)`` as the reference reports it.
+    """
+
+    def loss_fn(params, state, batch, rngs):
+        logits, new_carry = apply_fn(
+            {"params": params},
+            batch["inputs"],
+            carry=state.carry,
+            train=True,
+            rngs=dict(rngs),
+        )
+        nll = jnp.mean(
+            losslib.softmax_cross_entropy(logits, batch["targets"])
+        )
+        metrics = {"loss": nll, "nll": nll}
+        return nll, {"metrics": metrics, "carry": new_carry}
+
+    return loss_fn
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    rng_names: Sequence[str] = ("dropout",),
+    donate: bool | None = None,
+) -> Callable[[TrainState, Batch, jax.Array], tuple[TrainState, dict]]:
+    """Build the jitted ``(state, batch, rng) -> (state, metrics)`` step.
+
+    Equivalent of the whole worker-side hot loop in SURVEY.md §3.1 plus the
+    chief's §3.2 aggregation duties, compiled to one XLA program.  The step
+    is deterministic given ``rng`` and ``state.step`` (per-step keys are
+    derived by ``fold_in``), which is what makes the distributed run
+    reproducible — no arrival-order races as in the reference's async mode
+    (SURVEY.md §5.2).
+
+    ``donate`` defaults to True on accelerators (in-place state update —
+    halves HBM pressure for the params/opt_state pytrees) with two
+    environment carve-outs where donation is broken, both observed on this
+    machine:
+
+    - CPU: the XLA CPU thunk runtime can wedge its in-process collective
+      rendezvous when donated buffers and cross-partition all-reduces mix on
+      a small host thread pool (one partition never reaches the rendezvous;
+      the runtime aborts after 40 s).  CPU is only used for fake-mesh
+      testing, where donation buys nothing anyway.
+    - The axon TPU relay (``PALLAS_AXON_POOL_IPS`` set): executions with
+      input-output buffer aliasing fail with ``INVALID_ARGUMENT``.
+    """
+    if donate is None:
+        import os
+
+        donate = jax.default_backend() != "cpu" and not os.environ.get(
+            "PALLAS_AXON_POOL_IPS"
+        )
+
+    def step_fn(state: TrainState, batch: Batch, rng: jax.Array):
+        step_rng = jax.random.fold_in(rng, state.step)
+        rngs = {
+            name: jax.random.fold_in(step_rng, i)
+            for i, name in enumerate(rng_names)
+        }
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (_, aux), grads = grad_fn(state.params, state, batch, rngs)
+        metrics = aux.get("metrics", {})
+        new_batch_stats = aux.get("batch_stats", state.batch_stats)
+        new_carry = aux.get("carry", state.carry)
+        updates, new_opt_state = state.tx.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new_ema = state.ema_params
+        if state.ema_params is not None:
+            new_ema = emalib.update_ema(
+                state.ema_params,
+                new_params,
+                state.ema_decay,
+                num_updates=state.step,
+            )
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_batch_stats,
+            opt_state=new_opt_state,
+            ema_params=new_ema,
+            carry=new_carry,
+        )
+        return new_state, metrics
+
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    apply_fn: Callable, use_ema: bool = True
+) -> Callable[[TrainState, Batch], dict]:
+    """Jitted eval step returning top-1/top-5 *counts* (summed over the
+    global batch, so the host just accumulates integers across batches —
+    the reference eval loop's counting scheme, SURVEY.md §3.5)."""
+
+    def eval_fn(state: TrainState, batch: Batch):
+        params = state.eval_params if use_ema else state.params
+        variables = {"params": params}
+        if jax.tree_util.tree_leaves(state.batch_stats):
+            variables["batch_stats"] = state.batch_stats
+        outputs = apply_fn(variables, batch["image"], train=False)
+        logits = (
+            outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+        )
+        labels = batch["label"]
+        return {
+            "top1_count": jnp.sum(metriclib.top_k_correct(logits, labels, 1)),
+            "top5_count": jnp.sum(metriclib.top_k_correct(logits, labels, 5)),
+            "count": jnp.asarray(labels.shape[0], jnp.float32),
+            "xent_sum": jnp.sum(
+                losslib.softmax_cross_entropy(logits, labels)
+            ),
+        }
+
+    return jax.jit(eval_fn)
+
+
+def place_state(
+    state: TrainState,
+    mesh: Mesh,
+    param_rules: Sequence[shardlib.ShardingRule] = (),
+) -> TrainState:
+    """Lay the train state out on the mesh.
+
+    With no rules everything is replicated — classic data parallelism, the
+    reference's sync mode minus the parameter servers.  ``param_rules``
+    shard selected weight dimensions over the ``model`` axis (tensor
+    parallelism); optimizer slots and EMA shadows follow their parameters'
+    sharding automatically, the analogue of TF slot variables inheriting
+    their primary's PS placement (TF optimizer.py:463,
+    device_setter.py:92-125).
+    """
+    param_sh = shardlib.tree_param_shardings(mesh, state.params, param_rules)
+
+    def follow(template_sh, tree):
+        """Shard `tree` leaves like the params leaf they parallel, replicating
+        anything that has no parameter analogue (counts, scalars)."""
+        flat_params = {
+            shardlib._path_str(p): s
+            for p, s in jax.tree_util.tree_leaves_with_path(template_sh)
+        }
+
+        def one(path, leaf):
+            name = shardlib._path_str(path)
+            for pname, s in flat_params.items():
+                if name.endswith(pname) and leaf.ndim == len(s.spec):
+                    return jax.device_put(leaf, s)
+            return jax.device_put(leaf, shardlib.replicated(mesh))
+
+        return jax.tree_util.tree_map_with_path(one, tree)
+
+    return state.replace(
+        step=jax.device_put(state.step, shardlib.replicated(mesh)),
+        params=jax.tree.map(jax.device_put, state.params, param_sh),
+        batch_stats=jax.tree.map(
+            lambda x: jax.device_put(x, shardlib.replicated(mesh)),
+            state.batch_stats,
+        ),
+        opt_state=follow(param_sh, state.opt_state),
+        ema_params=(
+            None
+            if state.ema_params is None
+            else jax.tree.map(jax.device_put, state.ema_params, param_sh)
+        ),
+        # Recurrent carry is batch-major activation state: shard over data.
+        carry=(
+            None
+            if state.carry is None
+            else jax.tree.map(
+                lambda x: jax.device_put(
+                    x, shardlib.batch_sharding(mesh, x.ndim)
+                ),
+                state.carry,
+            )
+        ),
+    )

@@ -1,0 +1,57 @@
+"""Model zoo: Flax re-expressions of the reference's model set.
+
+Reference inventory (SURVEY.md §2.1): MNIST LeNet (R3), CIFAR-10 ResNet-32
+(R4), slim Inception-v3 (R5), slim ResNet-50-v1 (R6), slim VGG-16 / AlexNet
+(R7), PTB LSTM (R8).  Models here are pure graph builders exactly as in the
+reference (SURVEY.md §1 "L5 → L4": distribution is injected from outside) —
+they never mention mesh axes; sharding is applied by the caller.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_model(name: str, **kwargs):
+    """Instantiate a registered model builder by config name."""
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; have {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name](**kwargs)
+
+
+def available_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# Import for registration side effects.
+from distributed_tensorflow_models_tpu.models import lenet  # noqa: E402
+from distributed_tensorflow_models_tpu.models import resnet_cifar  # noqa: E402
+from distributed_tensorflow_models_tpu.models import resnet  # noqa: E402
+from distributed_tensorflow_models_tpu.models import inception_v3  # noqa: E402
+from distributed_tensorflow_models_tpu.models import vgg  # noqa: E402
+from distributed_tensorflow_models_tpu.models import alexnet  # noqa: E402
+from distributed_tensorflow_models_tpu.models import ptb_lstm  # noqa: E402
+
+from distributed_tensorflow_models_tpu.models.lenet import LeNet  # noqa: E402
+from distributed_tensorflow_models_tpu.models.resnet_cifar import (  # noqa: E402
+    CifarResNet,
+)
+from distributed_tensorflow_models_tpu.models.resnet import ResNet  # noqa: E402
+from distributed_tensorflow_models_tpu.models.inception_v3 import (  # noqa: E402
+    InceptionV3,
+)
+from distributed_tensorflow_models_tpu.models.vgg import VGG16  # noqa: E402
+from distributed_tensorflow_models_tpu.models.alexnet import AlexNet  # noqa: E402
+from distributed_tensorflow_models_tpu.models.ptb_lstm import PTBLSTM  # noqa: E402

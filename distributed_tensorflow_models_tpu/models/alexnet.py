@@ -1,0 +1,50 @@
+"""AlexNet (slim ``alexnet_v2`` layout) — throughput-benchmark model.
+
+Reference component R7 (SURVEY.md §2.1).  slim's v2 variant: 11x11/4 conv
+(64, VALID) → pool → 5x5 conv (192) → pool → 3x3 convs (384/384/256) → pool
+→ fc4096 x2 with dropout → classifier.  No LRN (dropped in v2).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_models_tpu.models import register
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dropout_rate: float = 0.5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            64, (11, 11), strides=(4, 4), padding="VALID", dtype=self.dtype,
+            name="conv1",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(192, (5, 5), padding="SAME", dtype=self.dtype,
+                    name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        for i, width in enumerate([384, 384, 256]):
+            x = nn.Conv(width, (3, 3), padding="SAME", dtype=self.dtype,
+                        name=f"conv{i + 3}")(x)
+            x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for i in range(2):
+            x = nn.Dense(4096, dtype=self.dtype, name=f"fc{i + 6}")(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+@register("alexnet")
+def build_alexnet(**kwargs) -> AlexNet:
+    return AlexNet(**kwargs)

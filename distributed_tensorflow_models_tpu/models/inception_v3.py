@@ -1,0 +1,247 @@
+"""Inception-v3 with auxiliary logits — the reference's slim flagship.
+
+Reference component R5 (SURVEY.md §2.1): the vendored slim ``inception_v3``
+builder trained with RMSProp, label smoothing 0.1, a 0.4-weighted auxiliary
+classifier off the 17x17 grid, BN everywhere, and an EMA of the weights
+restored at eval (SURVEY.md §3.5).  The loss-side pieces (smoothing, aux
+weight, EMA) live in :mod:`core.train_loop` / :mod:`ops.ema`; this module is
+the pure architecture.
+
+Layer schedule (Szegedy et al. 2015, "Rethinking the Inception
+Architecture"): stem → 3x Inception-A (35x35) → Reduction-A → 4x Inception-B
+(17x17) → [aux head] → Reduction-B → 2x Inception-C (8x8) → pool/dropout/fc.
+All convs are conv+BN+ReLU with no bias, as in slim's ``inception_v3``
+arg_scope.
+
+TPU notes: branches of an Inception block are independent convs that XLA
+schedules back-to-back on the MXU; bfloat16 compute keeps them on the fast
+path, float32 BN statistics preserve accuracy.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_models_tpu.models import register
+
+
+class ConvBN(nn.Module):
+    """slim ``conv2d`` under the inception arg_scope: conv (no bias) + BN +
+    ReLU."""
+
+    filters: int
+    kernel: tuple[int, int]
+    strides: tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(
+            self.filters,
+            self.kernel,
+            strides=self.strides,
+            padding=self.padding,
+            use_bias=False,
+            dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9997,  # slim inception BN decay
+            epsilon=1e-3,
+            dtype=jnp.float32,
+        )(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    """35x35 block (Mixed_5b/5c/5d): 1x1 / 5x5 / double-3x3 / pool-proj."""
+
+    pool_filters: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = partial(ConvBN, dtype=self.dtype)
+        b0 = c(64, (1, 1))(x, train=train)
+        b1 = c(48, (1, 1))(x, train=train)
+        b1 = c(64, (5, 5))(b1, train=train)
+        b2 = c(64, (1, 1))(x, train=train)
+        b2 = c(96, (3, 3))(b2, train=train)
+        b2 = c(96, (3, 3))(b2, train=train)
+        b3 = c(self.pool_filters, (1, 1))(_avg_pool_same(x), train=train)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class ReductionA(nn.Module):
+    """Mixed_6a: stride-2 3x3 / stride-2 double-3x3 / max pool."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = partial(ConvBN, dtype=self.dtype)
+        b0 = c(384, (3, 3), strides=(2, 2), padding="VALID")(x, train=train)
+        b1 = c(64, (1, 1))(x, train=train)
+        b1 = c(96, (3, 3))(b1, train=train)
+        b1 = c(96, (3, 3), strides=(2, 2), padding="VALID")(b1, train=train)
+        b2 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b0, b1, b2.astype(b0.dtype)], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """17x17 block (Mixed_6b..6e): factorized 7x7 branches; ``width`` is the
+    inner channel count (128 / 160 / 160 / 192 across the four blocks)."""
+
+    width: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = partial(ConvBN, dtype=self.dtype)
+        w = self.width
+        b0 = c(192, (1, 1))(x, train=train)
+        b1 = c(w, (1, 1))(x, train=train)
+        b1 = c(w, (1, 7))(b1, train=train)
+        b1 = c(192, (7, 1))(b1, train=train)
+        b2 = c(w, (1, 1))(x, train=train)
+        b2 = c(w, (7, 1))(b2, train=train)
+        b2 = c(w, (1, 7))(b2, train=train)
+        b2 = c(w, (7, 1))(b2, train=train)
+        b2 = c(192, (1, 7))(b2, train=train)
+        b3 = c(192, (1, 1))(_avg_pool_same(x), train=train)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class ReductionB(nn.Module):
+    """Mixed_7a."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = partial(ConvBN, dtype=self.dtype)
+        b0 = c(192, (1, 1))(x, train=train)
+        b0 = c(320, (3, 3), strides=(2, 2), padding="VALID")(b0, train=train)
+        b1 = c(192, (1, 1))(x, train=train)
+        b1 = c(192, (1, 7))(b1, train=train)
+        b1 = c(192, (7, 1))(b1, train=train)
+        b1 = c(192, (3, 3), strides=(2, 2), padding="VALID")(b1, train=train)
+        b2 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b0, b1, b2.astype(b0.dtype)], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """8x8 block (Mixed_7b/7c): expanded-filter-bank branches."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = partial(ConvBN, dtype=self.dtype)
+        b0 = c(320, (1, 1))(x, train=train)
+        b1 = c(384, (1, 1))(x, train=train)
+        b1 = jnp.concatenate(
+            [
+                c(384, (1, 3))(b1, train=train),
+                c(384, (3, 1))(b1, train=train),
+            ],
+            axis=-1,
+        )
+        b2 = c(448, (1, 1))(x, train=train)
+        b2 = c(384, (3, 3))(b2, train=train)
+        b2 = jnp.concatenate(
+            [
+                c(384, (1, 3))(b2, train=train),
+                c(384, (3, 1))(b2, train=train),
+            ],
+            axis=-1,
+        )
+        b3 = c(192, (1, 1))(_avg_pool_same(x), train=train)
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class AuxHead(nn.Module):
+    """Auxiliary classifier off Mixed_6e (slim ``AuxLogits``): 5x5/3 avg pool
+    → 1x1(128) → 5x5(768, VALID) → fc.  The reference weights its loss 0.4
+    (SURVEY.md §2.1 R5; wired in ``classification_loss_fn``)."""
+
+    num_classes: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+        x = ConvBN(128, (1, 1), dtype=self.dtype)(x, train=train)
+        x = ConvBN(768, (5, 5), padding="VALID", dtype=self.dtype)(
+            x, train=train
+        )
+        x = jnp.mean(x, axis=(1, 2))
+        x = x.astype(jnp.float32)
+        return nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.truncated_normal(0.001),
+            dtype=jnp.float32,
+            name="aux_logits",
+        )(x)
+
+
+class InceptionV3(nn.Module):
+    """Input ``[B, 299, 299, 3]``.  Returns ``logits`` (eval) or
+    ``(logits, aux_logits)`` (train, if ``aux_head``)."""
+
+    num_classes: int = 1000
+    dropout_rate: float = 0.2
+    aux_head: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # Stem: 299x299x3 → 35x35x192.
+        x = c(32, (3, 3), strides=(2, 2), padding="VALID")(x, train=train)
+        x = c(32, (3, 3), padding="VALID")(x, train=train)
+        x = c(64, (3, 3))(x, train=train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = c(80, (1, 1), padding="VALID")(x, train=train)
+        x = c(192, (3, 3), padding="VALID")(x, train=train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 35x35.
+        x = InceptionA(32, self.dtype, name="Mixed_5b")(x, train=train)
+        x = InceptionA(64, self.dtype, name="Mixed_5c")(x, train=train)
+        x = InceptionA(64, self.dtype, name="Mixed_5d")(x, train=train)
+        x = ReductionA(self.dtype, name="Mixed_6a")(x, train=train)
+        # 17x17.
+        x = InceptionB(128, self.dtype, name="Mixed_6b")(x, train=train)
+        x = InceptionB(160, self.dtype, name="Mixed_6c")(x, train=train)
+        x = InceptionB(160, self.dtype, name="Mixed_6d")(x, train=train)
+        x = InceptionB(192, self.dtype, name="Mixed_6e")(x, train=train)
+        aux = None
+        if self.aux_head and train:
+            aux = AuxHead(self.num_classes, self.dtype, name="AuxHead")(
+                x, train=train
+            )
+        x = ReductionB(self.dtype, name="Mixed_7a")(x, train=train)
+        # 8x8.
+        x = InceptionC(self.dtype, name="Mixed_7b")(x, train=train)
+        x = InceptionC(self.dtype, name="Mixed_7c")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = x.astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        if aux is not None:
+            return logits, aux
+        return logits
+
+
+@register("inception_v3")
+def build_inception_v3(**kwargs) -> InceptionV3:
+    return InceptionV3(**kwargs)

@@ -1,0 +1,44 @@
+"""MNIST LeNet — the reference's single-worker smoke config.
+
+Reference component R3 (SURVEY.md §2.1): the TF MNIST tutorial ``deepnn``
+architecture — conv5x5(32)-pool / conv5x5(64)-pool / fc1024-dropout / fc10
+with softmax cross entropy.  Serves the same role here: the minimum
+end-to-end slice (SURVEY.md §7.3) exercising every framework layer on tiny
+inputs.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_models_tpu.models import register
+
+
+class LeNet(nn.Module):
+    """Input: ``[B, 28, 28, 1]`` float images in [0, 1]."""
+
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(1024, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+@register("lenet")
+def build_lenet(**kwargs) -> LeNet:
+    return LeNet(**kwargs)

@@ -1,0 +1,117 @@
+"""PTB LSTM language model — truncated-BPTT on TPU via ``lax.scan``.
+
+Reference component R8 (SURVEY.md §2.1): the TF PTB tutorial — a 2-layer
+LSTM LM (Zaremba et al. 2014) with truncated BPTT over ``num_steps`` tokens,
+dropout between layers, gradients clipped by global norm, SGD with staged LR
+decay, and small/medium/large configs.  Critically, the reference threads
+the final LSTM state of each segment into the next (SURVEY.md §7.4.5) — here
+the carry is an explicit input/output of ``__call__`` so the train loop can
+keep it in the (sharded) train state.
+
+TPU-first: the time unroll is ``nn.scan`` (compiled ``lax.scan``), not a
+Python loop — one compiled step regardless of ``num_steps``; each scan step
+is a batched matmul hitting the MXU.  The carry is batch-sharded along the
+``data`` mesh axis like any activation, which is exactly the "sharded scan
+state" design SURVEY.md §2.4 calls for.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_models_tpu.models import register
+
+# Per-layer carry: (c, h) tuples, batch-major.
+Carry = Sequence[tuple[jax.Array, jax.Array]]
+
+
+class _StackedCell(nn.Module):
+    """One time step through the layer stack, scanned over time."""
+
+    hidden_size: int
+    num_layers: int
+    dropout_rate: float
+    train: bool
+
+    @nn.compact
+    def __call__(self, carry, x):
+        new_carry = []
+        h = x
+        for i in range(self.num_layers):
+            cell = nn.OptimizedLSTMCell(self.hidden_size, name=f"lstm_{i}")
+            c_i, h = cell(tuple(carry[i]), h)
+            new_carry.append(c_i)
+            if self.dropout_rate:
+                h = nn.Dropout(
+                    self.dropout_rate, deterministic=not self.train
+                )(h)
+        return tuple(new_carry), h
+
+
+class PTBLSTM(nn.Module):
+    """Input ``tokens [B, T]`` int32 + carry; returns ``(logits [B, T, V],
+    new_carry)``."""
+
+    vocab_size: int = 10000
+    hidden_size: int = 650  # "medium" config
+    num_layers: int = 2
+    dropout_rate: float = 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    def initial_carry(self, batch_size: int) -> Carry:
+        zeros = lambda: jnp.zeros(
+            (batch_size, self.hidden_size), self.dtype
+        )
+        return tuple(
+            (zeros(), zeros()) for _ in range(self.num_layers)
+        )
+
+    @nn.compact
+    def __call__(self, tokens, carry: Carry | None = None,
+                 train: bool = False):
+        if carry is None:
+            carry = self.initial_carry(tokens.shape[0])
+        x = nn.Embed(
+            self.vocab_size, self.hidden_size, dtype=self.dtype,
+            name="embedding",
+        )(tokens)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+
+        scan = nn.scan(
+            _StackedCell,
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+            in_axes=1,
+            out_axes=1,
+        )
+        carry, outputs = scan(
+            self.hidden_size,
+            self.num_layers,
+            self.dropout_rate,
+            train,
+            name="stack",
+        )(tuple(tuple(c) for c in carry), x)
+        logits = nn.Dense(
+            self.vocab_size, dtype=jnp.float32, name="head"
+        )(outputs)
+        return logits, carry
+
+
+# The three classic Zaremba configs the reference exposes (SURVEY.md §2.1 R8).
+PTB_CONFIGS = {
+    "small": dict(hidden_size=200, dropout_rate=0.0),
+    "medium": dict(hidden_size=650, dropout_rate=0.5),
+    "large": dict(hidden_size=1500, dropout_rate=0.65),
+}
+
+
+@register("ptb_lstm")
+def build_ptb_lstm(config: str = "medium", **kwargs) -> PTBLSTM:
+    base = dict(PTB_CONFIGS[config])
+    base.update(kwargs)
+    return PTBLSTM(**base)

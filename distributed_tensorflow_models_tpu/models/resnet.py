@@ -1,0 +1,122 @@
+"""ImageNet ResNet-v1 (50/101/152) — the reference's async-vs-sync flagship.
+
+Reference component R6 (SURVEY.md §2.1): slim ``resnet_v1_50``, the model of
+the async-PS vs sync-allreduce comparison config [B:10] and of this repo's
+headline benchmark (BASELINE.md: ≥5k images/sec/chip, 75.9% top-1).
+
+Architecture: 7x7/2 stem conv (64) + 3x3/2 max pool, four stages of
+bottleneck units ([3,4,6,3] for ResNet-50) at output widths
+256/512/1024/2048, global average pool, linear classifier.  Downsampling
+strides sit on the first unit of each stage (torchvision/Keras convention;
+slim places them on the last unit — a documented, accuracy-neutral
+divergence).
+
+TPU-first choices: bfloat16 compute dtype by default for MXU throughput with
+float32 BN statistics and head; NHWC layout throughout (XLA's preferred TPU
+conv layout); no Python control flow dependent on data, so the whole forward
+lowers to one fused XLA computation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_models_tpu.models import register
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce → 3x3 → 1x1 expand (x4), projection shortcut on shape
+    change — slim's ``bottleneck`` unit (ResNet v1: BN after each conv,
+    final ReLU after the residual add)."""
+
+    filters: int  # bottleneck width; output is 4x this
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        out_filters = 4 * self.filters
+
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding="SAME",
+        )(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(out_filters, (1, 1))(y)
+        # Zero-init the last BN scale so each block starts as identity —
+        # standard large-batch ResNet recipe (Goyal et al.), key to matching
+        # reference accuracy at the global batch sizes sync-DP produces.
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape[-1] != out_filters or self.strides != 1:
+            residual = conv(
+                out_filters, (1, 1), strides=(self.strides, self.strides),
+                name="proj",
+            )(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    """slim-style ResNet-v1 for 224x224 ImageNet inputs."""
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=self.dtype, name="conv_init",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32, name="bn_init",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    self.width * (2**stage), strides, self.dtype,
+                    name=f"stage{stage}_block{block}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = x.astype(jnp.float32)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+@register("resnet50")
+def build_resnet50(**kwargs) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kwargs)
+
+
+@register("resnet101")
+def build_resnet101(**kwargs) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), **kwargs)
+
+
+@register("resnet152")
+def build_resnet152(**kwargs) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), **kwargs)

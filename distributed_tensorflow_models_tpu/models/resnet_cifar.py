@@ -1,0 +1,99 @@
+"""CIFAR-10 ResNet-32 — the reference's sync-replica benchmark model.
+
+Reference component R4 (SURVEY.md §2.1): the TF CIFAR-10 ResNet tutorial
+architecture — a v1 residual net with an initial 3x3 conv and three stages of
+``n`` basic blocks at widths 16/32/64 (``depth = 6n + 2``; n=5 → ResNet-32),
+global average pooling and a linear head, trained with momentum SGD under
+``SyncReplicasOptimizer`` (SURVEY.md §2.4 "Data parallel, sync").
+
+TPU notes: BatchNorm statistics are computed over the *global* sharded batch
+(sync BN) — a deliberate, documented divergence from the reference's
+per-replica BN (SURVEY.md §7.4.2).  Compute dtype is configurable; bfloat16
+feeds the MXU at full rate while BN statistics and the head stay float32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_models_tpu.models import register
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity/projection shortcut (ResNet v1)."""
+
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+        )
+        conv = partial(
+            nn.Conv, kernel_size=(3, 3), padding="SAME", use_bias=False,
+            dtype=self.dtype,
+        )
+        residual = x
+        y = conv(self.filters, strides=(self.strides, self.strides))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters)(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters,
+                (1, 1),
+                strides=(self.strides, self.strides),
+                use_bias=False,
+                dtype=self.dtype,
+                name="proj",
+            )(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class CifarResNet(nn.Module):
+    """``depth = 6n + 2`` ResNet for 32x32 inputs; default n=5 → ResNet-32."""
+
+    blocks_per_stage: int = 5
+    widths: Sequence[int] = (16, 32, 64)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.widths[0], (3, 3), padding="SAME", use_bias=False,
+            dtype=self.dtype, name="conv_init",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32, name="bn_init",
+        )(x)
+        x = nn.relu(x)
+        for stage, width in enumerate(self.widths):
+            for block in range(self.blocks_per_stage):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(
+                    width, strides, self.dtype,
+                    name=f"stage{stage}_block{block}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+@register("resnet32_cifar")
+def build_resnet32(**kwargs) -> CifarResNet:
+    return CifarResNet(**kwargs)

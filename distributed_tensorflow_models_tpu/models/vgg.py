@@ -1,0 +1,47 @@
+"""VGG-16 — throughput-benchmark model.
+
+Reference component R7 (SURVEY.md §2.1): slim ``vgg_16``, used by the
+reference purely for distributed-throughput benchmarking (large dense
+gradients stress the PS network there; here they stress the all-reduce).
+Five conv stages (2-2-3-3-3 convs of 64/128/256/512/512) each followed by
+2x2 max pool, then fc4096-fc4096-fc_classes with dropout.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_models_tpu.models import register
+
+
+class VGG16(nn.Module):
+    num_classes: int = 1000
+    dropout_rate: float = 0.5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for stage, (n_convs, width) in enumerate(
+            [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+        ):
+            for i in range(n_convs):
+                x = nn.Conv(
+                    width, (3, 3), padding="SAME", dtype=self.dtype,
+                    name=f"conv{stage + 1}_{i + 1}",
+                )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for i in range(2):
+            x = nn.Dense(4096, dtype=self.dtype, name=f"fc{i + 6}")(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = x.astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+@register("vgg16")
+def build_vgg16(**kwargs) -> VGG16:
+    return VGG16(**kwargs)

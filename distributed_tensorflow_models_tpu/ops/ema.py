@@ -1,0 +1,46 @@
+"""Exponential moving average of parameters with TF semantics.
+
+The reference's Inception training maintains an EMA of all trainable
+variables and its eval driver restores the EMA *shadow* values in place of
+the raw weights (TF moving_averages.py:284,493,638 — SURVEY.md §2.2 F14,
+§3.5).  Here the shadow pytree lives inside the train state and is updated
+functionally each step; "restoring shadows" at eval is just selecting
+``state.ema_params`` instead of ``state.params``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def effective_decay(decay: float, num_updates: jax.Array | None) -> jax.Array:
+    """TF's warmup-damped decay (TF moving_averages.py:284): when
+    ``num_updates`` is supplied, the effective decay is
+    ``min(decay, (1 + num_updates) / (10 + num_updates))`` so early steps
+    average faster."""
+    decay = jnp.asarray(decay, jnp.float32)
+    if num_updates is None:
+        return decay
+    n = num_updates.astype(jnp.float32)
+    return jnp.minimum(decay, (1.0 + n) / (10.0 + n))
+
+
+def update_ema(
+    ema_params: PyTree,
+    params: PyTree,
+    decay: float,
+    num_updates: jax.Array | None = None,
+) -> PyTree:
+    """``shadow <- shadow - (1 - decay) * (shadow - value)``
+    (TF moving_averages.py:493 ``apply``)."""
+    d = effective_decay(decay, num_updates)
+    return jax.tree.map(
+        lambda s, v: s - (1.0 - d) * (s - v.astype(s.dtype)),
+        ema_params,
+        params,
+    )

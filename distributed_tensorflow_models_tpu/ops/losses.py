@@ -1,0 +1,81 @@
+"""Loss functions matching the reference's training objectives.
+
+Cross entropy with optional label smoothing reproduces the slim
+Inception-v3 objective (SURVEY.md §2.1 R5: "aux logits head; label
+smoothing"); L2 weight decay reproduces the slim ``weight_decay``
+regularizer added to every conv/fc kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Per-example softmax cross entropy from integer labels.
+
+    With ``label_smoothing`` = eps, targets become
+    ``onehot * (1 - eps) + eps / num_classes`` — the slim
+    ``losses.softmax_cross_entropy(label_smoothing=...)`` convention used by
+    the reference's Inception-v3 training (SURVEY.md §2.1 R5).
+    """
+    num_classes = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=logits.dtype)
+    if label_smoothing:
+        onehot = (
+            onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
+        )
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(onehot * log_probs, axis=-1)
+
+
+def mean_softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Batch-mean cross entropy.
+
+    Inside a jitted step whose batch is sharded over the ``data`` mesh axis,
+    this mean is a *global* mean: XLA lowers it to a partial sum plus an
+    all-reduce over ICI, which is the entire TPU-native replacement for the
+    reference's ConditionalAccumulator / take_grad(N) averaging protocol
+    (TF sync_replicas_optimizer.py:275-293 — SURVEY.md §3.2).
+    """
+    return jnp.mean(softmax_cross_entropy(logits, labels, label_smoothing))
+
+
+def l2_weight_decay(
+    params: PyTree,
+    scale: float,
+    predicate: Callable[[str], bool] | None = None,
+) -> jax.Array:
+    """``scale * sum(0.5 * ||w||^2)`` over kernel parameters.
+
+    ``predicate`` receives the '/'-joined parameter path; the default decays
+    only arrays whose path ends in ``kernel`` (slim decays conv/fc weights
+    but not biases or BN scales).
+    """
+    if predicate is None:
+        predicate = lambda name: name.endswith("kernel")
+
+    def path_str(path) -> str:
+        return "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    total = 0.0
+    for path, leaf in leaves:
+        if predicate(path_str(path)):
+            total = total + 0.5 * jnp.sum(jnp.square(leaf))
+    return scale * total

@@ -1,0 +1,35 @@
+"""Evaluation metrics: top-k accuracy and perplexity.
+
+The reference eval drivers count top-1/top-5 over the validation set
+(SURVEY.md §3.5) and the PTB driver reports perplexity = exp(mean NLL)
+(SURVEY.md §2.1 R8).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_correct(logits: jax.Array, labels: jax.Array, k: int) -> jax.Array:
+    """Per-example 0/1 indicator that the true label is in the top-k."""
+    topk = jax.lax.top_k(logits, k)[1]
+    return jnp.any(topk == labels[..., None], axis=-1).astype(jnp.float32)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean(
+        (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    )
+
+
+def topk_accuracies(
+    logits: jax.Array, labels: jax.Array, ks: tuple[int, ...] = (1, 5)
+) -> dict[str, jax.Array]:
+    return {
+        f"top{k}": jnp.mean(top_k_correct(logits, labels, k)) for k in ks
+    }
+
+
+def perplexity(mean_nll: jax.Array) -> jax.Array:
+    return jnp.exp(mean_nll)

@@ -1,0 +1,34 @@
+"""Test bootstrap: fake 8-device CPU mesh.
+
+SURVEY.md §4.3: `--xla_force_host_platform_device_count=8` gives 8 fake CPU
+devices so the real Mesh/collective code paths run in CI with no TPU — the
+direct analogue of the reference's in-process fake clusters
+(TF server_lib.py:216-239 `create_local_server`).
+
+Must run before the first `import jax` anywhere in the test process.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# This image's sitecustomize registers the axon TPU PJRT plugin and forces
+# jax_platforms='axon,cpu'; override after import (env vars alone are
+# clobbered by the plugin bootstrap).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from distributed_tensorflow_models_tpu.core import mesh as meshlib
+
+    assert len(jax.devices()) == 8, jax.devices()
+    return meshlib.data_parallel_mesh()

@@ -1,0 +1,43 @@
+"""Mesh construction and spec inference (SURVEY.md §7.2.1)."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu.core import mesh as meshlib
+
+
+def test_default_mesh_all_data(mesh8):
+    assert mesh8.shape[meshlib.AxisNames.DATA] == 8
+    for ax in meshlib.AxisNames.ALL[1:]:
+        assert mesh8.shape[ax] == 1
+    assert mesh8.size == 8
+
+
+def test_meshspec_inference():
+    assert meshlib.MeshSpec().sizes(8) == (8, 1, 1, 1, 1)
+    assert meshlib.MeshSpec(model=2).sizes(8) == (4, 2, 1, 1, 1)
+    assert meshlib.MeshSpec(data=2, model=2, seq=2).sizes(8) == (
+        2, 2, 2, 1, 1,
+    )
+
+
+def test_meshspec_errors():
+    with pytest.raises(ValueError, match="not divisible"):
+        meshlib.MeshSpec(model=3).sizes(8)
+    with pytest.raises(ValueError, match="wants"):
+        meshlib.MeshSpec(data=4, model=1).sizes(8)
+    with pytest.raises(ValueError, match="at most one"):
+        meshlib.MeshSpec(data=-1, model=-1).sizes(8)
+
+
+def test_explicit_mesh_shape():
+    mesh = meshlib.create_mesh(meshlib.MeshSpec(data=4, model=2))
+    assert mesh.shape[meshlib.AxisNames.DATA] == 4
+    assert mesh.shape[meshlib.AxisNames.MODEL] == 2
+
+
+def test_local_batch_size(mesh8):
+    # Single process: local == global.
+    assert meshlib.local_batch_size(64, mesh8) == 64
+    with pytest.raises(ValueError, match="not divisible"):
+        meshlib.local_batch_size(12, mesh8)

@@ -1,0 +1,157 @@
+"""Model-zoo golden-shape and parameter-count tests (SURVEY.md §4.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu.models import (
+    available_models,
+    get_model,
+)
+
+
+def n_params(tree):
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def init_shapes(model, sample, **kwargs):
+    """eval_shape init: no FLOPs, runs the biggest models on CPU instantly."""
+    return jax.eval_shape(
+        lambda rng: model.init(rng, sample, **kwargs), jax.random.key(0)
+    )
+
+
+def test_registry_complete():
+    # The reference zoo, SURVEY.md §2.1 R3-R8.
+    for name in [
+        "lenet",
+        "resnet32_cifar",
+        "resnet50",
+        "inception_v3",
+        "vgg16",
+        "alexnet",
+        "ptb_lstm",
+    ]:
+        assert name in available_models(), name
+
+
+def test_lenet_forward():
+    model = get_model("lenet")
+    variables = model.init(jax.random.key(0), jnp.zeros((2, 28, 28, 1)))
+    out = model.apply(variables, jnp.zeros((2, 28, 28, 1)))
+    assert out.shape == (2, 10)
+    # conv(5*5*1*32+32) + conv(5*5*32*64+64) + fc(3136*1024+1024) + fc(1024*10+10)
+    assert n_params(variables["params"]) == 3_274_634
+
+
+def test_resnet32_cifar():
+    model = get_model("resnet32_cifar")
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    out = model.apply(variables, jnp.zeros((2, 32, 32, 3)))
+    assert out.shape == (2, 10)
+    # ResNet-32 is ~0.46M params (He et al.); projection shortcuts add a bit.
+    count = n_params(variables["params"])
+    assert 4.4e5 < count < 5.5e5, count
+    # 3 stages x 5 blocks x 2 convs + init conv + head = 32 conv/fc layers
+    bn_state = variables["batch_stats"]
+    assert len(jax.tree.leaves(bn_state)) > 0
+
+
+def test_resnet50_shapes():
+    model = get_model("resnet50", dtype=jnp.float32)
+    shapes = init_shapes(model, jnp.zeros((1, 224, 224, 3)))
+    count = n_params(shapes["params"])
+    # torchvision resnet50: 25,557,032.
+    assert 25.0e6 < count < 26.0e6, count
+
+
+def test_resnet50_tiny_forward():
+    # Real forward at 32x32 to exercise the graph cheaply.
+    model = get_model("resnet50", num_classes=7, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    out = model.apply(variables, jnp.zeros((2, 32, 32, 3)))
+    assert out.shape == (2, 7)
+
+
+def test_inception_v3_shapes():
+    model = get_model("inception_v3", dtype=jnp.float32)
+    shapes = jax.eval_shape(
+        lambda rng: model.init(rng, jnp.zeros((1, 299, 299, 3)), train=False),
+        jax.random.key(0),
+    )
+    count = n_params(shapes["params"])
+    # torchvision inception_v3 (no aux): 23.8M; with aux: 27.2M.  Aux params
+    # are created lazily at train time here, so eval init sees the 23.8M side.
+    assert 21e6 < count < 28e6, count
+
+
+def test_inception_v3_train_returns_aux():
+    model = get_model("inception_v3", num_classes=5, dtype=jnp.float32)
+    x = jnp.zeros((1, 299, 299, 3))
+    shapes = jax.eval_shape(
+        lambda rng: model.init(rng, x, train=True), jax.random.key(0)
+    )
+    out_shapes = jax.eval_shape(
+        lambda v: model.apply(
+            v, x, train=True,
+            rngs={"dropout": jax.random.key(1)},
+            mutable=["batch_stats"],
+        ),
+        shapes,
+    )
+    (logits, aux), _ = out_shapes
+    assert logits.shape == (1, 5)
+    assert aux.shape == (1, 5)
+
+
+def test_vgg16_param_count():
+    model = get_model("vgg16", dtype=jnp.float32)
+    shapes = jax.eval_shape(
+        lambda rng: model.init(rng, jnp.zeros((1, 224, 224, 3))),
+        jax.random.key(0),
+    )
+    count = n_params(shapes["params"])
+    # Classic VGG-16: 138,357,544.
+    assert abs(count - 138_357_544) / 138_357_544 < 0.01, count
+
+
+def test_alexnet_forward_shape():
+    model = get_model("alexnet", num_classes=11, dtype=jnp.float32)
+    shapes = jax.eval_shape(
+        lambda rng: model.init(rng, jnp.zeros((1, 224, 224, 3))),
+        jax.random.key(0),
+    )
+    out = jax.eval_shape(
+        lambda v: model.apply(v, jnp.zeros((3, 224, 224, 3))), shapes
+    )
+    assert out.shape == (3, 11)
+
+
+class TestPTBLSTM:
+    def test_forward_and_carry(self):
+        model = get_model("ptb_lstm", config="small", vocab_size=100)
+        tokens = jnp.zeros((4, 8), jnp.int32)
+        variables = model.init(jax.random.key(0), tokens)
+        (logits, carry) = model.apply(variables, tokens)
+        assert logits.shape == (4, 8, 100)
+        assert len(carry) == model.num_layers
+        c, h = carry[0]
+        assert c.shape == (4, model.hidden_size)
+
+    def test_carry_threads_state(self):
+        """The reference threads final LSTM state into the next segment
+        (SURVEY.md §7.4.5): same tokens with different carries must differ."""
+        model = get_model("ptb_lstm", config="small", vocab_size=50)
+        tokens = jnp.ones((2, 4), jnp.int32)
+        variables = model.init(jax.random.key(0), tokens)
+        logits1, carry1 = model.apply(variables, tokens)
+        logits2, _ = model.apply(variables, tokens, carry=carry1)
+        assert not np.allclose(logits1, logits2)
+
+    def test_configs(self):
+        from distributed_tensorflow_models_tpu.models.ptb_lstm import (
+            PTB_CONFIGS,
+        )
+        assert set(PTB_CONFIGS) == {"small", "medium", "large"}
+        assert PTB_CONFIGS["medium"]["hidden_size"] == 650

@@ -1,0 +1,171 @@
+"""Numerics pinned to the reference's TF semantics (SURVEY.md §4.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu.ops import ema as emalib
+from distributed_tensorflow_models_tpu.ops import losses, metrics, optim
+
+
+class TestLosses:
+    def test_xent_matches_manual(self):
+        logits = jnp.array([[2.0, 1.0, 0.0], [0.0, 0.0, 0.0]])
+        labels = jnp.array([0, 2])
+        got = losses.softmax_cross_entropy(logits, labels)
+        expect = -jax.nn.log_softmax(logits)[jnp.arange(2), labels]
+        np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+    def test_label_smoothing_targets(self):
+        # eps=0.1, 10 classes: true class weight 0.91, others 0.01.
+        logits = jnp.zeros((1, 10))
+        got = losses.softmax_cross_entropy(
+            logits, jnp.array([3]), label_smoothing=0.1
+        )
+        # uniform logits -> loss = log(10) regardless of target distribution
+        np.testing.assert_allclose(got, [np.log(10)], rtol=1e-6)
+        # non-uniform check against hand-rolled smoothed one-hot
+        logits = jnp.array([[1.0, 2.0, 3.0]])
+        smoothed = jnp.array([[0.1 / 3, 0.1 / 3, 0.9 + 0.1 / 3]])
+        expect = -(smoothed * jax.nn.log_softmax(logits)).sum()
+        got = losses.softmax_cross_entropy(
+            logits, jnp.array([2]), label_smoothing=0.1
+        )
+        np.testing.assert_allclose(got[0], expect, rtol=1e-6)
+
+    def test_l2_decay_kernels_only(self):
+        params = {
+            "conv": {"kernel": jnp.full((2, 2), 2.0), "bias": jnp.ones(2)},
+        }
+        got = losses.l2_weight_decay(params, scale=0.1)
+        np.testing.assert_allclose(got, 0.1 * 0.5 * 4 * 4.0, rtol=1e-6)
+
+
+class TestMetrics:
+    def test_topk(self):
+        logits = jnp.array([[0.1, 0.5, 0.4], [0.9, 0.05, 0.05]])
+        labels = jnp.array([2, 0])
+        assert metrics.top_k_correct(logits, labels, 1).tolist() == [0.0, 1.0]
+        assert metrics.top_k_correct(logits, labels, 2).tolist() == [1.0, 1.0]
+        np.testing.assert_allclose(
+            metrics.accuracy(logits, labels), 0.5
+        )
+
+
+class TestTfRMSProp:
+    """Pin to the TF kernel recurrence (TF rmsprop.py:50): ms starts at ONES,
+    epsilon inside the sqrt."""
+
+    def test_single_step_matches_formula(self):
+        g = 0.5
+        lr, decay, momentum, eps = 0.1, 0.9, 0.9, 1e-2
+        tx = optim.tf_rmsprop(lr, decay, momentum, eps)
+        params = {"w": jnp.array([1.0])}
+        state = tx.init(params)
+        grads = {"w": jnp.array([g])}
+        updates, state = tx.update(grads, state)
+        ms = 0.9 * 1.0 + 0.1 * g * g  # ms init = 1.0, TF convention
+        mom = lr * g / np.sqrt(ms + eps)
+        np.testing.assert_allclose(updates["w"], [-mom], rtol=1e-6)
+        # second step accumulates momentum
+        updates, state = tx.update(grads, state)
+        ms2 = 0.9 * ms + 0.1 * g * g
+        mom2 = momentum * mom + lr * g / np.sqrt(ms2 + eps)
+        np.testing.assert_allclose(updates["w"], [-mom2], rtol=1e-6)
+
+    def test_centered_variant(self):
+        tx = optim.tf_rmsprop(0.1, 0.9, 0.0, 1e-2, centered=True)
+        params = {"w": jnp.array([2.0])}
+        state = tx.init(params)
+        updates, state = tx.update({"w": jnp.array([1.0])}, state)
+        ms = 0.9 + 0.1
+        mg = 0.1
+        denom = ms - mg * mg + 1e-2
+        np.testing.assert_allclose(
+            updates["w"], [-0.1 * 1.0 / np.sqrt(denom)], rtol=1e-6
+        )
+
+    def test_schedule_uses_count(self):
+        sched = optim.exponential_decay(1.0, decay_steps=1, decay_rate=0.5)
+        tx = optim.tf_rmsprop(sched, 0.9, 0.0, 1.0)
+        params = {"w": jnp.array([1.0])}
+        state = tx.init(params)
+        u1, state = tx.update({"w": jnp.array([1.0])}, state)
+        u2, state = tx.update({"w": jnp.array([0.0])}, state)
+        u3, state = tx.update({"w": jnp.array([0.0])}, state)
+        assert abs(float(u1["w"][0])) > 0
+        assert int(state.count) == 3
+
+
+class TestMomentumSGD:
+    def test_tf_momentum_accumulator(self):
+        # accum = m*accum + g ; update = -lr*accum  (TF momentum.py:25)
+        tx = optim.tf_momentum(0.1, momentum=0.9)
+        params = {"w": jnp.array([0.0])}
+        state = tx.init(params)
+        u1, state = tx.update({"w": jnp.array([1.0])}, state, params)
+        np.testing.assert_allclose(u1["w"], [-0.1], rtol=1e-6)
+        u2, state = tx.update({"w": jnp.array([1.0])}, state, params)
+        np.testing.assert_allclose(u2["w"], [-0.1 * 1.9], rtol=1e-6)
+
+    def test_sgd(self):
+        tx = optim.sgd(0.5)
+        state = tx.init({"w": jnp.array([0.0])})
+        u, _ = tx.update({"w": jnp.array([2.0])}, state)
+        np.testing.assert_allclose(u["w"], [-1.0])
+
+
+class TestSchedules:
+    def test_exponential_decay_staircase(self):
+        # TF legacy_learning_rate_decay.py:29 semantics.
+        s = optim.exponential_decay(0.1, 10, 0.5, staircase=True)
+        np.testing.assert_allclose(s(0), 0.1, rtol=1e-6)
+        np.testing.assert_allclose(s(9), 0.1, rtol=1e-6)
+        np.testing.assert_allclose(s(10), 0.05, rtol=1e-6)
+        np.testing.assert_allclose(s(25), 0.025, rtol=1e-6)
+
+    def test_exponential_decay_smooth(self):
+        s = optim.exponential_decay(0.1, 10, 0.5, staircase=False)
+        np.testing.assert_allclose(s(5), 0.1 * 0.5**0.5, rtol=1e-6)
+
+    def test_piecewise_constant(self):
+        s = optim.piecewise_constant([100, 200], [1.0, 0.1, 0.01])
+        np.testing.assert_allclose(s(0), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(s(150), 0.1, rtol=1e-5)
+        np.testing.assert_allclose(s(250), 0.01, rtol=1e-5)
+        # TF boundary semantics: old value holds AT the boundary
+        # (values[i] while x <= boundaries[i]).
+        np.testing.assert_allclose(s(100), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(s(101), 0.1, rtol=1e-5)
+        np.testing.assert_allclose(s(200), 0.1, rtol=1e-5)
+        np.testing.assert_allclose(s(201), 0.01, rtol=1e-5)
+
+    def test_clip_by_global_norm(self):
+        tx = optim.clip_by_global_norm(1.0)
+        grads = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}  # norm 5
+        state = tx.init(grads)
+        u, _ = tx.update(grads, state)
+        np.testing.assert_allclose(
+            optim.global_norm(u), 1.0, rtol=1e-6
+        )
+        np.testing.assert_allclose(u["a"], [0.6], rtol=1e-6)
+
+
+class TestEMA:
+    def test_effective_decay_ramp(self):
+        # TF moving_averages.py:284: min(decay, (1+n)/(10+n)).
+        d = emalib.effective_decay(0.999, jnp.asarray(0))
+        np.testing.assert_allclose(d, 0.1, rtol=1e-6)
+        d = emalib.effective_decay(0.999, jnp.asarray(90))
+        np.testing.assert_allclose(d, 0.91, rtol=1e-6)
+        d = emalib.effective_decay(0.5, jnp.asarray(90))
+        np.testing.assert_allclose(d, 0.5, rtol=1e-6)
+        d = emalib.effective_decay(0.999, None)
+        np.testing.assert_allclose(d, 0.999, rtol=1e-6)
+
+    def test_update_rule(self):
+        shadow = {"w": jnp.array([1.0])}
+        value = {"w": jnp.array([0.0])}
+        out = emalib.update_ema(shadow, value, decay=0.9)
+        np.testing.assert_allclose(out["w"], [0.9], rtol=1e-6)

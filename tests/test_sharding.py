@@ -1,0 +1,48 @@
+"""Sharding rules: the replacement for replica_device_setter placement
+(SURVEY.md §2.2 F2)."""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_models_tpu.core import sharding as shardlib
+from distributed_tensorflow_models_tpu.core.mesh import AxisNames
+
+
+def test_batch_sharding_spec():
+    assert shardlib.batch_spec(4) == P(AxisNames.DATA, None, None, None)
+    assert shardlib.batch_spec(1) == P(AxisNames.DATA)
+
+
+def test_shard_batch_places_on_data_axis(mesh8):
+    batch = {
+        "image": np.zeros((16, 8, 8, 3), np.float32),
+        "label": np.zeros((16,), np.int32),
+    }
+    sharded = shardlib.shard_batch(mesh8, batch)
+    for leaf in jax.tree.leaves(sharded):
+        spec = leaf.sharding.spec
+        assert spec[0] == AxisNames.DATA
+    # Each device holds 1/8 of the leading dim.
+    shard_shape = sharded["image"].sharding.shard_shape((16, 8, 8, 3))
+    assert shard_shape == (2, 8, 8, 3)
+
+
+def test_param_rules_default_replicated(mesh8):
+    params = {"layer": {"kernel": np.zeros((4, 4)), "bias": np.zeros(4)}}
+    sh = shardlib.tree_param_shardings(mesh8, params)
+    for leaf in jax.tree.leaves(sh):
+        assert leaf.spec == P()
+
+
+def test_param_rules_match_path(mesh8):
+    params = {
+        "body": {"kernel": np.zeros((4, 4))},
+        "head": {"kernel": np.zeros((4, 8)), "bias": np.zeros(8)},
+    }
+    sh = shardlib.tree_param_shardings(
+        mesh8, params, shardlib.head_tensor_parallel_rules()
+    )
+    assert sh["head"]["kernel"].spec == P(None, AxisNames.MODEL)
+    assert sh["head"]["bias"].spec == P(AxisNames.MODEL)
+    assert sh["body"]["kernel"].spec == P()

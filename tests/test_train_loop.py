@@ -1,0 +1,162 @@
+"""End-to-end train-step tests on the 8-fake-device mesh (SURVEY.md §4.3):
+the real Mesh/collective code path, no TPU required."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu.core import (
+    sharding as shardlib,
+    train_loop,
+)
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.ops import optim
+
+
+def make_batch(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "image": rng.rand(n, 28, 28, 1).astype(np.float32),
+        "label": rng.randint(0, 10, (n,)),
+    }
+
+
+@pytest.fixture(scope="module")
+def lenet_setup(mesh8):
+    model = get_model("lenet")
+    tx = optim.tf_momentum(0.05, 0.9)
+    state = TrainState.create(
+        model, tx, jax.random.key(0), jnp.zeros((2, 28, 28, 1)),
+        ema_decay=0.999,
+    )
+    state = train_loop.place_state(state, mesh8)
+    step = train_loop.make_train_step(
+        train_loop.classification_loss_fn(model.apply)
+    )
+    return model, state, step
+
+
+def test_loss_decreases(lenet_setup, mesh8):
+    model, state, step = lenet_setup
+    batch = shardlib.shard_batch(mesh8, make_batch())
+    rng = jax.random.key(7)
+    losses = []
+    for _ in range(20):
+        state, metrics = step(state, batch, rng)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert int(state.step) == 20
+
+
+def test_deterministic(lenet_setup, mesh8):
+    """SPMD sync training is reproducible — unlike the reference's async PS
+    races (SURVEY.md §5.2)."""
+    model, state0, step = lenet_setup
+    batch = shardlib.shard_batch(mesh8, make_batch(seed=3))
+    rng = jax.random.key(11)
+
+    def run():
+        s = state0
+        out = []
+        for _ in range(3):
+            s, m = step(s, batch, rng)
+            out.append(float(m["loss"]))
+        return out
+
+    assert run() == run()
+
+
+def test_global_batch_semantics(mesh8):
+    """Gradients over the sharded global batch must equal single-device
+    gradients over the same full batch — the semantics the reference gets
+    from SyncReplicasOptimizer's take_grad(N) averaging
+    (TF sync_replicas_optimizer.py:281-282)."""
+    model = get_model("lenet", dropout_rate=0.0)
+    tx = optim.sgd(0.1)
+    state = TrainState.create(
+        model, tx, jax.random.key(0), jnp.zeros((2, 28, 28, 1))
+    )
+    loss_fn = train_loop.classification_loss_fn(model.apply)
+    step = train_loop.make_train_step(loss_fn)
+    batch_np = make_batch(n=16, seed=5)
+    rng = jax.random.key(0)
+
+    # Sharded over the 8-device mesh.
+    state_mesh = train_loop.place_state(state, mesh8)
+    s1, m1 = step(state_mesh, shardlib.shard_batch(mesh8, batch_np), rng)
+
+    # Single device, full batch.
+    batch_local = {k: jnp.asarray(v) for k, v in batch_np.items()}
+    s2, m2 = step(state, batch_local, rng)
+
+    np.testing.assert_allclose(
+        float(m1["loss"]), float(m2["loss"]), rtol=1e-5
+    )
+    p1 = jax.tree.leaves(s1.params)
+    p2 = jax.tree.leaves(s2.params)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+
+
+def test_eval_step_counts(lenet_setup, mesh8):
+    model, state, step = lenet_setup
+    batch = shardlib.shard_batch(mesh8, make_batch(n=24))
+    eval_step = train_loop.make_eval_step(model.apply, use_ema=False)
+    out = eval_step(state, batch)
+    assert float(out["count"]) == 24
+    assert 0 <= float(out["top1_count"]) <= 24
+    assert float(out["top1_count"]) <= float(out["top5_count"])
+
+
+def test_ema_tracks_params(lenet_setup, mesh8):
+    model, state, step = lenet_setup
+    batch = shardlib.shard_batch(mesh8, make_batch())
+    rng = jax.random.key(1)
+    s = state
+    for _ in range(3):
+        s, _ = step(s, batch, rng)
+    # EMA shadows must differ from raw params but not be the init values.
+    diffs = [
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(
+            jax.tree.leaves(s.params), jax.tree.leaves(s.ema_params)
+        )
+    ]
+    assert max(diffs) > 0
+    # eval_params prefers EMA
+    assert s.eval_params is s.ema_params
+
+
+def test_bn_model_train_step(mesh8):
+    """ResNet-32 (with BatchNorm) through the generic step: batch_stats must
+    update; BN statistics are global-batch (sync BN, SURVEY.md §7.4.2)."""
+    model = get_model("resnet32_cifar")
+    tx = optim.tf_momentum(0.1, 0.9)
+    state = TrainState.create(
+        model, tx, jax.random.key(0), jnp.zeros((2, 32, 32, 3))
+    )
+    state = train_loop.place_state(state, mesh8)
+    step = train_loop.make_train_step(
+        train_loop.classification_loss_fn(
+            model.apply, weight_decay=1e-4
+        )
+    )
+    rng_np = np.random.RandomState(0)
+    batch = shardlib.shard_batch(
+        mesh8,
+        {
+            "image": rng_np.rand(16, 32, 32, 3).astype(np.float32),
+            "label": rng_np.randint(0, 10, (16,)),
+        },
+    )
+    stats_before = jax.tree.leaves(state.batch_stats)[0]
+    state, metrics = step(state, batch, jax.random.key(0))
+    stats_after = jax.tree.leaves(state.batch_stats)[0]
+    assert not np.allclose(
+        np.asarray(stats_before), np.asarray(stats_after)
+    )
+    assert np.isfinite(float(metrics["loss"]))
