@@ -168,6 +168,17 @@ def make_train_step(
         donate = jax.default_backend() != "cpu" and not os.environ.get(
             "PALLAS_AXON_POOL_IPS"
         )
+    step_fn = make_train_step_fn(loss_fn, rng_names)
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+
+def make_train_step_fn(
+    loss_fn: LossFn,
+    rng_names: Sequence[str] = ("dropout",),
+) -> Callable[[TrainState, Batch, jax.Array], tuple[TrainState, dict]]:
+    """The raw (unjitted) step — compose into larger compiled programs,
+    e.g. ``lax.scan`` over many steps for single-dispatch epochs/benchmarks
+    (amortises host round-trips, lets XLA overlap across step boundaries)."""
 
     def step_fn(state: TrainState, batch: Batch, rng: jax.Array):
         step_rng = jax.random.fold_in(rng, state.step)
@@ -204,7 +215,7 @@ def make_train_step(
         )
         return new_state, metrics
 
-    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+    return step_fn
 
 
 def make_eval_step(
