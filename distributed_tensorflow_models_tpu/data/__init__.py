@@ -1,0 +1,29 @@
+"""Input pipelines: the TPU-native replacement for the reference's
+graph-resident queue pipeline.
+
+The reference ingests data *inside the TF graph*: `string_input_producer` →
+`TFRecordReader` → decode/augment kernels → `shuffle_batch`/`batch_join`
+queues driven by Python `QueueRunner` threads (SURVEY.md §3.4; TF
+training/input.py:209,1089,1255; io_ops.py:542).  On TPU the idiomatic split
+is: *host-side* file reading + decode + augmentation feeding a small device
+prefetch buffer, with the accelerator program consuming one globally-sharded
+batch per step (SURVEY.md §2.3 "Queue kernels" row).
+
+Modules:
+
+- :mod:`tfrecord` — TFRecord container format (reader/writer, masked CRC32C),
+  with an optional native C++ fast path.
+- :mod:`example_proto` — minimal ``tf.train.Example`` wire-format codec
+  (no TensorFlow or protobuf dependency).
+- :mod:`augment` — the reference's augmentation set, transform-for-transform
+  (SURVEY.md §7.4.3).
+- :mod:`datasets` — array-backed datasets for every reference config
+  (MNIST, CIFAR-10, ImageNet-from-TFRecord, PTB).
+- :mod:`pipeline` — threaded host prefetcher with checkpointable iterator
+  state (the QueueRunner/Coordinator replacement, SURVEY.md §2.2 F10/F11).
+"""
+
+from distributed_tensorflow_models_tpu.data.pipeline import (  # noqa: F401
+    DevicePrefetcher,
+    HostPipeline,
+)

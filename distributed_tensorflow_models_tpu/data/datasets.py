@@ -1,0 +1,357 @@
+"""Array- and TFRecord-backed datasets for every reference config.
+
+Covers the reference zoo's inputs (SURVEY.md §2.1): MNIST (R3), CIFAR-10
+(R4), ImageNet TFRecord shards (R9), and the PTB token stream (R8).  Real
+data is loaded when present under ``DATA_DIR`` (``$DTM_DATA_DIR``, default
+``/root/data``); otherwise a deterministic synthetic substitute with the
+exact shapes/classes is generated, so every pipeline is runnable and
+testable in this offline environment.
+
+All iterators expose ``get_state()/set_state()`` for mid-epoch resume —
+the capability gap called out in SURVEY.md §5.4 (the reference's queue
+pipeline cannot resume; it restarts input from scratch after recovery).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from distributed_tensorflow_models_tpu.data import augment, example_proto, tfrecord
+
+DATA_DIR = os.environ.get("DTM_DATA_DIR", "/root/data")
+
+
+# --------------------------------------------------------------------------
+# Generic array dataset
+# --------------------------------------------------------------------------
+
+
+class ArrayDataset:
+    """Shuffled, checkpointable batch iterator over in-memory arrays.
+
+    Replaces ``shuffle_batch`` over an in-graph queue (TF training/input.py:
+    1255 — SURVEY.md §2.2 F10): per-epoch seeded permutation instead of a
+    RandomShuffleQueue, so batches are reproducible and the position
+    ``(epoch, batch_idx)`` is the full iterator state.
+
+    ``transform(image, rng) -> image`` runs per sample with an rng derived
+    from ``(seed, epoch, sample_position)`` — deterministic augmentation.
+    """
+
+    def __init__(
+        self,
+        arrays: dict[str, np.ndarray],
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        transform: Optional[Callable] = None,
+        transform_key: str = "image",
+        drop_remainder: bool = True,
+    ):
+        sizes = {k: len(v) for k, v in arrays.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"mismatched array lengths {sizes}")
+        self._arrays = arrays
+        self._n = next(iter(sizes.values()))
+        self._batch_size = batch_size
+        self._shuffle = shuffle
+        self._seed = seed
+        self._transform = transform
+        self._transform_key = transform_key
+        if not drop_remainder and self._n % batch_size:
+            raise NotImplementedError("partial final batches unsupported")
+        self._epoch = 0
+        self._batch_idx = 0
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self._n // self._batch_size
+
+    def get_state(self) -> dict:
+        return {"epoch": self._epoch, "batch_idx": self._batch_idx}
+
+    def set_state(self, state: dict) -> None:
+        self._epoch = int(state["epoch"])
+        self._batch_idx = int(state["batch_idx"])
+
+    def _perm(self) -> np.ndarray:
+        if not self._shuffle:
+            return np.arange(self._n)
+        return np.random.RandomState(
+            (self._seed + self._epoch) & 0x7FFFFFFF
+        ).permutation(self._n)
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        while True:
+            perm = self._perm()
+            while self._batch_idx < self.batches_per_epoch:
+                lo = self._batch_idx * self._batch_size
+                idx = perm[lo : lo + self._batch_size]
+                batch = {k: v[idx] for k, v in self._arrays.items()}
+                if self._transform is not None:
+                    key = self._transform_key
+                    out = []
+                    for j, img in enumerate(batch[key]):
+                        rng = np.random.default_rng(
+                            (self._seed, self._epoch, lo + j)
+                        )
+                        out.append(self._transform(img, rng))
+                    batch[key] = np.stack(out)
+                self._batch_idx += 1
+                yield batch
+            self._epoch += 1
+            self._batch_idx = 0
+
+
+# --------------------------------------------------------------------------
+# MNIST / CIFAR-10
+# --------------------------------------------------------------------------
+
+
+def _synthetic_images(n, h, w, c, classes, seed):
+    """Class-conditional gaussian blobs: learnable by a small net, so
+    loss-decrease integration tests (SURVEY.md §4.4) are meaningful.
+    Class means depend only on the *shape* signature, not ``seed``, so a
+    model trained on the train split generalizes to the test split."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, classes, n).astype(np.int32)
+    means = np.random.RandomState(hash((h, w, c, classes)) & 0x7FFFFFFF).rand(
+        classes, 1, 1, c
+    ).astype(np.float32)
+    images = (
+        means[labels]
+        + 0.1 * rng.randn(n, h, w, c).astype(np.float32)
+    ).clip(0, 1)
+    return images.astype(np.float32), labels
+
+
+def load_mnist(split: str = "train") -> tuple[np.ndarray, np.ndarray]:
+    """``[N,28,28,1]`` float32 in [0,1] + int32 labels (R3's input)."""
+    path = os.path.join(DATA_DIR, "mnist.npz")
+    if os.path.exists(path):
+        with np.load(path) as z:
+            x = z[f"x_{split}"].astype(np.float32)[..., None] / 255.0
+            y = z[f"y_{split}"].astype(np.int32)
+            return x, y
+    n = 8192 if split == "train" else 1024
+    return _synthetic_images(n, 28, 28, 1, 10, seed=1 if split == "train" else 2)
+
+
+def load_cifar10(split: str = "train") -> tuple[np.ndarray, np.ndarray]:
+    """``[N,32,32,3]`` float32 in [0,1] + int32 labels (R4's input)."""
+    path = os.path.join(DATA_DIR, "cifar10.npz")
+    if os.path.exists(path):
+        with np.load(path) as z:
+            x = z[f"x_{split}"].astype(np.float32) / 255.0
+            y = z[f"y_{split}"].reshape(-1).astype(np.int32)
+            return x, y
+    n = 8192 if split == "train" else 1024
+    return _synthetic_images(n, 32, 32, 3, 10, seed=3 if split == "train" else 4)
+
+
+def mnist_dataset(batch_size: int, split: str = "train", seed: int = 0):
+    x, y = load_mnist(split)
+    return ArrayDataset(
+        {"image": x, "label": y}, batch_size, shuffle=split == "train", seed=seed
+    )
+
+
+def cifar10_dataset(
+    batch_size: int, split: str = "train", seed: int = 0
+):
+    x, y = load_cifar10(split)
+    transform = (
+        augment.preprocess_cifar_train
+        if split == "train"
+        else lambda img, rng: augment.preprocess_cifar_eval(img)
+    )
+    return ArrayDataset(
+        {"image": x, "label": y},
+        batch_size,
+        shuffle=split == "train",
+        seed=seed,
+        transform=transform,
+    )
+
+
+# --------------------------------------------------------------------------
+# ImageNet TFRecord (R9)
+# --------------------------------------------------------------------------
+
+
+class ImageNetTFRecordDataset:
+    """TFRecord shards → decoded, augmented batches (R9 end-to-end).
+
+    Record schema (inception convention): ``image/encoded`` JPEG bytes,
+    ``image/class/label`` int64 (1-based in the reference's shards —
+    ``label_offset`` subtracts it away), optional ``image/object/bbox/*``.
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        batch_size: int,
+        *,
+        train: bool = True,
+        image_size: int = 224,
+        seed: int = 0,
+        label_offset: int = 0,
+        native: bool | None = None,
+    ):
+        self._records = tfrecord.ShardedRecordIterator(
+            paths, shuffle_shards=train, seed=seed, native=native
+        )
+        self._batch_size = batch_size
+        self._train = train
+        self._size = image_size
+        self._seed = seed
+        self._label_offset = label_offset
+        self._count = 0
+
+    def get_state(self) -> dict:
+        return {"records": self._records.get_state(), "count": self._count}
+
+    def set_state(self, state: dict) -> None:
+        self._records.set_state(state["records"])
+        self._count = int(state["count"])
+
+    def _parse(self, raw: bytes) -> tuple[np.ndarray, int]:
+        feats = example_proto.parse_example(raw)
+        img = augment.decode_jpeg(feats["image/encoded"][0])
+        label = int(feats["image/class/label"][0]) - self._label_offset
+        bbox = None
+        if self._train and feats.get("image/object/bbox/ymin"):
+            bbox = np.array(
+                [
+                    feats["image/object/bbox/ymin"][0],
+                    feats["image/object/bbox/xmin"][0],
+                    feats["image/object/bbox/ymax"][0],
+                    feats["image/object/bbox/xmax"][0],
+                ],
+                np.float32,
+            )
+        if self._train:
+            rng = np.random.default_rng((self._seed, self._count))
+            img = augment.preprocess_imagenet_train(
+                img, rng, size=self._size, bbox=bbox
+            )
+        else:
+            img = augment.preprocess_imagenet_eval(img, size=self._size)
+        return img.astype(np.float32), label
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        images, labels = [], []
+        for raw in self._records:
+            img, label = self._parse(raw)
+            self._count += 1
+            images.append(img)
+            labels.append(label)
+            if len(images) == self._batch_size:
+                yield {
+                    "image": np.stack(images),
+                    "label": np.asarray(labels, np.int32),
+                }
+                images, labels = [], []
+
+
+def synthetic_imagenet_dataset(
+    batch_size: int, image_size: int = 224, seed: int = 0
+):
+    """On-host synthetic ImageNet batches (shapes/classes exact) — the
+    throughput-benchmark input, the role slim's fake dataset played for the
+    reference's own benchmarking (see bench.py)."""
+    x, y = _synthetic_images(
+        max(2 * batch_size, 256), image_size, image_size, 3, 1000, seed
+    )
+    return ArrayDataset({"image": x, "label": y}, batch_size, seed=seed)
+
+
+# --------------------------------------------------------------------------
+# PTB (R8)
+# --------------------------------------------------------------------------
+
+
+class PTBDataset:
+    """``ptb_producer`` semantics: the token stream is laid out
+    ``[batch_size, -1]`` and cut into consecutive ``num_steps`` windows;
+    ``targets`` are inputs shifted by one.  Consecutive batches are
+    consecutive in the stream, which is what makes threading the LSTM carry
+    across steps meaningful (truncated BPTT, SURVEY.md §7.4.5)."""
+
+    def __init__(
+        self, tokens: np.ndarray, batch_size: int, num_steps: int
+    ):
+        n_batches = len(tokens) // batch_size
+        data = tokens[: n_batches * batch_size].reshape(batch_size, n_batches)
+        self._data = data
+        self._num_steps = num_steps
+        self._epoch_size = (n_batches - 1) // num_steps
+        if self._epoch_size <= 0:
+            raise ValueError("token stream too short for batch/num_steps")
+        self._pos = 0
+        self._epoch = 0
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self._epoch_size
+
+    def get_state(self) -> dict:
+        return {"epoch": self._epoch, "pos": self._pos}
+
+    def set_state(self, state: dict) -> None:
+        self._epoch = int(state["epoch"])
+        self._pos = int(state["pos"])
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        T = self._num_steps
+        while True:
+            while self._pos < self._epoch_size:
+                i = self._pos * T
+                self._pos += 1
+                yield {
+                    "inputs": self._data[:, i : i + T].astype(np.int32),
+                    "targets": self._data[:, i + 1 : i + T + 1].astype(
+                        np.int32
+                    ),
+                }
+            self._epoch += 1
+            self._pos = 0
+
+
+def load_ptb_tokens(split: str = "train", vocab_size: int = 10000) -> np.ndarray:
+    """Real PTB ids if ``ptb.{split}.txt`` exists under DATA_DIR (word-level,
+    vocab built from the train split), else a synthetic Zipfian stream."""
+    path = os.path.join(DATA_DIR, f"ptb.{split}.txt")
+    train_path = os.path.join(DATA_DIR, "ptb.train.txt")
+    if os.path.exists(path) and os.path.exists(train_path):
+        with open(train_path) as f:
+            words = f.read().replace("\n", " <eos> ").split()
+        vocab = {
+            w: i
+            for i, (w, _) in enumerate(
+                sorted(
+                    __import__("collections").Counter(words).items(),
+                    key=lambda kv: (-kv[1], kv[0]),
+                )
+            )
+        }
+        with open(path) as f:
+            data = f.read().replace("\n", " <eos> ").split()
+        return np.array([vocab[w] for w in data if w in vocab], np.int32)
+    rng = np.random.RandomState(5 if split == "train" else 6)
+    n = 200_000 if split == "train" else 20_000
+    # Zipf-ish distribution over the vocab, clipped into range.
+    toks = rng.zipf(1.3, n).astype(np.int64) % vocab_size
+    return toks.astype(np.int32)
+
+
+def ptb_dataset(
+    batch_size: int, num_steps: int, split: str = "train", vocab_size: int = 10000
+) -> PTBDataset:
+    return PTBDataset(
+        load_ptb_tokens(split, vocab_size), batch_size, num_steps
+    )

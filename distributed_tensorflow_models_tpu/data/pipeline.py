@@ -1,0 +1,140 @@
+"""Host prefetch pipeline: the QueueRunner/Coordinator replacement.
+
+The reference overlaps input with compute via graph-resident queues driven
+by Python ``QueueRunner`` threads under a ``Coordinator`` (SURVEY.md §2.2
+F10/F11; TF queue_runner_impl.py:34, coordinator.py:28).  The TPU-native
+split: a background host thread produces numpy batches into a bounded
+buffer (:class:`HostPipeline` — the queue-runner role, including the
+Coordinator's cooperative-stop and exception-propagation semantics), and
+:class:`DevicePrefetcher` keeps a couple of batches resident on the mesh so
+the next step's transfer overlaps the current step's compute.
+
+Unlike the reference's queues, the pipeline is *checkpointable*: each batch
+carries the producer state that follows it, so `state` after consuming
+batch k resumes at batch k+1 exactly (SURVEY.md §5.4 gap).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Optional
+
+PyTree = Any
+
+
+class _Stop:
+    pass
+
+
+_STOP = _Stop()
+
+
+class HostPipeline:
+    """Background-thread batch producer with bounded buffering.
+
+    ``dataset`` must be iterable (yielding numpy pytrees) and may expose
+    ``get_state()/set_state()`` for resume.
+    """
+
+    def __init__(self, dataset, *, prefetch: int = 4):
+        self._dataset = dataset
+        self._buffer: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._error: Optional[BaseException] = None
+        self._stop_event = threading.Event()
+        self._state: Optional[dict] = (
+            dataset.get_state() if hasattr(dataset, "get_state") else None
+        )
+        self._thread = threading.Thread(
+            target=self._run, name="host-pipeline", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            for batch in self._dataset:
+                state = (
+                    self._dataset.get_state()
+                    if hasattr(self._dataset, "get_state")
+                    else None
+                )
+                while not self._stop_event.is_set():
+                    try:
+                        self._buffer.put((batch, state), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop_event.is_set():
+                    return
+        except BaseException as e:  # propagate like Coordinator.join
+            self._error = e
+        finally:
+            try:
+                self._buffer.put((_STOP, None), timeout=1.0)
+            except queue.Full:
+                pass
+
+    def __iter__(self) -> Iterator[PyTree]:
+        return self
+
+    def __next__(self) -> PyTree:
+        # Buffered good batches drain before a producer error surfaces —
+        # the error is raised at the position it occurred, not earlier.
+        item, state = self._buffer.get()
+        if isinstance(item, _Stop):
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        self._state = state
+        return item
+
+    def get_state(self) -> Optional[dict]:
+        """Producer state as of the last *consumed* batch (resume-exact)."""
+        return self._state
+
+    def stop(self) -> None:
+        """Cooperative stop — ``Coordinator.request_stop`` +
+        ``join`` (TF coordinator.py:181,318)."""
+        self._stop_event.set()
+        while True:  # drain so the producer unblocks
+            try:
+                self._buffer.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+
+class DevicePrefetcher:
+    """Keep ``depth`` sharded batches ahead on the mesh.
+
+    Transfers the *next* batch to device while the current step computes —
+    the role of the reference's in-graph staging between queue and compute.
+    """
+
+    def __init__(self, iterator, mesh, *, depth: int = 2):
+        from distributed_tensorflow_models_tpu.core import sharding
+
+        self._it = iter(iterator)
+        self._mesh = mesh
+        self._shard = sharding.shard_batch
+        self._buf: list[PyTree] = []
+        self._depth = depth
+        self._fill()
+
+    def _fill(self) -> None:
+        while len(self._buf) < self._depth:
+            try:
+                batch = next(self._it)
+            except StopIteration:
+                return
+            self._buf.append(self._shard(self._mesh, batch))
+
+    def __iter__(self) -> Iterator[PyTree]:
+        return self
+
+    def __next__(self) -> PyTree:
+        if not self._buf:
+            raise StopIteration
+        out = self._buf.pop(0)
+        self._fill()
+        return out

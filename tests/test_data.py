@@ -1,0 +1,361 @@
+"""Data-pipeline tests: container format, Example codec, augmentation,
+dataset iteration + mid-epoch resume, host prefetch pipeline.
+
+TF 2.21 (installed) is used as the *oracle* for wire-format compatibility —
+SURVEY.md §4.5's parity-harness strategy.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu.data import (
+    augment,
+    datasets,
+    example_proto,
+    pipeline,
+    tfrecord,
+)
+
+
+# --------------------------------------------------------------------------
+# TFRecord container
+# --------------------------------------------------------------------------
+
+
+def test_tfrecord_roundtrip(tmp_path):
+    path = tmp_path / "a.tfrecord"
+    payloads = [b"hello", b"", b"x" * 10_000, bytes(range(256))]
+    assert tfrecord.write_records(path, payloads) == 4
+    assert list(tfrecord.read_records(path)) == payloads
+
+
+def test_tfrecord_crc_detects_corruption(tmp_path):
+    path = tmp_path / "a.tfrecord"
+    tfrecord.write_records(path, [b"payload-data"])
+    raw = bytearray(path.read_bytes())
+    raw[14] ^= 0xFF  # flip a payload byte
+    path.write_bytes(bytes(raw))
+    with pytest.raises(tfrecord.CorruptRecordError):
+        list(tfrecord.read_records(path))
+
+
+def test_tfrecord_matches_tf_oracle(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    path = str(tmp_path / "oracle.tfrecord")
+    payloads = [b"first", b"second" * 100]
+    with tf.io.TFRecordWriter(path) as w:
+        for p in payloads:
+            w.write(p)
+    assert list(tfrecord.read_records(path)) == payloads
+    # And TF can read ours.
+    ours = str(tmp_path / "ours.tfrecord")
+    tfrecord.write_records(ours, payloads)
+    got = [bytes(r.numpy()) for r in tf.data.TFRecordDataset(ours)]
+    assert got == payloads
+
+
+def test_crc32c_known_values():
+    # RFC 3720 test vector: 32 zero bytes -> 0x8a9136aa.
+    assert tfrecord.crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert tfrecord.crc32c(b"123456789") == 0xE3069283
+
+
+def test_sharded_iterator_resume(tmp_path):
+    paths = []
+    for s in range(3):
+        p = str(tmp_path / f"s{s}.tfrecord")
+        tfrecord.write_records(
+            p, [f"{s}-{i}".encode() for i in range(5)]
+        )
+        paths.append(p)
+    it = tfrecord.ShardedRecordIterator(paths, seed=7)
+    stream = iter(it)
+    first = [next(stream) for _ in range(8)]
+    state = it.get_state()
+
+    it2 = tfrecord.ShardedRecordIterator(paths, seed=7)
+    it2.set_state(state)
+    rest = [next(iter(it2)) for _ in range(7)]
+
+    it3 = tfrecord.ShardedRecordIterator(paths, seed=7)
+    full = [next(iter(it3)) for _ in range(15)]
+    assert first + rest == full
+
+
+# --------------------------------------------------------------------------
+# Example proto codec
+# --------------------------------------------------------------------------
+
+
+def test_example_roundtrip_self():
+    feats = {
+        "image/encoded": [b"\x00\x01jpegdata"],
+        "image/class/label": [42],
+        "bbox": [0.1, 0.2, 0.9, 0.8],
+    }
+    parsed = example_proto.parse_example(example_proto.build_example(feats))
+    assert parsed["image/encoded"] == [b"\x00\x01jpegdata"]
+    assert parsed["image/class/label"] == [42]
+    np.testing.assert_allclose(parsed["bbox"], feats["bbox"], rtol=1e-6)
+
+
+def test_example_matches_tf_oracle():
+    tf = pytest.importorskip("tensorflow")
+    ex = tf.train.Example(
+        features=tf.train.Features(
+            feature={
+                "image/encoded": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[b"rawbytes"])
+                ),
+                "image/class/label": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[7, -3])
+                ),
+                "w": tf.train.Feature(
+                    float_list=tf.train.FloatList(value=[1.5, -2.25])
+                ),
+            }
+        )
+    )
+    parsed = example_proto.parse_example(ex.SerializeToString())
+    assert parsed["image/encoded"] == [b"rawbytes"]
+    assert parsed["image/class/label"] == [7, -3]
+    np.testing.assert_allclose(parsed["w"], [1.5, -2.25])
+
+    # Reverse direction: TF parses what we build.
+    ours = example_proto.build_example(
+        {"label": [5], "name": [b"x"], "f": [0.5]}
+    )
+    parsed_tf = tf.io.parse_single_example(
+        ours,
+        {
+            "label": tf.io.FixedLenFeature([], tf.int64),
+            "name": tf.io.FixedLenFeature([], tf.string),
+            "f": tf.io.FixedLenFeature([], tf.float32),
+        },
+    )
+    assert int(parsed_tf["label"]) == 5
+    assert bytes(parsed_tf["name"].numpy()) == b"x"
+    assert float(parsed_tf["f"]) == 0.5
+
+
+# --------------------------------------------------------------------------
+# Augmentation
+# --------------------------------------------------------------------------
+
+
+def test_per_image_standardization_matches_tf():
+    tf = pytest.importorskip("tensorflow")
+    rng = np.random.RandomState(0)
+    img = rng.rand(16, 16, 3).astype(np.float32)
+    ours = augment.per_image_standardization(img)
+    theirs = tf.image.per_image_standardization(img).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+    assert abs(ours.mean()) < 1e-4
+    # JAX batched variant agrees.
+    jax_out = np.asarray(
+        augment.jax_per_image_standardization(img[None])[0]
+    )
+    np.testing.assert_allclose(jax_out, ours, rtol=1e-4, atol=1e-5)
+
+
+def test_cifar_train_preprocess_shapes_and_determinism():
+    img = np.random.RandomState(1).rand(32, 32, 3).astype(np.float32)
+    a = augment.preprocess_cifar_train(img, np.random.default_rng(3))
+    b = augment.preprocess_cifar_train(img, np.random.default_rng(3))
+    c = augment.preprocess_cifar_train(img, np.random.default_rng(4))
+    assert a.shape == (32, 32, 3)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_distorted_bbox_crop_properties():
+    rng = np.random.default_rng(0)
+    areas = []
+    for _ in range(50):
+        top, left, h, w = augment.sample_distorted_bounding_box((480, 640), rng)
+        assert 0 <= top <= 480 - h and 0 <= left <= 640 - w
+        assert 1 <= h <= 480 and 1 <= w <= 640
+        areas.append(h * w / (480 * 640))
+        assert 0.6 <= (w / h) <= 1.45  # aspect within sampled range + rounding
+    assert min(areas) < 0.4 and max(areas) > 0.5  # spans the area range
+
+
+def test_imagenet_train_preprocess():
+    img = (np.random.RandomState(2).rand(64, 80, 3) * 255).astype(np.uint8)
+    out = augment.preprocess_imagenet_train(
+        img, np.random.default_rng(1), size=32
+    )
+    assert out.shape == (32, 32, 3)
+    assert out.min() >= -1.0 - 1e-6 and out.max() <= 1.0 + 1e-6
+
+
+def test_imagenet_eval_preprocess_central_crop():
+    img = (np.random.RandomState(2).rand(100, 100, 3) * 255).astype(np.uint8)
+    out = augment.preprocess_imagenet_eval(img, size=24)
+    assert out.shape == (24, 24, 3)
+
+
+def test_jpeg_roundtrip_decode():
+    yy, xx = np.mgrid[0:40, 0:40]
+    img = np.stack([yy * 6, xx * 6, (yy + xx) * 3], axis=-1).astype(np.uint8)
+    decoded = augment.decode_jpeg(augment.encode_jpeg(img, quality=95))
+    assert decoded.shape == (40, 40, 3)
+    assert np.abs(decoded.astype(int) - img.astype(int)).mean() < 8
+
+
+def test_jax_random_crop_with_pad():
+    import jax
+
+    imgs = np.random.RandomState(0).rand(4, 8, 8, 3).astype(np.float32)
+    out = augment.jax_random_crop_with_pad(imgs, jax.random.key(0), pad=2)
+    assert out.shape == (4, 8, 8, 3)
+
+
+# --------------------------------------------------------------------------
+# Datasets
+# --------------------------------------------------------------------------
+
+
+def test_array_dataset_epochs_and_resume():
+    x = np.arange(20, dtype=np.float32).reshape(20, 1)
+    y = np.arange(20, dtype=np.int32)
+    ds = datasets.ArrayDataset({"image": x, "label": y}, 4, seed=11)
+    it = iter(ds)
+    seen = [next(it) for _ in range(7)]  # crosses an epoch boundary
+    state = ds.get_state()
+
+    ds2 = datasets.ArrayDataset({"image": x, "label": y}, 4, seed=11)
+    ds2.set_state(state)
+    resumed = [next(iter(ds2)) for _ in range(3)]
+
+    ds3 = datasets.ArrayDataset({"image": x, "label": y}, 4, seed=11)
+    full = [next(iter(ds3)) for _ in range(10)]
+    for a, b in zip(seen + resumed, full):
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+    # Every epoch covers all samples exactly once.
+    labels = np.concatenate([b["label"] for b in full[:5]])
+    assert sorted(labels.tolist()) == list(range(20))
+
+
+def test_mnist_cifar_shapes():
+    b = next(iter(datasets.mnist_dataset(8)))
+    assert b["image"].shape == (8, 28, 28, 1)
+    b = next(iter(datasets.cifar10_dataset(8)))
+    assert b["image"].shape == (8, 32, 32, 3)
+    assert b["image"].dtype == np.float32
+    # standardized: roughly zero mean per image
+    assert abs(b["image"][0].mean()) < 0.1
+
+
+def test_imagenet_tfrecord_dataset(tmp_path):
+    paths = []
+    rs = np.random.RandomState(0)
+    for s in range(2):
+        recs = []
+        for i in range(6):
+            img = (rs.rand(48, 56, 3) * 255).astype(np.uint8)
+            recs.append(
+                example_proto.build_example(
+                    {
+                        "image/encoded": [augment.encode_jpeg(img)],
+                        "image/class/label": [1 + (s * 6 + i) % 10],
+                    }
+                )
+            )
+        p = str(tmp_path / f"train-{s:05d}")
+        tfrecord.write_records(p, recs)
+        paths.append(p)
+
+    ds = datasets.ImageNetTFRecordDataset(
+        paths, 4, train=True, image_size=32, label_offset=1
+    )
+    batch = next(iter(ds))
+    assert batch["image"].shape == (4, 32, 32, 3)
+    assert batch["label"].min() >= 0 and batch["label"].max() < 10
+
+    state = ds.get_state()
+    ds2 = datasets.ImageNetTFRecordDataset(
+        paths, 4, train=True, image_size=32, label_offset=1
+    )
+    ds2.set_state(state)
+    b2 = next(iter(ds2))
+    b_cont = next(iter(ds))
+    np.testing.assert_array_equal(b2["label"], b_cont["label"])
+
+
+def test_ptb_dataset_windows_and_resume():
+    tokens = np.arange(100, dtype=np.int32)
+    ds = datasets.PTBDataset(tokens, batch_size=4, num_steps=5)
+    it = iter(ds)
+    b0 = next(it)
+    assert b0["inputs"].shape == (4, 5)
+    np.testing.assert_array_equal(b0["targets"], b0["inputs"] + 1)
+    b1 = next(it)
+    np.testing.assert_array_equal(b1["inputs"], b0["inputs"] + 5)
+
+    state = ds.get_state()
+    ds2 = datasets.PTBDataset(tokens, batch_size=4, num_steps=5)
+    ds2.set_state(state)
+    np.testing.assert_array_equal(next(iter(ds2))["inputs"], next(it)["inputs"])
+
+
+def test_synthetic_imagenet():
+    ds = datasets.synthetic_imagenet_dataset(16, image_size=8)
+    b = next(iter(ds))
+    assert b["image"].shape == (16, 8, 8, 3)
+    assert b["label"].max() < 1000
+
+
+# --------------------------------------------------------------------------
+# Host pipeline + device prefetch
+# --------------------------------------------------------------------------
+
+
+def test_host_pipeline_order_and_state():
+    x = np.arange(24, dtype=np.float32).reshape(24, 1)
+    y = np.arange(24, dtype=np.int32)
+    ds = datasets.ArrayDataset({"image": x, "label": y}, 4, seed=2)
+    pipe = pipeline.HostPipeline(ds, prefetch=2)
+    got = [next(pipe) for _ in range(4)]
+    state = pipe.get_state()
+    pipe.stop()
+
+    # Resume from the captured state reproduces the continuation.
+    ds2 = datasets.ArrayDataset({"image": x, "label": y}, 4, seed=2)
+    ds2.set_state(state)
+    pipe2 = pipeline.HostPipeline(ds2, prefetch=2)
+    b_resume = next(pipe2)
+    pipe2.stop()
+
+    ds3 = datasets.ArrayDataset({"image": x, "label": y}, 4, seed=2)
+    ref = [next(iter(ds3)) for _ in range(5)]
+    for a, b in zip(got, ref[:4]):
+        np.testing.assert_array_equal(a["label"], b["label"])
+    np.testing.assert_array_equal(b_resume["label"], ref[4]["label"])
+
+
+def test_host_pipeline_propagates_errors():
+    def bad_gen():
+        yield {"x": np.zeros(1)}
+        raise RuntimeError("producer exploded")
+
+    pipe = pipeline.HostPipeline(bad_gen(), prefetch=1)
+    next(pipe)
+    with pytest.raises(RuntimeError, match="producer exploded"):
+        next(pipe)
+        next(pipe)
+
+
+def test_device_prefetcher(mesh8):
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    y = np.arange(8, dtype=np.int32)
+    ds = datasets.ArrayDataset({"image": x, "label": y}, 8, seed=0)
+    pre = pipeline.DevicePrefetcher(ds, mesh8, depth=2)
+    batch = next(pre)
+    import jax
+
+    assert isinstance(batch["image"], jax.Array)
+    assert batch["image"].shape == (8, 8)
+    # Sharded over the data axis of the mesh.
+    assert not batch["image"].sharding.is_fully_replicated
