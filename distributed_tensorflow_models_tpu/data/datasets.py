@@ -202,8 +202,15 @@ class ImageNetTFRecordDataset:
         label_offset: int = 0,
         native: bool | None = None,
     ):
+        # Eval is exactly one pass (the reference eval loop counts over the
+        # validation set once per checkpoint, SURVEY.md §3.5); training
+        # loops epochs forever.
         self._records = tfrecord.ShardedRecordIterator(
-            paths, shuffle_shards=train, seed=seed, native=native
+            paths,
+            shuffle_shards=train,
+            seed=seed,
+            native=native,
+            num_epochs=None if train else 1,
         )
         self._batch_size = batch_size
         self._train = train
@@ -256,6 +263,12 @@ class ImageNetTFRecordDataset:
                     "label": np.asarray(labels, np.int32),
                 }
                 images, labels = [], []
+        if images and not self._train:
+            # Partial final batch so a one-pass eval covers every record.
+            yield {
+                "image": np.stack(images),
+                "label": np.asarray(labels, np.int32),
+            }
 
 
 def synthetic_imagenet_dataset(
@@ -330,13 +343,12 @@ def load_ptb_tokens(split: str = "train", vocab_size: int = 10000) -> np.ndarray
     if os.path.exists(path) and os.path.exists(train_path):
         with open(train_path) as f:
             words = f.read().replace("\n", " <eos> ").split()
+        from collections import Counter
+
         vocab = {
             w: i
             for i, (w, _) in enumerate(
-                sorted(
-                    __import__("collections").Counter(words).items(),
-                    key=lambda kv: (-kv[1], kv[0]),
-                )
+                sorted(Counter(words).items(), key=lambda kv: (-kv[1], kv[0]))
             )
         }
         with open(path) as f:

@@ -151,6 +151,8 @@ def _encode_len_field(out: bytearray, field: int, payload: bytes) -> None:
 
 
 def _encode_feature(values: FeatureValue) -> bytes:
+    import numbers
+
     inner = bytearray()
     out = bytearray()
     values = list(values)
@@ -160,7 +162,15 @@ def _encode_feature(values: FeatureValue) -> bytes:
                 v = v.encode("utf-8")
             _encode_len_field(inner, 1, v)
         _encode_len_field(out, 1, bytes(inner))
-    elif values and isinstance(values[0], float):
+    elif values and (
+        # numpy float32/float64 are not Python floats but must encode as
+        # FloatList — Real-but-not-Integral covers both.
+        isinstance(values[0], float)
+        or (
+            isinstance(values[0], numbers.Real)
+            and not isinstance(values[0], numbers.Integral)
+        )
+    ):
         packed = struct.pack(f"<{len(values)}f", *values)
         _encode_len_field(inner, 1, packed)
         _encode_len_field(out, 2, bytes(inner))

@@ -69,10 +69,15 @@ class HostPipeline:
         except BaseException as e:  # propagate like Coordinator.join
             self._error = e
         finally:
-            try:
-                self._buffer.put((_STOP, None), timeout=1.0)
-            except queue.Full:
-                pass
+            # The STOP sentinel must not be dropped: without it a consumer
+            # blocks forever after draining the buffer (and a stored error
+            # would never surface).  Retry until delivered or stop requested.
+            while not self._stop_event.is_set():
+                try:
+                    self._buffer.put((_STOP, None), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def __iter__(self) -> Iterator[PyTree]:
         return self

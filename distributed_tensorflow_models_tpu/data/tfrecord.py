@@ -121,7 +121,12 @@ class ShardedRecordIterator:
         shuffle_shards: bool = True,
         seed: int = 0,
         native: bool | None = None,
+        num_epochs: int | None = None,
     ):
+        """``native``: None = use the C++ loader when built, True = require
+        it (raise if missing), False = pure Python.  ``num_epochs``: stop
+        after that many passes (eval loops need exactly one); None = loop
+        forever (training)."""
         if not paths:
             raise ValueError("no shard paths given")
         self._paths = list(paths)
@@ -131,6 +136,7 @@ class ShardedRecordIterator:
         self._shard_idx = 0
         self._record_idx = 0
         self._native = native
+        self._num_epochs = num_epochs
 
     def _epoch_order(self) -> list[str]:
         if not self._shuffle:
@@ -145,14 +151,16 @@ class ShardedRecordIterator:
     def _read_shard(self, path: str) -> Iterator[bytes]:
         use_native = self._native
         if use_native is None or use_native:
-            try:
-                from distributed_tensorflow_models_tpu.data import native_loader
+            from distributed_tensorflow_models_tpu.data import native_loader
 
-                if native_loader.available():
-                    return iter(native_loader.read_all_records(path))
-            except Exception:
-                if use_native:
-                    raise
+            if native_loader.available():
+                return iter(native_loader.read_all_records(path))
+            if use_native:
+                raise RuntimeError(
+                    "native=True but the native library is not built; "
+                    "run `make -C native` or pass native=None for "
+                    "automatic fallback"
+                )
         return read_records(path)
 
     def get_state(self) -> dict:
@@ -168,7 +176,7 @@ class ShardedRecordIterator:
         self._record_idx = int(state["record_idx"])
 
     def __iter__(self) -> Iterator[bytes]:
-        while True:
+        while self._num_epochs is None or self._epoch < self._num_epochs:
             order = self._epoch_order()
             while self._shard_idx < len(order):
                 path = order[self._shard_idx]

@@ -1,0 +1,292 @@
+// Native TFRecord loader: framed-record parsing, hardware CRC32C, and a
+// multi-threaded shard prefetch pool.
+//
+// Role: the reference ingests records through TensorFlow's C++
+// `TFRecordReader` kernel and overlaps I/O with compute via queue kernels
+// plus Python queue-runner threads (SURVEY.md §2.3, §3.4; TF io_ops.py:542,
+// input.py:1089 binding sites).  This library keeps that layer native in the
+// new framework: C++ threads stream raw records from shard files into a
+// bounded ring buffer the Python host pipeline drains — decode/augment stay
+// in Python/NumPy, framing+CRC+I/O run here.
+//
+// C ABI (consumed by data/native_loader.py via ctypes):
+//   dtm_crc32c(data, n)                 -> crc32c value
+//   dtm_reader_open(path, verify_crc)   -> handle | NULL
+//   dtm_reader_next(h, &buf, &size)     -> 1 record, 0 EOF, <0 corrupt
+//   dtm_reader_close(h)
+//   dtm_pool_open(paths, n, threads, capacity) -> handle | NULL
+//   dtm_pool_next(h, &buf, &size)       -> 1 record, 0 drained, <0 corrupt
+//   dtm_pool_close(h)
+//   dtm_free(buf)
+//
+// Buffers returned through &buf are malloc'd; the caller frees with
+// dtm_free (Python copies then frees immediately).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#endif
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli).  SSE4.2 hardware instruction when compiled with
+// -msse4.2, slice-by-8 table fallback otherwise.
+// ---------------------------------------------------------------------------
+
+uint32_t g_table[8][256];
+std::once_flag g_table_once;
+
+void init_table() {
+  const uint32_t poly = 0x82F63B78u;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+    g_table[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = g_table[0][i];
+    for (int t = 1; t < 8; t++) {
+      c = g_table[0][c & 0xFF] ^ (c >> 8);
+      g_table[t][i] = c;
+    }
+  }
+}
+
+[[maybe_unused]] uint32_t crc32c_sw(const uint8_t* p, size_t n, uint32_t crc) {
+  std::call_once(g_table_once, init_table);
+  crc ^= 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    w ^= crc;
+    crc = g_table[7][w & 0xFF] ^ g_table[6][(w >> 8) & 0xFF] ^
+          g_table[5][(w >> 16) & 0xFF] ^ g_table[4][(w >> 24) & 0xFF] ^
+          g_table[3][(w >> 32) & 0xFF] ^ g_table[2][(w >> 40) & 0xFF] ^
+          g_table[1][(w >> 48) & 0xFF] ^ g_table[0][w >> 56];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = g_table[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+uint32_t crc32c(const uint8_t* p, size_t n, uint32_t crc = 0) {
+#if defined(__SSE4_2__)
+  uint32_t c = crc ^ 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    c = (uint32_t)_mm_crc32_u64(c, w);
+    p += 8;
+    n -= 8;
+  }
+  while (n--) c = _mm_crc32_u8(c, *p++);
+  return c ^ 0xFFFFFFFFu;
+#else
+  return crc32c_sw(p, n, crc);
+#endif
+}
+
+uint32_t masked_crc(const uint8_t* p, size_t n) {
+  uint32_t c = crc32c(p, n);
+  return ((c >> 15) | (c << 17)) + 0xA282EAD8u;
+}
+
+// ---------------------------------------------------------------------------
+// Single-file reader
+// ---------------------------------------------------------------------------
+
+constexpr int kOk = 1;
+constexpr int kEof = 0;
+constexpr int kErrTruncated = -1;
+constexpr int kErrLengthCrc = -2;
+constexpr int kErrDataCrc = -3;
+constexpr int kErrTooLarge = -4;
+
+// Records larger than this are treated as corruption (a flipped length
+// field would otherwise drive a multi-GB allocation).
+constexpr uint64_t kMaxRecordBytes = 1ull << 30;
+
+struct Reader {
+  FILE* f = nullptr;
+  bool verify = true;
+};
+
+// Returns kOk and a malloc'd buffer in *out, or a status code.
+int read_one(FILE* f, bool verify, uint8_t** out, uint64_t* out_size) {
+  uint8_t header[12];
+  size_t got = fread(header, 1, 12, f);
+  if (got == 0) return kEof;
+  if (got < 12) return kErrTruncated;
+  uint64_t len;
+  uint32_t len_crc;
+  memcpy(&len, header, 8);
+  memcpy(&len_crc, header + 8, 4);
+  if (verify && masked_crc(header, 8) != len_crc) return kErrLengthCrc;
+  if (len > kMaxRecordBytes) return kErrTooLarge;
+  uint8_t* data = (uint8_t*)malloc(len ? len : 1);
+  if (fread(data, 1, len, f) < len) {
+    free(data);
+    return kErrTruncated;
+  }
+  uint32_t data_crc;
+  if (fread(&data_crc, 1, 4, f) < 4) {
+    free(data);
+    return kErrTruncated;
+  }
+  if (verify && masked_crc(data, len) != data_crc) {
+    free(data);
+    return kErrDataCrc;
+  }
+  *out = data;
+  *out_size = len;
+  return kOk;
+}
+
+// ---------------------------------------------------------------------------
+// Threaded shard pool: N workers pull shard paths off a list and push
+// records into one bounded ring buffer (the batch_join N-reader pattern).
+// ---------------------------------------------------------------------------
+
+struct Record {
+  uint8_t* data;
+  uint64_t size;
+};
+
+struct Pool {
+  std::vector<std::string> paths;
+  std::atomic<size_t> next_path{0};
+  size_t capacity;
+
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<Record> buffer;
+  int error = kOk;          // first error wins; pool drains then reports it
+  int live_workers = 0;
+  bool closing = false;
+
+  std::vector<std::thread> workers;
+};
+
+void worker_main(Pool* pool) {
+  for (;;) {
+    size_t idx = pool->next_path.fetch_add(1);
+    if (idx >= pool->paths.size()) break;
+    FILE* f = fopen(pool->paths[idx].c_str(), "rb");
+    if (!f) {
+      std::lock_guard<std::mutex> lock(pool->mu);
+      if (pool->error == kOk) pool->error = kErrTruncated;
+      break;
+    }
+    for (;;) {
+      uint8_t* data;
+      uint64_t size;
+      int rc = read_one(f, true, &data, &size);
+      if (rc == kEof) break;
+      if (rc != kOk) {
+        std::lock_guard<std::mutex> lock(pool->mu);
+        if (pool->error == kOk) pool->error = rc;
+        fclose(f);
+        goto done;
+      }
+      std::unique_lock<std::mutex> lock(pool->mu);
+      pool->cv_push.wait(lock, [&] {
+        return pool->buffer.size() < pool->capacity || pool->closing;
+      });
+      if (pool->closing) {
+        free(data);
+        fclose(f);
+        goto done;
+      }
+      pool->buffer.push_back({data, size});
+      pool->cv_pop.notify_one();
+    }
+    fclose(f);
+  }
+done:
+  std::lock_guard<std::mutex> lock(pool->mu);
+  pool->live_workers--;
+  pool->cv_pop.notify_all();
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t dtm_crc32c(const char* data, uint64_t n) {
+  return crc32c((const uint8_t*)data, n);
+}
+
+void* dtm_reader_open(const char* path, int verify_crc) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Reader* r = new Reader{f, verify_crc != 0};
+  return r;
+}
+
+int dtm_reader_next(void* handle, char** out, uint64_t* out_size) {
+  Reader* r = (Reader*)handle;
+  return read_one(r->f, r->verify, (uint8_t**)out, out_size);
+}
+
+void dtm_reader_close(void* handle) {
+  Reader* r = (Reader*)handle;
+  fclose(r->f);
+  delete r;
+}
+
+void* dtm_pool_open(const char** paths, int n_paths, int threads,
+                    int capacity) {
+  if (n_paths <= 0 || threads <= 0 || capacity <= 0) return nullptr;
+  Pool* pool = new Pool;
+  for (int i = 0; i < n_paths; i++) pool->paths.emplace_back(paths[i]);
+  pool->capacity = (size_t)capacity;
+  pool->live_workers = threads;
+  for (int i = 0; i < threads; i++)
+    pool->workers.emplace_back(worker_main, pool);
+  return pool;
+}
+
+int dtm_pool_next(void* handle, char** out, uint64_t* out_size) {
+  Pool* pool = (Pool*)handle;
+  std::unique_lock<std::mutex> lock(pool->mu);
+  pool->cv_pop.wait(lock, [&] {
+    return !pool->buffer.empty() || pool->live_workers == 0;
+  });
+  if (pool->buffer.empty())  // fully drained: report first error, else EOF
+    return pool->error == kOk ? kEof : pool->error;
+  Record rec = pool->buffer.front();
+  pool->buffer.pop_front();
+  pool->cv_push.notify_one();
+  *out = (char*)rec.data;
+  *out_size = rec.size;
+  return 1;
+}
+
+void dtm_pool_close(void* handle) {
+  Pool* pool = (Pool*)handle;
+  {
+    std::lock_guard<std::mutex> lock(pool->mu);
+    pool->closing = true;
+    pool->cv_push.notify_all();
+  }
+  for (auto& t : pool->workers) t.join();
+  for (auto& rec : pool->buffer) free(rec.data);
+  delete pool;
+}
+
+void dtm_free(void* p) { free(p); }
+
+}  // extern "C"

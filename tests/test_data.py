@@ -300,6 +300,53 @@ def test_ptb_dataset_windows_and_resume():
     np.testing.assert_array_equal(next(iter(ds2))["inputs"], next(it)["inputs"])
 
 
+def test_example_numpy_scalars_encode_correctly():
+    feats = {
+        "bbox": [np.float32(0.37), np.float32(0.9)],
+        "label": [np.int64(3)],
+    }
+    parsed = example_proto.parse_example(example_proto.build_example(feats))
+    np.testing.assert_allclose(parsed["bbox"], [0.37, 0.9], rtol=1e-6)
+    assert parsed["label"] == [3]
+
+
+def test_imagenet_eval_is_one_pass_with_partial_batch(tmp_path):
+    recs = []
+    for i in range(10):
+        img = np.full((24, 24, 3), i * 20, np.uint8)
+        recs.append(
+            example_proto.build_example(
+                {
+                    "image/encoded": [augment.encode_jpeg(img)],
+                    "image/class/label": [i],
+                }
+            )
+        )
+    p = str(tmp_path / "val-00000")
+    tfrecord.write_records(p, recs)
+    ds = datasets.ImageNetTFRecordDataset(
+        [p], 4, train=False, image_size=16
+    )
+    batches = list(ds)
+    assert [len(b["label"]) for b in batches] == [4, 4, 2]
+    assert sorted(np.concatenate([b["label"] for b in batches])) == list(
+        range(10)
+    )
+
+
+def test_sharded_iterator_native_true_requires_library(tmp_path):
+    from distributed_tensorflow_models_tpu.data import native_loader
+
+    p = str(tmp_path / "s")
+    tfrecord.write_records(p, [b"x"])
+    it = tfrecord.ShardedRecordIterator([p], native=True)
+    if native_loader.available():
+        assert next(iter(it)) == b"x"
+    else:
+        with pytest.raises(RuntimeError, match="native=True"):
+            next(iter(it))
+
+
 def test_synthetic_imagenet():
     ds = datasets.synthetic_imagenet_dataset(16, image_size=8)
     b = next(iter(ds))
