@@ -235,12 +235,22 @@ def make_eval_step(
             outputs[0] if isinstance(outputs, (tuple, list)) else outputs
         )
         labels = batch["label"]
+        # Rows with label < 0 are padding (partial final eval batches padded
+        # up to the mesh size) and are excluded from every count.
+        valid = (labels >= 0).astype(jnp.float32)
         return {
-            "top1_count": jnp.sum(metriclib.top_k_correct(logits, labels, 1)),
-            "top5_count": jnp.sum(metriclib.top_k_correct(logits, labels, 5)),
-            "count": jnp.asarray(labels.shape[0], jnp.float32),
+            "top1_count": jnp.sum(
+                metriclib.top_k_correct(logits, labels, 1) * valid
+            ),
+            "top5_count": jnp.sum(
+                metriclib.top_k_correct(logits, labels, 5) * valid
+            ),
+            "count": jnp.sum(valid),
             "xent_sum": jnp.sum(
-                losslib.softmax_cross_entropy(logits, labels)
+                losslib.softmax_cross_entropy(
+                    logits, jnp.maximum(labels, 0)
+                )
+                * valid
             ),
         }
 

@@ -114,16 +114,26 @@ class DevicePrefetcher:
 
     Transfers the *next* batch to device while the current step computes —
     the role of the reference's in-graph staging between queue and compute.
+
+    Each buffered batch carries the producer state captured when it was
+    pulled, and :meth:`get_state` returns the state of the last batch
+    *handed to the consumer* — so a checkpoint taken mid-training resumes
+    at exactly the next unconsumed batch, never skipping the ``depth``
+    batches sitting in this buffer.
     """
 
     def __init__(self, iterator, mesh, *, depth: int = 2):
         from distributed_tensorflow_models_tpu.core import sharding
 
         self._it = iter(iterator)
+        self._source = iterator
         self._mesh = mesh
         self._shard = sharding.shard_batch
-        self._buf: list[PyTree] = []
+        self._buf: list[tuple[PyTree, Optional[dict]]] = []
         self._depth = depth
+        self._state: Optional[dict] = (
+            iterator.get_state() if hasattr(iterator, "get_state") else None
+        )
         self._fill()
 
     def _fill(self) -> None:
@@ -132,7 +142,12 @@ class DevicePrefetcher:
                 batch = next(self._it)
             except StopIteration:
                 return
-            self._buf.append(self._shard(self._mesh, batch))
+            state = (
+                self._source.get_state()
+                if hasattr(self._source, "get_state")
+                else None
+            )
+            self._buf.append((self._shard(self._mesh, batch), state))
 
     def __iter__(self) -> Iterator[PyTree]:
         return self
@@ -140,6 +155,11 @@ class DevicePrefetcher:
     def __next__(self) -> PyTree:
         if not self._buf:
             raise StopIteration
-        out = self._buf.pop(0)
+        out, state = self._buf.pop(0)
+        self._state = state
         self._fill()
         return out
+
+    def get_state(self) -> Optional[dict]:
+        """Producer state as of the last batch the consumer received."""
+        return self._state

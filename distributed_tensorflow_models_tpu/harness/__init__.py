@@ -1,0 +1,23 @@
+"""Training harness: configs, hooks, checkpointing, train/eval drivers.
+
+This package is the L5/L2 replacement (SURVEY.md §1): what the reference
+spreads across per-model driver scripts, ``MonitoredTrainingSession`` hook
+orchestration (F7/F13), ``Supervisor``/``SessionManager`` bootstrap (F8/F9),
+and ``Saver`` checkpointing (F12) collapses into:
+
+- :mod:`config` — one dataclass per reference config [B:6-12];
+- :mod:`hooks` — step-callback hooks with the reference's metric names and
+  cadences (steps/sec counter, NaN guard, checkpoint/log cadence);
+- :mod:`checkpoint` — orbax-backed save/restore of the full training state
+  *including input-pipeline position*;
+- :mod:`train` — the generic restore-or-init + train-loop driver;
+- :mod:`evaluate` — eval loops restoring EMA shadows (SURVEY.md §3.5);
+- :mod:`cli` — the command-line entry point replacing the reference's
+  per-model ``main()``s and launch scripts (L6).
+"""
+
+from distributed_tensorflow_models_tpu.harness.config import (  # noqa: F401
+    ExperimentConfig,
+    get_config,
+    list_configs,
+)

@@ -1,0 +1,128 @@
+"""Checkpoint save/restore: the Saver/SessionManager replacement.
+
+Reference semantics being reproduced (SURVEY.md §2.2 F12, §5.4):
+``tf.train.Saver`` writes ``model.ckpt-N`` keeping the last k, a
+CheckpointSaverHook fires every 600 s, and ``SessionManager.prepare_session``
+decides restore-vs-init at startup.  Improvements the TPU stack makes
+natural: checkpoints are *atomic pytree snapshots* (no partial-variable
+states), saves are async (orbax writes in the background while training
+continues), and the **input-pipeline position is checkpointed too** — the
+reference's queues lose their position on restart (SURVEY.md §5.4 gap).
+
+What is saved per step: the array leaves of :class:`TrainState`
+(step/params/batch_stats/opt_state/ema_params/carry) plus a JSON blob with
+the dataset iterator state.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+
+log = logging.getLogger("dtm")
+
+PyTree = Any
+
+
+def _array_tree(state: TrainState) -> dict:
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "ema_params": state.ema_params,
+        "carry": state.carry,
+    }
+
+
+class CheckpointManager:
+    """keep-last-k, async, atomic checkpoints under ``workdir/checkpoints``."""
+
+    def __init__(self, workdir: str, keep: int = 5):
+        self._mgr = ocp.CheckpointManager(
+            f"{workdir}/checkpoints",
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True
+            ),
+        )
+
+    def save(
+        self,
+        state: TrainState,
+        dataset_state: Optional[dict] = None,
+        *,
+        force: bool = False,
+    ) -> bool:
+        step = int(state.step)
+        saved = self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(_array_tree(state)),
+                data=ocp.args.JsonSave(dataset_state or {}),
+            ),
+            force=force,
+        )
+        if saved:
+            log.info("saved checkpoint at step %d", step)
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self, template: TrainState, step: Optional[int] = None
+    ) -> tuple[TrainState, dict]:
+        """Restore into the structure of ``template`` (a freshly-created
+        state — supplies static fields and the pytree layout).  Returns the
+        restored state and the dataset iterator state dict."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        abstract = jax.tree.map(
+            ocp.utils.to_shape_dtype_struct, _array_tree(template)
+        )
+        out = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                data=ocp.args.JsonRestore(),
+            ),
+        )
+        tree = out.state
+        state = template.replace(
+            step=tree["step"],
+            params=tree["params"],
+            batch_stats=tree["batch_stats"],
+            opt_state=tree["opt_state"],
+            ema_params=tree["ema_params"],
+            carry=tree["carry"],
+        )
+        return state, dict(out.data or {})
+
+    def wait(self) -> None:
+        """Block until pending async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def restore_or_init(
+    manager: CheckpointManager, template: TrainState
+) -> tuple[TrainState, dict, bool]:
+    """``SessionManager.prepare_session`` semantics (TF
+    session_manager.py:259): restore the latest checkpoint when one exists,
+    otherwise return the fresh ``template``.  Returns
+    ``(state, dataset_state, restored)``."""
+    if manager.latest_step() is None:
+        return template, {}, False
+    state, data = manager.restore(template)
+    log.info("restored checkpoint at step %d", int(state.step))
+    return state, data, True

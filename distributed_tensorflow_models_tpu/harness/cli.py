@@ -1,0 +1,112 @@
+"""Command-line entry point: train / eval / list for every config.
+
+The L6+L5 replacement (SURVEY.md §1): the reference launches each model
+with a shell script exporting host lists and ``--job_name/--task_index``
+flags into a per-model ``main()``.  Here one CLI covers the zoo, and there
+is no job/task topology to configure — multi-host SPMD needs only
+``--multihost`` (coordinator autodetected on managed TPU slices, SURVEY.md
+§5.8).
+
+    python -m distributed_tensorflow_models_tpu.harness.cli train \\
+        --config lenet_mnist --workdir /tmp/lenet --train-steps 2000
+    python -m distributed_tensorflow_models_tpu.harness.cli eval \\
+        --config lenet_mnist --workdir /tmp/lenet
+    python -m distributed_tensorflow_models_tpu.harness.cli list
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", required=True, help="config name (see `list`)")
+    p.add_argument("--workdir", required=True, help="checkpoint/metrics dir")
+    p.add_argument("--train-steps", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument(
+        "--mesh-model", type=int, default=None,
+        help="tensor-parallel axis size (default 1)",
+    )
+    p.add_argument(
+        "--multihost", action="store_true",
+        help="initialize jax.distributed (multi-host SPMD)",
+    )
+
+
+def _overrides(args) -> dict:
+    out = {}
+    if args.train_steps is not None:
+        out["train_steps"] = args.train_steps
+    if args.batch_size is not None:
+        out["global_batch_size"] = args.batch_size
+    if args.seed is not None:
+        out["seed"] = args.seed
+    if args.mesh_model is not None:
+        out["mesh_model"] = args.mesh_model
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    parser = argparse.ArgumentParser(prog="dtm")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_train = sub.add_parser("train", help="train a config (auto-resumes)")
+    _add_common(p_train)
+    p_eval = sub.add_parser("eval", help="evaluate the latest checkpoint")
+    _add_common(p_eval)
+    p_eval.add_argument(
+        "--continuous", action="store_true",
+        help="re-evaluate as new checkpoints appear",
+    )
+    p_eval.add_argument("--max-batches", type=int, default=None)
+    sub.add_parser("list", help="list available configs")
+    args = parser.parse_args(argv)
+
+    from distributed_tensorflow_models_tpu.harness.config import (
+        get_config,
+        list_configs,
+    )
+
+    if args.cmd == "list":
+        for name in list_configs():
+            print(name)
+        return 0
+
+    if args.multihost:
+        from distributed_tensorflow_models_tpu.core import mesh as meshlib
+
+        meshlib.initialize_multihost()
+
+    cfg = get_config(args.config, **_overrides(args))
+
+    if args.cmd == "train":
+        from distributed_tensorflow_models_tpu.harness import train as trainlib
+
+        result = trainlib.fit(cfg, args.workdir)
+        print(json.dumps({"final_metrics": result.final_metrics}))
+        return 0
+
+    from distributed_tensorflow_models_tpu.harness import evaluate as evallib
+
+    if args.continuous:
+        for res in evallib.continuous_eval(
+            cfg, args.workdir, max_batches=args.max_batches
+        ):
+            print(json.dumps({"step": res.step, **res.metrics}))
+        return 0
+    fn = evallib.evaluate_lm if cfg.task == "lm" else evallib.evaluate_classification
+    res = fn(cfg, args.workdir, max_batches=args.max_batches)
+    print(json.dumps({"step": res.step, **res.metrics}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,269 @@
+"""Experiment configs: one dataclass per reference training configuration.
+
+Replaces the reference's per-driver ``tf.app.flags`` blocks (SURVEY.md §5.6)
+with typed dataclasses.  The registry names correspond to BASELINE.json's
+config list [B:6-12]: MNIST LeNet, CIFAR-10 ResNet-32 sync-DP, ImageNet
+Inception-v3, ImageNet ResNet-50 (the async-vs-sync A/B model), and the PTB
+LSTM small/medium/large family.
+
+Hyperparameters follow the reference lineage (TF tutorials / slim defaults):
+e.g. Inception-v3's RMSProp(decay=0.9, momentum=0.9, eps=1.0), lr 0.045
+decayed 0.94 every 2 epochs, label smoothing 0.1, aux-loss weight 0.4, EMA
+0.9999 (SURVEY.md §2.1 R5); PTB's staged-LR SGD + global-norm clipping (R8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import optax
+
+from distributed_tensorflow_models_tpu.ops import optim
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "sgd"  # sgd | momentum | rmsprop | adam
+    learning_rate: float = 0.1
+    # LR schedule: exponential decay (staircase) as in the reference
+    # (TF learning_rate_decay, SURVEY.md §2.2 F16); None = constant.
+    decay_steps: Optional[int] = None
+    decay_rate: float = 0.94
+    staircase: bool = True
+    momentum: float = 0.9
+    rmsprop_decay: float = 0.9
+    rmsprop_epsilon: float = 1.0
+    # Global-norm gradient clipping (PTB path, TF clip_ops.py:300).
+    clip_global_norm: Optional[float] = None
+    # Zaremba staged schedule (PTB): constant for ``hold_epochs`` epochs of
+    # ``steps_per_epoch`` steps, then x ``decay_rate`` per epoch.  When set,
+    # takes precedence over the exponential fields.
+    steps_per_epoch: Optional[int] = None
+    hold_epochs: Optional[int] = None
+
+    def schedule(self) -> float | optax.Schedule:
+        if self.steps_per_epoch is not None and self.hold_epochs is not None:
+            return optim.zaremba_decay(
+                self.learning_rate,
+                self.steps_per_epoch,
+                self.hold_epochs,
+                self.decay_rate,
+            )
+        if self.decay_steps is None:
+            return self.learning_rate
+        return optim.exponential_decay(
+            self.learning_rate,
+            self.decay_steps,
+            self.decay_rate,
+            staircase=self.staircase,
+        )
+
+    def make(self) -> optax.GradientTransformation:
+        lr = self.schedule()
+        if self.name == "sgd":
+            tx = optim.sgd(lr)
+        elif self.name == "momentum":
+            tx = optim.tf_momentum(lr, self.momentum)
+        elif self.name == "rmsprop":
+            tx = optim.tf_rmsprop(
+                lr,
+                decay=self.rmsprop_decay,
+                momentum=self.momentum,
+                epsilon=self.rmsprop_epsilon,
+            )
+        elif self.name == "adam":
+            tx = optim.adam(lr)
+        else:
+            raise ValueError(f"unknown optimizer {self.name!r}")
+        if self.clip_global_norm is not None:
+            tx = optax.chain(
+                optim.clip_by_global_norm(self.clip_global_norm), tx
+            )
+        return tx
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """Everything one training run needs.  ``task`` selects the driver
+    wiring: ``classification`` or ``lm``."""
+
+    name: str
+    model: str
+    task: str = "classification"
+    model_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    dataset: str = "mnist"  # mnist|cifar10|imagenet|imagenet_synthetic|ptb
+    image_size: int = 28
+    global_batch_size: int = 256
+    optimizer: OptimizerConfig = dataclasses.field(
+        default_factory=OptimizerConfig
+    )
+    # Loss shaping (Inception path, SURVEY.md §7.4.2).
+    label_smoothing: float = 0.0
+    weight_decay: float = 0.0
+    aux_loss_weight: float = 0.0
+    # EMA of weights for eval (TF moving_averages.py:284; None = off).
+    ema_decay: Optional[float] = None
+    # LM settings (R8).
+    num_steps: int = 35
+    vocab_size: int = 10000
+    # Loop control (reference cadences: summaries/logs every 100 steps,
+    # checkpoint every 600 s — TF monitored_session.py:517-532).
+    train_steps: int = 1000
+    log_every_steps: int = 100
+    checkpoint_every_secs: float = 600.0
+    keep_checkpoints: int = 5
+    eval_every_steps: Optional[int] = None
+    eval_batches: Optional[int] = None
+    seed: int = 0
+    # Mesh axis sizes; -1 absorbs remaining devices (data axis).
+    mesh_data: int = -1
+    mesh_model: int = 1
+
+    def replace(self, **kw) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)
+
+
+_CONFIGS: dict[str, ExperimentConfig] = {}
+
+
+def _add(cfg: ExperimentConfig) -> ExperimentConfig:
+    _CONFIGS[cfg.name] = cfg
+    return cfg
+
+
+# --- MNIST LeNet [B:7] — the single-worker reference config. -------------
+_add(
+    ExperimentConfig(
+        name="lenet_mnist",
+        model="lenet",
+        dataset="mnist",
+        image_size=28,
+        global_batch_size=64,
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+        train_steps=2000,
+    )
+)
+
+# --- CIFAR-10 ResNet-32 sync-replica DP [B:8]. ---------------------------
+_add(
+    ExperimentConfig(
+        name="resnet32_cifar10",
+        model="resnet32_cifar",
+        dataset="cifar10",
+        image_size=32,
+        global_batch_size=128,
+        optimizer=OptimizerConfig(
+            name="momentum",
+            learning_rate=0.1,
+            momentum=0.9,
+            decay_steps=20000,
+            decay_rate=0.1,
+        ),
+        weight_decay=2e-4,
+        train_steps=64000,
+    )
+)
+
+# --- ImageNet Inception-v3 (slim) [B:9]. ---------------------------------
+_add(
+    ExperimentConfig(
+        name="inception_v3_imagenet",
+        model="inception_v3",
+        dataset="imagenet",
+        image_size=299,
+        global_batch_size=256,
+        optimizer=OptimizerConfig(
+            name="rmsprop",
+            learning_rate=0.045,
+            rmsprop_decay=0.9,
+            momentum=0.9,
+            rmsprop_epsilon=1.0,
+            # 0.94 decay every 2 epochs (epoch ~= 1.28M/256 = 5005 steps).
+            decay_steps=10010,
+            decay_rate=0.94,
+        ),
+        label_smoothing=0.1,
+        aux_loss_weight=0.4,
+        weight_decay=4e-5,
+        ema_decay=0.9999,
+        train_steps=500_000,
+    )
+)
+
+# --- ImageNet ResNet-50 — the async-PS vs sync A/B model [B:10]. ---------
+_add(
+    ExperimentConfig(
+        name="resnet50_imagenet",
+        model="resnet50",
+        dataset="imagenet",
+        image_size=224,
+        global_batch_size=256,
+        optimizer=OptimizerConfig(
+            name="momentum",
+            learning_rate=0.1,
+            momentum=0.9,
+            decay_steps=150_000,  # ~30 epochs, staircase x0.1
+            decay_rate=0.1,
+        ),
+        weight_decay=1e-4,
+        train_steps=450_000,
+    )
+)
+
+# --- Synthetic-input ResNet-50 (throughput benchmarking). ----------------
+_add(
+    ExperimentConfig(
+        name="resnet50_synthetic",
+        model="resnet50",
+        dataset="imagenet_synthetic",
+        image_size=224,
+        global_batch_size=256,
+        optimizer=OptimizerConfig(name="momentum", learning_rate=0.1),
+        weight_decay=1e-4,
+        train_steps=100,
+    )
+)
+
+# --- PTB LSTM family [B:11] — Zaremba staged-LR SGD + grad clipping. -----
+# Per-size (lr_decay, clip, hold_epochs "max_epoch", total epochs
+# "max_max_epoch") exactly as the reference's small/medium/large configs.
+# One epoch of the real PTB train split at batch 20 x num_steps ≈ 1327
+# batches (20-step) / 1327·20/35 ≈ 758 (35-step).
+for _size, _lr_decay, _clip, _hold, _total, _nsteps in (
+    ("small", 0.5, 5.0, 4, 13, 20),
+    ("medium", 0.8, 5.0, 6, 39, 35),
+    ("large", 1 / 1.15, 10.0, 14, 55, 35),
+):
+    _spe = 929_589 // (20 * _nsteps)  # PTB train tokens / (batch*unroll)
+    _add(
+        ExperimentConfig(
+            name=f"ptb_{_size}",
+            model="ptb_lstm",
+            task="lm",
+            model_kwargs={"config": _size},
+            dataset="ptb",
+            global_batch_size=20,
+            num_steps=_nsteps,
+            optimizer=OptimizerConfig(
+                name="sgd",
+                learning_rate=1.0,
+                decay_rate=_lr_decay,
+                steps_per_epoch=_spe,
+                hold_epochs=_hold,
+                clip_global_norm=_clip,
+            ),
+            train_steps=_spe * _total,
+        )
+    )
+
+
+def get_config(name: str, **overrides) -> ExperimentConfig:
+    if name not in _CONFIGS:
+        raise KeyError(f"unknown config {name!r}; have {sorted(_CONFIGS)}")
+    cfg = _CONFIGS[name]
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def list_configs() -> list[str]:
+    return sorted(_CONFIGS)

@@ -1,0 +1,210 @@
+"""Training hooks: the reference's session-hook set, step-callback style.
+
+The reference orchestrates its train loop through ``SessionRunHook``s
+(SURVEY.md §2.2 F13; TF basic_session_run_hooks.py): StepCounterHook
+(steps/sec), NanTensorHook, StopAtStepHook, LoggingTensorHook,
+SummarySaverHook, CheckpointSaverHook.  Here the loop is a plain Python
+``for`` over a compiled step, so hooks are simple objects with
+``begin/after_step/end`` callbacks — same capabilities, same metric names
+and cadences, no graph machinery.
+
+Metric readback note: ``after_step`` receives the *device* metrics dict;
+hooks that need host floats call ``float(...)`` themselves, and only on the
+steps where they fire, so the hot loop never forces a sync on quiet steps.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+
+log = logging.getLogger("dtm")
+
+Metrics = Mapping[str, Any]
+
+
+class Hook:
+    def begin(self, state) -> None: ...
+
+    def after_step(self, state, metrics: Metrics, step: int) -> None: ...
+
+    def end(self, state) -> None: ...
+
+
+class StopRequested(Exception):
+    """Raised by hooks to end training (StopAtStepHook's mechanism)."""
+
+
+class StopAtStepHook(Hook):
+    """Stop after ``last_step`` (TF basic_session_run_hooks.py:393)."""
+
+    def __init__(self, last_step: int):
+        self._last = last_step
+
+    def after_step(self, state, metrics, step):
+        if step >= self._last:
+            raise StopRequested
+
+
+class StepCounterHook(Hook):
+    """steps/sec (and examples/sec) every ``every_steps`` — the reference's
+    throughput meter (TF basic_session_run_hooks.py:674)."""
+
+    def __init__(self, every_steps: int = 100, batch_size: Optional[int] = None):
+        self._every = every_steps
+        self._batch = batch_size
+        self._t0 = None
+        self._s0 = 0
+        self.last_steps_per_sec: Optional[float] = None
+
+    def begin(self, state):
+        self._t0 = time.perf_counter()
+        self._s0 = int(state.step)
+
+    def after_step(self, state, metrics, step):
+        if step % self._every:
+            return
+        now = time.perf_counter()
+        dt = now - self._t0
+        if dt <= 0:
+            return
+        sps = (step - self._s0) / dt
+        self.last_steps_per_sec = sps
+        msg = f"step {step}: {sps:.2f} steps/sec"
+        if self._batch:
+            msg += f", {sps * self._batch:.1f} examples/sec"
+        log.info(msg)
+        self._t0, self._s0 = now, step
+
+
+class NanGuardHook(Hook):
+    """Abort on non-finite loss (NanTensorHook, TF
+    basic_session_run_hooks.py:761).  Checks every ``every_steps`` to avoid
+    forcing a device sync each step."""
+
+    def __init__(self, every_steps: int = 100, key: str = "loss"):
+        self._every = every_steps
+        self._key = key
+
+    def after_step(self, state, metrics, step):
+        if step % self._every:
+            return
+        value = float(metrics[self._key])
+        if not np.isfinite(value):
+            raise FloatingPointError(
+                f"{self._key} is {value} at step {step}"
+            )
+
+
+class LoggingHook(Hook):
+    """Log scalar metrics every N steps (LoggingTensorHook :169)."""
+
+    def __init__(self, every_steps: int = 100, keys: Optional[Sequence[str]] = None):
+        self._every = every_steps
+        self._keys = keys
+
+    def after_step(self, state, metrics, step):
+        if step % self._every:
+            return
+        keys = self._keys or sorted(metrics)
+        parts = []
+        for k in keys:
+            v = metrics.get(k)
+            if v is not None:
+                parts.append(f"{k}={float(v):.4f}")
+        log.info("step %d: %s", step, ", ".join(parts))
+
+
+class MetricWriterHook(Hook):
+    """Append scalar metrics to ``<workdir>/metrics.jsonl`` every N steps —
+    the SummarySaverHook role (TF monitored_session.py:585-590) with a
+    dependency-free format (one JSON object per line, TensorBoard-convertible)."""
+
+    def __init__(self, workdir: str, every_steps: int = 100):
+        self._path = os.path.join(workdir, "metrics.jsonl")
+        self._every = every_steps
+        os.makedirs(workdir, exist_ok=True)
+
+    def after_step(self, state, metrics, step):
+        if step % self._every:
+            return
+        row = {"step": step, "time": time.time()}
+        for k, v in metrics.items():
+            try:
+                row[k] = float(v)
+            except (TypeError, ValueError):
+                continue
+        with open(self._path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+
+class CheckpointHook(Hook):
+    """Save every ``every_secs`` (default 600 s, the reference's
+    CheckpointSaverHook default — TF monitored_session.py:525-528) and at
+    ``end``.  ``save_fn(state, step)`` is provided by the driver so the hook
+    stays agnostic of checkpoint layout."""
+
+    def __init__(self, save_fn, every_secs: float = 600.0,
+                 every_steps: Optional[int] = None):
+        self._save = save_fn
+        self._every_secs = every_secs
+        self._every_steps = every_steps
+        self._last_time = time.time()
+
+    def after_step(self, state, metrics, step):
+        due_time = (
+            self._every_secs is not None
+            and time.time() - self._last_time >= self._every_secs
+        )
+        due_step = self._every_steps and step % self._every_steps == 0
+        if due_time or due_step:
+            self._save(state, step)
+            self._last_time = time.time()
+
+    def end(self, state):
+        self._save(state, int(state.step))
+
+
+class ProfilerHook(Hook):
+    """Capture an XLA/TPU trace for steps [start, stop) into
+    ``<workdir>/profile`` — the Timeline/FULL_TRACE replacement (SURVEY.md
+    §5.1; TF client/timeline.py:410 → ``jax.profiler``)."""
+
+    def __init__(self, workdir: str, start_step: int, stop_step: int):
+        self._dir = os.path.join(workdir, "profile")
+        self._start = start_step
+        self._stop = stop_step
+        self._active = False
+
+    def after_step(self, state, metrics, step):
+        if step == self._start and not self._active:
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+        elif step >= self._stop and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def end(self, state):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+def run_hooks_after_step(hooks: Sequence[Hook], state, metrics, step) -> bool:
+    """Returns False when a hook requested stop.  Every hook runs every
+    step — a StopRequested from one hook must not starve later hooks of the
+    final step's metrics (logging/metric-writer/checkpoint all fire on the
+    stop step before the loop exits)."""
+    stop = False
+    for h in hooks:
+        try:
+            h.after_step(state, metrics, step)
+        except StopRequested:
+            stop = True
+    return not stop

@@ -1,0 +1,203 @@
+"""Generic training driver: restore-or-init, hook orchestration, auto-resume.
+
+This is the worker ``main()`` of every reference driver collapsed into one
+function (SURVEY.md §3.1): where the reference builds a ClusterSpec/Server,
+wraps graph construction in ``replica_device_setter``, and loops
+``mon_sess.run(train_op)`` under MonitoredTrainingSession's hooks, this
+driver builds the mesh, places the state, compiles the step, and loops over
+the host pipeline — identical capabilities, one SPMD program.
+
+Fault recovery (SURVEY.md §5.3): the reference wraps sessions in
+``_RecoverableSession`` which recreates a session after preemption and
+restarts from the last checkpoint (TF monitored_session.py:1261-1274).  On
+TPU the process dies with its slice, so the equivalent is *auto-resume*:
+rerunning the same command restores the latest checkpoint — including the
+input-pipeline position — and continues.  ``fit`` is therefore idempotent
+under kill/restart, which the integration test exercises.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_models_tpu.core import mesh as meshlib
+from distributed_tensorflow_models_tpu.core import train_loop
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.data import datasets as datalib
+from distributed_tensorflow_models_tpu.data import pipeline as pipelib
+from distributed_tensorflow_models_tpu.harness import checkpoint as ckptlib
+from distributed_tensorflow_models_tpu.harness import hooks as hooklib
+from distributed_tensorflow_models_tpu.harness.config import ExperimentConfig
+from distributed_tensorflow_models_tpu.models import get_model
+
+log = logging.getLogger("dtm")
+
+
+def build_dataset(cfg: ExperimentConfig, split: str = "train"):
+    """Dataset factory keyed by config (the L3 wiring of each driver)."""
+    if cfg.dataset == "mnist":
+        return datalib.mnist_dataset(cfg.global_batch_size, split, cfg.seed)
+    if cfg.dataset == "cifar10":
+        return datalib.cifar10_dataset(cfg.global_batch_size, split, cfg.seed)
+    if cfg.dataset == "imagenet_synthetic":
+        return datalib.synthetic_imagenet_dataset(
+            cfg.global_batch_size, cfg.image_size, cfg.seed
+        )
+    if cfg.dataset == "imagenet":
+        import glob
+        import os
+
+        pattern = os.path.join(
+            datalib.DATA_DIR,
+            "imagenet",
+            "train-*" if split == "train" else "validation-*",
+        )
+        paths = sorted(glob.glob(pattern))
+        if not paths:
+            log.warning(
+                "no ImageNet shards under %s; using synthetic data", pattern
+            )
+            return datalib.synthetic_imagenet_dataset(
+                cfg.global_batch_size, cfg.image_size, cfg.seed
+            )
+        return datalib.ImageNetTFRecordDataset(
+            paths,
+            cfg.global_batch_size,
+            train=split == "train",
+            image_size=cfg.image_size,
+            seed=cfg.seed,
+            label_offset=1,
+        )
+    if cfg.dataset == "ptb":
+        return datalib.ptb_dataset(
+            cfg.global_batch_size, cfg.num_steps, split, cfg.vocab_size
+        )
+    raise ValueError(f"unknown dataset {cfg.dataset!r}")
+
+
+def build_state(cfg: ExperimentConfig, mesh) -> TrainState:
+    model = get_model(cfg.model, **cfg.model_kwargs)
+    tx = cfg.optimizer.make()
+    if cfg.task == "lm":
+        sample = jnp.zeros(
+            (2, cfg.num_steps), jnp.int32
+        )
+        carry = model.initial_carry(cfg.global_batch_size)
+        state = TrainState.create(
+            model,
+            tx,
+            jax.random.key(cfg.seed),
+            sample,
+            ema_decay=cfg.ema_decay,
+            carry=carry,
+        )
+    else:
+        sample = jnp.zeros(
+            (2, cfg.image_size, cfg.image_size, 3 if cfg.image_size > 28 else 1),
+            jnp.float32,
+        )
+        if cfg.model == "lenet":
+            sample = jnp.zeros((2, 28, 28, 1), jnp.float32)
+        state = TrainState.create(
+            model, tx, jax.random.key(cfg.seed), sample, ema_decay=cfg.ema_decay
+        )
+    return train_loop.place_state(state, mesh)
+
+
+def build_step(cfg: ExperimentConfig, state: TrainState):
+    if cfg.task == "lm":
+        loss_fn = train_loop.lm_loss_fn(state.apply_fn)
+    else:
+        loss_fn = train_loop.classification_loss_fn(
+            state.apply_fn,
+            label_smoothing=cfg.label_smoothing,
+            weight_decay=cfg.weight_decay,
+            aux_loss_weight=cfg.aux_loss_weight,
+        )
+    return train_loop.make_train_step(loss_fn)
+
+
+@dataclasses.dataclass
+class FitResult:
+    state: TrainState
+    final_metrics: dict
+    steps_run: int
+
+
+def fit(
+    cfg: ExperimentConfig,
+    workdir: str,
+    *,
+    extra_hooks: Sequence[hooklib.Hook] = (),
+    mesh: Optional[object] = None,
+) -> FitResult:
+    """Train ``cfg`` to ``cfg.train_steps``, resuming from ``workdir`` if a
+    checkpoint exists.  Returns the final (host-fetched) state."""
+    if mesh is None:
+        mesh = meshlib.create_mesh(
+            meshlib.MeshSpec(data=cfg.mesh_data, model=cfg.mesh_model)
+        )
+    state = build_state(cfg, mesh)
+    manager = ckptlib.CheckpointManager(workdir, keep=cfg.keep_checkpoints)
+    state, data_state, restored = ckptlib.restore_or_init(manager, state)
+    if restored:
+        # Restored arrays arrive with default placement; re-lay them out on
+        # the mesh exactly as the fresh template was.
+        state = train_loop.place_state(state, mesh)
+
+    dataset = build_dataset(cfg, "train")
+    if restored and data_state.get("dataset") and hasattr(dataset, "set_state"):
+        dataset.set_state(data_state["dataset"])
+
+    host = pipelib.HostPipeline(dataset, prefetch=4)
+    device_it = pipelib.DevicePrefetcher(host, mesh, depth=2)
+    step_fn = build_step(cfg, state)
+
+    def save_fn(s, _step):
+        # Use the *device prefetcher's* view of the dataset position — it
+        # lags the host pipeline by the prefetch depth and reflects exactly
+        # the batches the train loop has consumed, so resume never skips.
+        manager.save(s, {"dataset": device_it.get_state()})
+
+    all_hooks: list[hooklib.Hook] = [
+        hooklib.StopAtStepHook(cfg.train_steps),
+        hooklib.StepCounterHook(
+            cfg.log_every_steps, cfg.global_batch_size
+        ),
+        hooklib.NanGuardHook(cfg.log_every_steps),
+        hooklib.LoggingHook(cfg.log_every_steps, keys=("loss",)),
+        hooklib.MetricWriterHook(workdir, cfg.log_every_steps),
+        hooklib.CheckpointHook(
+            save_fn, every_secs=cfg.checkpoint_every_secs
+        ),
+        *extra_hooks,
+    ]
+
+    rng = jax.random.key(cfg.seed + 1)
+    for h in all_hooks:
+        h.begin(state)
+
+    metrics = {}
+    steps_run = 0
+    step = int(state.step)
+    try:
+        while step < cfg.train_steps:
+            batch = next(device_it)
+            state, metrics = step_fn(state, batch, rng)
+            step += 1
+            steps_run += 1
+            if not hooklib.run_hooks_after_step(all_hooks, state, metrics, step):
+                break
+    finally:
+        for h in all_hooks:
+            h.end(state)
+        host.stop()
+        manager.close()
+
+    host_metrics = {k: float(v) for k, v in metrics.items()}
+    return FitResult(state=state, final_metrics=host_metrics, steps_run=steps_run)

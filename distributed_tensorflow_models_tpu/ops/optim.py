@@ -161,6 +161,27 @@ def exponential_decay(
     )
 
 
+def zaremba_decay(
+    initial_lr: float,
+    steps_per_epoch: int,
+    hold_epochs: int,
+    decay_rate: float,
+) -> optax.Schedule:
+    """The PTB staged schedule (SURVEY.md §2.1 R8, Zaremba et al.):
+    constant for the first ``hold_epochs`` epochs, then multiplied by
+    ``decay_rate`` once per epoch —
+    ``lr * decay_rate ** max(0, epoch + 1 - hold_epochs)`` with
+    ``epoch = step // steps_per_epoch`` (the reference reassigns the LR
+    variable at each epoch boundary with exactly this exponent)."""
+
+    def schedule(count):
+        epoch = count // steps_per_epoch
+        exponent = jnp.maximum(0, epoch + 1 - hold_epochs)
+        return initial_lr * decay_rate ** exponent.astype(jnp.float32)
+
+    return schedule
+
+
 def piecewise_constant(
     boundaries: list[int], values: list[float]
 ) -> optax.Schedule:

@@ -1,0 +1,311 @@
+"""Harness tests: configs, hooks, checkpoint round-trip, fit with
+auto-resume (the reference's recovery semantics, SURVEY.md §5.3-5.4), and
+eval drivers."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu.core import train_loop
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.harness import (
+    checkpoint as ckptlib,
+    config as configlib,
+    evaluate as evallib,
+    hooks as hooklib,
+    train as trainlib,
+)
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.ops import optim
+
+
+# --------------------------------------------------------------------------
+# Configs
+# --------------------------------------------------------------------------
+
+
+def test_config_registry_complete():
+    names = configlib.list_configs()
+    # The BASELINE.json config list, one entry each [B:6-12].
+    for required in (
+        "lenet_mnist",
+        "resnet32_cifar10",
+        "inception_v3_imagenet",
+        "resnet50_imagenet",
+        "ptb_small",
+        "ptb_medium",
+        "ptb_large",
+    ):
+        assert required in names
+
+
+def test_config_optimizers_build():
+    for name in configlib.list_configs():
+        cfg = configlib.get_config(name)
+        tx = cfg.optimizer.make()
+        params = {"w": jnp.ones((3,))}
+        opt_state = tx.init(params)
+        updates, _ = tx.update({"w": jnp.ones((3,))}, opt_state, params)
+        assert jnp.all(jnp.isfinite(updates["w"]))
+
+
+def test_config_overrides():
+    cfg = configlib.get_config("lenet_mnist", train_steps=7, seed=3)
+    assert cfg.train_steps == 7 and cfg.seed == 3
+    with pytest.raises(KeyError):
+        configlib.get_config("nope")
+
+
+# --------------------------------------------------------------------------
+# Hooks
+# --------------------------------------------------------------------------
+
+
+class _FakeState:
+    step = jnp.asarray(0)
+
+
+def test_stop_at_step_hook():
+    hooks = [hooklib.StopAtStepHook(5)]
+    assert hooklib.run_hooks_after_step(hooks, _FakeState(), {}, 4)
+    assert not hooklib.run_hooks_after_step(hooks, _FakeState(), {}, 5)
+
+
+def test_nan_guard_hook():
+    h = hooklib.NanGuardHook(every_steps=2)
+    h.after_step(_FakeState(), {"loss": jnp.asarray(1.0)}, 2)
+    h.after_step(_FakeState(), {"loss": jnp.asarray(float("nan"))}, 3)  # off-cadence
+    with pytest.raises(FloatingPointError):
+        h.after_step(_FakeState(), {"loss": jnp.asarray(float("nan"))}, 4)
+
+
+def test_metric_writer_hook(tmp_path):
+    h = hooklib.MetricWriterHook(str(tmp_path), every_steps=2)
+    h.after_step(_FakeState(), {"loss": jnp.asarray(2.0)}, 1)  # skipped
+    h.after_step(_FakeState(), {"loss": jnp.asarray(1.5)}, 2)
+    h.after_step(_FakeState(), {"loss": jnp.asarray(1.0)}, 4)
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert [r["step"] for r in rows] == [2, 4]
+    assert rows[0]["loss"] == 1.5
+
+
+def test_checkpoint_hook_cadence():
+    saves = []
+    h = hooklib.CheckpointHook(
+        lambda s, step: saves.append(step), every_secs=None, every_steps=3
+    )
+    for step in range(1, 8):
+        h.after_step(_FakeState(), {}, step)
+    state = _FakeState()
+    state.step = jnp.asarray(7)
+    h.end(state)
+    assert saves == [3, 6, 7]
+
+
+def test_step_counter_hook():
+    h = hooklib.StepCounterHook(every_steps=2, batch_size=32)
+    state = _FakeState()
+    h.begin(state)
+    h.after_step(state, {}, 1)
+    h.after_step(state, {}, 2)
+    assert h.last_steps_per_sec is not None and h.last_steps_per_sec > 0
+
+
+# --------------------------------------------------------------------------
+# Checkpointing
+# --------------------------------------------------------------------------
+
+
+def _tiny_state(ema=False, carry=False):
+    model = get_model("lenet", num_classes=4)
+    tx = optim.tf_momentum(0.1, 0.9)
+    return TrainState.create(
+        model,
+        tx,
+        jax.random.key(0),
+        jnp.zeros((2, 28, 28, 1)),
+        ema_decay=0.99 if ema else None,
+        carry={"h": jnp.ones((2, 3))} if carry else None,
+    )
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    state = _tiny_state(ema=True, carry=True)
+    state = state.replace(step=jnp.asarray(12, jnp.int32))
+    mgr = ckptlib.CheckpointManager(str(tmp_path), keep=2)
+    assert mgr.save(state, {"dataset": {"epoch": 1, "batch_idx": 7}})
+    mgr.wait()
+
+    template = _tiny_state(ema=True, carry=True)
+    restored, data = mgr.restore(template)
+    mgr.close()
+    assert int(restored.step) == 12
+    assert data == {"dataset": {"epoch": 1, "batch_idx": 7}}
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        state.params,
+        restored.params,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        state.ema_params,
+        restored.ema_params,
+    )
+    np.testing.assert_allclose(restored.carry["h"], np.ones((2, 3)))
+
+
+def test_checkpoint_keep_k(tmp_path):
+    state = _tiny_state()
+    mgr = ckptlib.CheckpointManager(str(tmp_path), keep=2)
+    for s in (1, 2, 3):
+        mgr.save(state.replace(step=jnp.asarray(s, jnp.int32)))
+    mgr.wait()
+    assert mgr.latest_step() == 3
+    with pytest.raises(Exception):
+        mgr.restore(_tiny_state(), step=1)  # evicted by keep=2
+    mgr.close()
+
+
+def test_restore_or_init_fresh(tmp_path):
+    template = _tiny_state()
+    mgr = ckptlib.CheckpointManager(str(tmp_path), keep=1)
+    state, data, restored = ckptlib.restore_or_init(mgr, template)
+    assert not restored and state is template and data == {}
+    mgr.close()
+
+
+# --------------------------------------------------------------------------
+# fit / eval end-to-end on the fake mesh
+# --------------------------------------------------------------------------
+
+
+def _small_cfg(**kw):
+    base = dict(
+        train_steps=6,
+        global_batch_size=32,
+        log_every_steps=2,
+        checkpoint_every_secs=10_000.0,
+    )
+    base.update(kw)
+    return configlib.get_config("lenet_mnist", **base)
+
+
+def test_fit_runs_and_checkpoints(mesh8, tmp_path):
+    cfg = _small_cfg()
+    result = trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    assert result.steps_run == 6
+    assert int(result.state.step) == 6
+    assert np.isfinite(result.final_metrics["loss"])
+    assert os.path.exists(tmp_path / "metrics.jsonl")
+    # CheckpointHook.end saved the final state.
+    mgr = ckptlib.CheckpointManager(str(tmp_path))
+    assert mgr.latest_step() == 6
+    mgr.close()
+
+
+def test_fit_auto_resume(mesh8, tmp_path):
+    """Kill/restart semantics: a second fit picks up at the saved step and
+    the input pipeline position, finishing the remaining steps only."""
+    cfg = _small_cfg(train_steps=4)
+    trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+
+    cfg2 = _small_cfg(train_steps=8)
+    result = trainlib.fit(cfg2, str(tmp_path), mesh=mesh8)
+    assert result.steps_run == 4  # only the remaining 4
+    assert int(result.state.step) == 8
+
+    # And a third invocation with nothing to do runs zero steps.
+    result3 = trainlib.fit(cfg2, str(tmp_path), mesh=mesh8)
+    assert result3.steps_run == 0
+    assert int(result3.state.step) == 8
+
+
+def test_fit_then_eval_classification(mesh8, tmp_path):
+    cfg = _small_cfg(train_steps=20)
+    trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    res = evallib.evaluate_classification(
+        cfg, str(tmp_path), mesh=mesh8, max_batches=4
+    )
+    assert res.step == 20
+    assert 0.0 <= res.metrics["top1"] <= 1.0
+    assert res.metrics["top5"] >= res.metrics["top1"]
+    assert res.metrics["top1"] > 0.15  # better than chance after 20 steps
+
+
+def test_fit_lm_and_eval(mesh8, tmp_path):
+    cfg = configlib.get_config(
+        "ptb_small",
+        train_steps=4,
+        global_batch_size=16,
+        num_steps=8,
+        vocab_size=64,
+        model_kwargs={"config": "small", "hidden_size": 16, "vocab_size": 64},
+        log_every_steps=2,
+        checkpoint_every_secs=10_000.0,
+    )
+    result = trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    assert int(result.state.step) == 4
+    assert np.isfinite(result.final_metrics["loss"])
+    res = evallib.evaluate_lm(cfg, str(tmp_path), mesh=mesh8, max_batches=3)
+    assert res.metrics["perplexity"] > 1.0
+    assert np.isfinite(res.metrics["perplexity"])
+
+
+def test_zaremba_schedule():
+    sched = optim.zaremba_decay(1.0, steps_per_epoch=10, hold_epochs=4,
+                                decay_rate=0.5)
+    # Constant through the first 4 epochs (steps 0..39).
+    assert float(sched(0)) == 1.0
+    assert float(sched(39)) == 1.0
+    # Then halves each epoch: epoch 4 -> 0.5, epoch 5 -> 0.25 ...
+    assert float(sched(40)) == pytest.approx(0.5)
+    assert float(sched(49)) == pytest.approx(0.5)
+    assert float(sched(50)) == pytest.approx(0.25)
+
+
+def test_final_step_metrics_written(mesh8, tmp_path):
+    """The stop step's metrics must land in metrics.jsonl even though
+    StopAtStepHook fires on that same step."""
+    cfg = _small_cfg(train_steps=4, log_every_steps=2)
+    trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert rows[-1]["step"] == 4
+
+
+def test_device_prefetcher_state_tracks_consumed(mesh8):
+    """Checkpoointed dataset position reflects consumed batches, not the
+    prefetch buffer's read-ahead."""
+    from distributed_tensorflow_models_tpu.data import datasets, pipeline
+
+    x = np.arange(64, dtype=np.float32).reshape(64, 1)
+    y = np.arange(64, dtype=np.int32)
+    ds = datasets.ArrayDataset({"image": x, "label": y}, 8, seed=1)
+    pre = pipeline.DevicePrefetcher(ds, mesh8, depth=2)
+    consumed = [np.asarray(next(pre)["label"]) for _ in range(3)]
+    state = pre.get_state()
+    assert state == {"epoch": 0, "batch_idx": 3}
+
+    ds2 = datasets.ArrayDataset({"image": x, "label": y}, 8, seed=1)
+    ds2.set_state(state)
+    nxt = np.asarray(next(pre)["label"])  # 4th batch from original
+    resumed = next(iter(ds2))["label"]
+    np.testing.assert_array_equal(resumed, nxt)
+    assert not any(np.array_equal(resumed, c) for c in consumed)
+
+
+def test_cli_list_and_train(tmp_path, capsys):
+    from distributed_tensorflow_models_tpu.harness import cli
+
+    assert cli.main(["list"]) == 0
+    out = capsys.readouterr().out
+    assert "lenet_mnist" in out
