@@ -172,6 +172,49 @@ def make_train_step(
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
 
+def per_step_rngs(
+    rng: jax.Array, salt: jax.Array | int, rng_names: Sequence[str]
+) -> dict[str, jax.Array]:
+    """Derive the per-step named rng dict: ``fold_in`` the step (or event)
+    counter, then one fold per rng name.  Shared by the sync train step and
+    the async-PS emulator so their trajectories agree by construction."""
+    step_rng = jax.random.fold_in(rng, salt)
+    return {
+        name: jax.random.fold_in(step_rng, i)
+        for i, name in enumerate(rng_names)
+    }
+
+
+def apply_gradients(state: TrainState, grads: PyTree, aux: dict) -> TrainState:
+    """Optimizer update + state advance from one grad computation's output.
+
+    Consumes the full ``aux`` contract of :data:`LossFn` (``batch_stats``,
+    ``carry``) and maintains the EMA shadows — the single place where a
+    gradient becomes a new :class:`TrainState`, used by both the sync SPMD
+    step and the async-PS emulation (TF optimizer.py:656's
+    ``apply_gradients`` role)."""
+    updates, new_opt_state = state.tx.update(
+        grads, state.opt_state, state.params
+    )
+    new_params = optax.apply_updates(state.params, updates)
+    new_ema = state.ema_params
+    if state.ema_params is not None:
+        new_ema = emalib.update_ema(
+            state.ema_params,
+            new_params,
+            state.ema_decay,
+            num_updates=state.step,
+        )
+    return state.replace(
+        step=state.step + 1,
+        params=new_params,
+        batch_stats=aux.get("batch_stats", state.batch_stats),
+        opt_state=new_opt_state,
+        ema_params=new_ema,
+        carry=aux.get("carry", state.carry),
+    )
+
+
 def make_train_step_fn(
     loss_fn: LossFn,
     rng_names: Sequence[str] = ("dropout",),
@@ -181,39 +224,12 @@ def make_train_step_fn(
     (amortises host round-trips, lets XLA overlap across step boundaries)."""
 
     def step_fn(state: TrainState, batch: Batch, rng: jax.Array):
-        step_rng = jax.random.fold_in(rng, state.step)
-        rngs = {
-            name: jax.random.fold_in(step_rng, i)
-            for i, name in enumerate(rng_names)
-        }
+        rngs = per_step_rngs(rng, state.step, rng_names)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (_, aux), grads = grad_fn(state.params, state, batch, rngs)
-        metrics = aux.get("metrics", {})
-        new_batch_stats = aux.get("batch_stats", state.batch_stats)
-        new_carry = aux.get("carry", state.carry)
-        updates, new_opt_state = state.tx.update(
-            grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
-        new_ema = state.ema_params
-        if state.ema_params is not None:
-            new_ema = emalib.update_ema(
-                state.ema_params,
-                new_params,
-                state.ema_decay,
-                num_updates=state.step,
-            )
-        metrics = dict(metrics)
+        metrics = dict(aux.get("metrics", {}))
         metrics["grad_norm"] = optax.global_norm(grads)
-        new_state = state.replace(
-            step=state.step + 1,
-            params=new_params,
-            batch_stats=new_batch_stats,
-            opt_state=new_opt_state,
-            ema_params=new_ema,
-            carry=new_carry,
-        )
-        return new_state, metrics
+        return apply_gradients(state, grads, aux), metrics
 
     return step_fn
 

@@ -1,0 +1,18 @@
+"""Parallelism strategies beyond plain data parallelism.
+
+The reference's only strategies are async/sync data parallelism over a
+parameter-server topology (SURVEY.md §2.4).  This package carries both of
+those *capabilities* forward and adds the strategies a TPU-native framework
+is expected to provide on a named device mesh:
+
+- :mod:`.tensor` — tensor parallelism: sharding-rule sets over the ``model``
+  mesh axis (Megatron-style column/row splits, expressed declaratively; XLA
+  inserts the collectives).
+- :mod:`.async_ps` — emulation of the reference's asynchronous
+  parameter-server training (SURVEY.md §7.6) with deterministic replay and
+  staleness accounting, for the async-vs-sync A/B the reference was built
+  to run.
+"""
+
+from distributed_tensorflow_models_tpu.parallel import async_ps  # noqa: F401
+from distributed_tensorflow_models_tpu.parallel import tensor  # noqa: F401

@@ -1,0 +1,169 @@
+"""Asynchronous parameter-server emulation (SURVEY.md §7.6).
+
+The one reference behavior with no natural SPMD analogue: in async-PS mode
+each worker computes gradients against a *stale* parameter snapshot and
+applies them straight into PS variable memory with no coordination
+(SURVEY.md §3.3; TF optimizer.py:656 unlocked applies).  Convergence
+degrades with staleness; the reference's headline experiment is the
+async-vs-sync A/B on ResNet-50 (SURVEY.md §2.1 R6, BASELINE [B:10]).
+
+This module reproduces those *semantics* deterministically, above the
+compiled layer:
+
+- ``num_workers`` virtual workers each hold a parameter snapshot tagged
+  with the canonical step at fetch time.
+- A schedule (round-robin, or seeded-random for arrival-order jitter)
+  picks which worker acts at each event — the deterministic-replay knob.
+- The picked worker computes gradients at its snapshot (compiled step),
+  the coordinator applies them to the canonical state (compiled apply),
+  and the worker refetches.  ``staleness = canonical_step - snapshot_step``
+  is logged per event.
+- ``staleness_limit`` reproduces the ConditionalAccumulator's
+  stale-gradient *drop* (TF sync_replicas_optimizer.py:275-293 — grads
+  stamped with an old ``local_step`` are discarded); the reference's
+  accumulators drop, so dropped events still cost a fetch but no apply.
+
+With ``num_workers=1`` the trajectory is bit-identical to the sync train
+step on the same batches — the emulator's correctness anchor (tested).
+
+Steady-state staleness under round-robin is ``num_workers - 1``, exactly a
+K-worker PS where every worker pushes once per round.  BN moving statistics
+follow last-writer-wins, as PS-resident aux variables did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterable, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_models_tpu.core import train_loop
+from distributed_tensorflow_models_tpu.core.train_loop import LossFn
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+
+PyTree = Any
+Batch = Mapping[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncConfig:
+    """Emulation knobs.
+
+    ``schedule``: ``"round_robin"`` (steady staleness K-1) or ``"random"``
+    (seeded arrival-order jitter; same seed → same trajectory).
+    ``staleness_limit``: drop gradients older than this many canonical
+    steps (None = never drop; the reference default — plain async applies
+    have no staleness check, only SyncReplicas' accumulators do).
+    """
+
+    num_workers: int = 4
+    schedule: str = "round_robin"
+    seed: int = 0
+    staleness_limit: Optional[int] = None
+
+
+@dataclasses.dataclass
+class _Worker:
+    params: PyTree
+    version: int  # canonical step when this snapshot was fetched
+
+
+class AsyncPSEmulator:
+    """Event-driven async-PS trainer over a compiled grad/apply pair.
+
+    The canonical :class:`TrainState` plays the parameter servers' role
+    (single source of truth for params, optimizer slots, BN stats, step);
+    virtual workers play the reference's worker processes.
+    """
+
+    def __init__(
+        self,
+        state: TrainState,
+        loss_fn: LossFn,
+        config: AsyncConfig = AsyncConfig(),
+        rng_names: Sequence[str] = ("dropout",),
+    ):
+        if config.num_workers < 1:
+            raise ValueError("need at least one virtual worker")
+        self.config = config
+        self.state = state
+        self._rng_names = tuple(rng_names)
+        self.staleness_log: list[int] = []
+        self.dropped: int = 0
+        self._event = 0
+        self._sched_rng = np.random.RandomState(config.seed)
+        self.workers = [
+            _Worker(params=state.params, version=int(state.step))
+            for _ in range(config.num_workers)
+        ]
+
+        def grad_fn(params, state, batch, rng, event):
+            # Per-event keys via the sync step's own derivation
+            # (train_loop.per_step_rngs) so that num_workers=1 replays the
+            # sync trajectory exactly — parity by construction, not by
+            # copy-paste.
+            rngs = train_loop.per_step_rngs(rng, event, self._rng_names)
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, state, batch, rngs
+            )
+            return grads, aux
+
+        self._grad = jax.jit(grad_fn)
+        # Shared state-advance: optimizer update + batch_stats / carry / EMA
+        # threading, same code the sync step runs.
+        self._apply = jax.jit(train_loop.apply_gradients)
+
+    # -- schedule ----------------------------------------------------------
+    def _pick(self) -> int:
+        if self.config.schedule == "round_robin":
+            return self._event % self.config.num_workers
+        if self.config.schedule == "random":
+            return int(self._sched_rng.randint(self.config.num_workers))
+        raise ValueError(f"unknown schedule {self.config.schedule!r}")
+
+    # -- event loop --------------------------------------------------------
+    def step(self, batch: Batch, rng: jax.Array) -> dict:
+        """One async event: pick worker → grad at snapshot → apply → fetch.
+
+        Returns the event record (worker id, staleness, dropped flag,
+        metrics from the worker's forward pass).
+        """
+        widx = self._pick()
+        worker = self.workers[widx]
+        canonical_step = int(self.state.step)
+        staleness = canonical_step - worker.version
+
+        grads, aux = self._grad(
+            worker.params, self.state, batch, rng, self._event
+        )
+        dropped = (
+            self.config.staleness_limit is not None
+            and staleness > self.config.staleness_limit
+        )
+        if dropped:
+            self.dropped += 1
+        else:
+            self.state = self._apply(self.state, grads, aux)
+        # Fetch: worker adopts canonical params (the reference worker's
+        # variable read at the top of its next step, SURVEY.md §3.3).
+        self.workers[widx] = _Worker(
+            params=self.state.params, version=int(self.state.step)
+        )
+        self.staleness_log.append(staleness)
+        self._event += 1
+        return {
+            "worker": widx,
+            "staleness": staleness,
+            "dropped": dropped,
+            "metrics": aux.get("metrics", {}),
+        }
+
+    def run(self, batches: Iterable[Batch], rng: jax.Array) -> list[dict]:
+        """Replay a batch stream through the event loop."""
+        return [self.step(b, rng) for b in batches]
+
+    @property
+    def mean_staleness(self) -> float:
+        return float(np.mean(self.staleness_log)) if self.staleness_log else 0.0

@@ -1,0 +1,92 @@
+"""Tensor parallelism: declarative weight-sharding rule sets.
+
+The reference has no tensor parallelism (SURVEY.md §2.4 — variables are
+placed *whole* on PS tasks by ``replica_device_setter``, TF
+training/device_setter.py:128-223).  The TPU-native generalization shards
+*dimensions* of weight arrays over the ``model`` mesh axis and lets XLA's
+SPMD partitioner insert the collectives: a column-split matmul needs no
+communication on the forward pass; the following row-split matmul produces
+partial sums that XLA reduces with one ``psum`` over ICI — the Megatron
+split, expressed as ``PartitionSpec`` rules rather than hand-written
+collectives.
+
+Rules here compose with :func:`...core.sharding.tree_param_shardings`
+(first match wins) and are consumed by ``train_loop.place_state``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_models_tpu.core.mesh import AxisNames
+from distributed_tensorflow_models_tpu.core.sharding import ShardingRule
+
+
+def transformer_tp_rules() -> list[ShardingRule]:
+    """Megatron-style rules for :class:`...models.transformer_lm.TransformerLM`.
+
+    Column-parallel (output-dim split, no fwd communication): Q/K/V
+    projections and the MLP up-projection.  Row-parallel (input-dim split,
+    one psum after): attention output projection and MLP down-projection.
+    The embedding and LM head are split over the vocab/model dim.
+    """
+    M = AxisNames.MODEL
+    return [
+        (r"attn/(query|key|value)/kernel$", P(None, M)),
+        (r"attn/(query|key|value)/bias$", P(M)),
+        (r"attn/out/kernel$", P(M, None)),
+        (r"mlp/up/kernel$", P(None, M)),
+        (r"mlp/up/bias$", P(M)),
+        (r"mlp/down/kernel$", P(M, None)),
+        (r"embedding/embedding$", P(None, M)),
+        (r"head/kernel$", P(None, M)),
+        (r"head/bias$", P(M)),
+    ]
+
+
+def lstm_tp_rules() -> list[ShardingRule]:
+    """Rules for the PTB LSTM: gate matmuls are 4x-wide column splits
+    (the hidden dim concatenation of i/f/g/o gates), so output-dim sharding
+    over ``model`` splits every gate evenly."""
+    M = AxisNames.MODEL
+    return [
+        (r"lstm_\d+/(hi|hf|hg|ho|ii|if|ig|io)/kernel$", P(None, M)),
+        (r"embedding/embedding$", P(None, M)),
+        (r"head/kernel$", P(None, M)),
+        (r"head/bias$", P(M)),
+    ]
+
+
+def cnn_tp_rules() -> list[ShardingRule]:
+    """Rules for the CNN zoo: shard output channels of convolutions and the
+    dense head over ``model``.  Conv kernels are HWIO, so the split is on
+    the last (output-channel) dim; XLA turns the following conv's
+    input-channel contraction into a psum."""
+    M = AxisNames.MODEL
+    return [
+        (r"[Cc]onv[^/]*/kernel$", P(None, None, None, M)),
+        (r"[Cc]onv[^/]*/bias$", P(M)),
+        (r"head/kernel$", P(None, M)),
+        (r"head/bias$", P(M)),
+    ]
+
+
+def head_tp_rules() -> list[ShardingRule]:
+    """Classifier-head-only split — the minimum-communication TP layout
+    (re-exported from core.sharding for discoverability)."""
+    from distributed_tensorflow_models_tpu.core import sharding as shardlib
+
+    return shardlib.head_tensor_parallel_rules()
+
+
+def constrain(x: jax.Array, *axes: str | None) -> jax.Array:
+    """Pin an activation's sharding inside jitted code.
+
+    ``constrain(x, AxisNames.DATA, None, AxisNames.MODEL)`` marks the
+    batch dim data-sharded and the feature dim model-sharded; XLA's
+    propagation fills everything in between.  This is the activation-side
+    counterpart of the parameter rules, used to stop the partitioner from
+    choosing a replicated layout at subgraph boundaries.
+    """
+    return jax.lax.with_sharding_constraint(x, P(*axes))

@@ -1,0 +1,206 @@
+"""Tests for the parallel package: tensor-parallel rules and async-PS
+emulation (SURVEY.md §2.4, §7.6), on the 8-fake-CPU-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_models_tpu.core import mesh as meshlib
+from distributed_tensorflow_models_tpu.core import sharding as shardlib
+from distributed_tensorflow_models_tpu.core import train_loop
+from distributed_tensorflow_models_tpu.core.mesh import AxisNames
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.ops import optim
+from distributed_tensorflow_models_tpu.parallel import async_ps, tensor
+
+
+def _lenet_state(tx=None, seed=0):
+    model = get_model("lenet")
+    tx = tx or optim.sgd(0.1)
+    state = TrainState.create(
+        model, tx, jax.random.key(seed), jnp.zeros((2, 28, 28, 1))
+    )
+    return model, state
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "image": rng.rand(n, 28, 28, 1).astype(np.float32),
+        "label": rng.randint(0, 10, (n,)),
+    }
+
+
+# ---------------------------------------------------------------- tensor TP
+
+
+def test_cnn_tp_rules_assign_model_axis():
+    mesh = meshlib.create_mesh(meshlib.MeshSpec(data=-1, model=2))
+    _, state = _lenet_state()
+    sh = shardlib.tree_param_shardings(
+        mesh, state.params, tensor.cnn_tp_rules()
+    )
+    flat = {
+        shardlib._path_str(p): s
+        for p, s in jax.tree_util.tree_leaves_with_path(sh)
+    }
+    conv_kernels = [k for k in flat if "Conv" in k and k.endswith("kernel")]
+    assert conv_kernels
+    for k in conv_kernels:
+        assert flat[k].spec == P(None, None, None, AxisNames.MODEL), k
+    assert flat["head/kernel"].spec == P(None, AxisNames.MODEL)
+    # Non-matching params (Dense_0) stay replicated.
+    dense = [k for k in flat if k.startswith("Dense")]
+    assert dense and all(flat[k].spec == P() for k in dense)
+
+
+def test_tp_step_matches_data_parallel():
+    """One train step with conv+head weights sharded over model=2 must
+    match the pure-DP step numerically — TP changes layout, not math."""
+    mesh_tp = meshlib.create_mesh(meshlib.MeshSpec(data=-1, model=2))
+    mesh_dp = meshlib.data_parallel_mesh()
+    model, state = _lenet_state()
+    step = train_loop.make_train_step(
+        train_loop.classification_loss_fn(model.apply)
+    )
+    batch = _batch()
+    rng = jax.random.key(1)
+
+    s_dp = train_loop.place_state(state, mesh_dp)
+    s_dp, m_dp = step(s_dp, shardlib.shard_batch(mesh_dp, batch), rng)
+
+    s_tp = train_loop.place_state(state, mesh_tp, tensor.cnn_tp_rules())
+    s_tp, m_tp = step(s_tp, shardlib.shard_batch(mesh_tp, batch), rng)
+
+    np.testing.assert_allclose(
+        float(m_dp["loss"]), float(m_tp["loss"]), rtol=1e-5
+    )
+    a = jax.tree.leaves(s_dp.params)
+    b = jax.tree.leaves(s_tp.params)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_transformer_rules_shapes():
+    rules = tensor.transformer_tp_rules()
+    patterns = [p for p, _ in rules]
+    assert any("query" in p for p in patterns)
+    assert any("down" in p for p in patterns)
+
+
+# ---------------------------------------------------------------- async PS
+
+
+def _emulator(num_workers, schedule="round_robin", seed=0, limit=None):
+    model, state = _lenet_state()
+    loss_fn = train_loop.classification_loss_fn(model.apply)
+    cfg = async_ps.AsyncConfig(
+        num_workers=num_workers,
+        schedule=schedule,
+        seed=seed,
+        staleness_limit=limit,
+    )
+    return model, async_ps.AsyncPSEmulator(state, loss_fn, cfg)
+
+
+def test_async_one_worker_matches_sync():
+    """K=1 async == the sync train step trajectory, bit-for-bit-ish."""
+    model, emu = _emulator(1)
+    _, state = _lenet_state()
+    step = train_loop.make_train_step(
+        train_loop.classification_loss_fn(model.apply), donate=False
+    )
+    rng = jax.random.key(7)
+    batches = [_batch(seed=i) for i in range(3)]
+    for b in batches:
+        emu.step(b, rng)
+        state, _ = step(state, b, rng)
+    assert emu.staleness_log == [0, 0, 0]
+    for x, y in zip(
+        jax.tree.leaves(emu.state.params), jax.tree.leaves(state.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_async_round_robin_staleness():
+    _, emu = _emulator(4)
+    rng = jax.random.key(0)
+    for i in range(8):
+        emu.step(_batch(seed=i), rng)
+    # Ramp-up 0,1,2,3 then steady-state K-1.
+    assert emu.staleness_log == [0, 1, 2, 3, 3, 3, 3, 3]
+    assert emu.dropped == 0
+
+
+def test_async_staleness_limit_drops():
+    _, emu = _emulator(4, limit=2)
+    rng = jax.random.key(0)
+    records = [emu.step(_batch(seed=i), rng) for i in range(8)]
+    assert emu.dropped > 0
+    assert any(r["dropped"] for r in records)
+    # Dropped events must not advance the canonical step.
+    applied = sum(1 for r in records if not r["dropped"])
+    assert int(emu.state.step) == applied
+
+
+def test_async_random_schedule_deterministic():
+    _, emu1 = _emulator(4, schedule="random", seed=3)
+    _, emu2 = _emulator(4, schedule="random", seed=3)
+    rng = jax.random.key(0)
+    r1 = [emu1.step(_batch(seed=i), rng)["worker"] for i in range(6)]
+    r2 = [emu2.step(_batch(seed=i), rng)["worker"] for i in range(6)]
+    assert r1 == r2
+    for x, y in zip(
+        jax.tree.leaves(emu1.state.params), jax.tree.leaves(emu2.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_async_threads_ema_and_version():
+    """EMA shadows advance through the emulator's apply, and workers built
+    from a restored (step>0) state start at staleness 0, not step."""
+    model = get_model("lenet")
+    state = TrainState.create(
+        model,
+        optim.sgd(0.1),
+        jax.random.key(0),
+        jnp.zeros((2, 28, 28, 1)),
+        ema_decay=0.9,
+    )
+    state = state.replace(step=jnp.asarray(100, jnp.int32))
+    loss_fn = train_loop.classification_loss_fn(model.apply)
+    emu = async_ps.AsyncPSEmulator(
+        state, loss_fn, async_ps.AsyncConfig(num_workers=2, staleness_limit=2)
+    )
+    rec = emu.step(_batch(), jax.random.key(1))
+    assert rec["staleness"] == 0 and not rec["dropped"]
+    changed = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(emu.state.ema_params),
+            jax.tree.leaves(state.ema_params),
+        )
+    )
+    assert changed, "EMA shadows did not advance"
+
+
+def test_async_loss_decreases():
+    """Async training with staleness still learns on a fixed batch."""
+    model, state = _lenet_state(tx=optim.sgd(0.02))
+    loss_fn = train_loop.classification_loss_fn(model.apply)
+    emu = async_ps.AsyncPSEmulator(
+        state, loss_fn, async_ps.AsyncConfig(num_workers=4)
+    )
+    rng = jax.random.key(0)
+    batch = _batch(n=16)
+    losses = [
+        float(emu.step(batch, rng)["metrics"]["loss"]) for _ in range(30)
+    ]
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
