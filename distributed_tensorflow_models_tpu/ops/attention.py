@@ -1,0 +1,349 @@
+"""Attention ops: reference, blockwise (memory-efficient), and Pallas flash.
+
+The reference framework predates attention entirely (its only sequence model
+is the PTB LSTM, SURVEY.md §2.1 R8) — this module is part of the framework's
+long-context mandate: scaled-dot-product attention implemented three ways,
+all sharing one API so models and the sequence-parallel layer
+(:mod:`...parallel.ring`) can pick per backend:
+
+- :func:`reference_attention` — O(T²) materialized scores; the numerics
+  oracle for everything else.
+- :func:`blockwise_attention` — ``lax.scan`` over KV blocks with running
+  (max, sum, acc) renormalization (Rabe & Staats / FlashAttention
+  recurrence).  O(T·block) memory, differentiable end-to-end (scan is
+  reverse-AD-able), runs on any backend; the training default.
+- :func:`flash_attention` — the same recurrence as a Pallas TPU kernel:
+  one grid step per (batch·head, q-block), KV loop innermost with the
+  softmax state in VMEM scratch, causal blocks skipped.  MXU-shaped
+  matmuls (q·kᵀ and p·v), fp32 accumulation.  Gradients via
+  ``jax.custom_vjp`` with a recomputing backward (blockwise), so training
+  through it is correct while the forward stays O(T·block) memory.
+
+Layout convention everywhere: ``[batch, seq, heads, head_dim]`` (BTHD).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # finite "-inf": keeps exp(s - m) well-defined in masked rows
+
+
+def _scale(q, scale: Optional[float]) -> float:
+    return scale if scale is not None else q.shape[-1] ** -0.5
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset: int | jax.Array = 0,
+    kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Materialized-scores attention. BTHD in, BTHD out.
+
+    ``q_offset``/``kv_offset`` are the global positions of the first query /
+    key row — how causal masking stays correct when q and kv are *chunks* of
+    a longer sequence (the ring-attention case).
+    """
+    s = _scale(q, scale)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * s
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[1])[:, None]
+        kj = kv_offset + jnp.arange(k.shape[1])[None, :]
+        logits = jnp.where(qi >= kj, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v
+    )
+
+
+# --------------------------------------------------------------- blockwise
+
+
+def _block_update(carry, s_block, v_block):
+    """One step of the streaming-softmax recurrence.
+
+    carry = (m, l, acc): running row-max [..., q, 1], running normalizer
+    [..., q, 1], unnormalized output accumulator [..., q, d] — all fp32.
+    """
+    m, l, acc = carry
+    m_new = jnp.maximum(m, jnp.max(s_block, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s_block - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = alpha * acc + jnp.einsum(
+        "...qk,...kd->...qd", p, v_block.astype(jnp.float32)
+    )
+    return m_new, l_new, acc_new
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_kv: int = 512,
+    q_offset: int | jax.Array = 0,
+    kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Memory-efficient attention: scan over KV blocks, BTHD in/out.
+
+    Peak memory O(B·H·T_q·block_kv) instead of O(B·H·T_q·T_kv) in *both*
+    passes (the scan body is remat-ed, so backward recomputes per-block
+    scores instead of storing them); exact same math as
+    :func:`reference_attention` (tested to fp32 tolerance).  KV lengths
+    that don't divide ``block_kv`` are padded and masked.
+    """
+    B, Tq, H, D = q.shape
+    Tkv = k.shape[1]
+    block_kv = min(block_kv, Tkv)
+    # Arbitrary lengths: pad KV up to a block multiple and mask the tail.
+    pad = (-Tkv) % block_kv
+    nblocks = (Tkv + pad) // block_kv
+    s = _scale(q, scale)
+
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * s  # [B,H,Tq,D]
+    kf = jnp.swapaxes(k, 1, 2)  # [B,H,Tkv,D]
+    vf = jnp.swapaxes(v, 1, 2)
+    if pad:
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = kf.reshape(B, H, nblocks, block_kv, D).transpose(2, 0, 1, 3, 4)
+    vb = vf.reshape(B, H, nblocks, block_kv, D).transpose(2, 0, 1, 3, 4)
+
+    qi = q_offset + jnp.arange(Tq)[:, None]  # [Tq, 1]
+
+    @jax.checkpoint
+    def body(carry, inp):
+        # remat: recompute s_block/p in backward instead of stacking
+        # score-sized residuals per step — this is what keeps the backward
+        # pass O(T·block) too.
+        j, k_j, v_j = inp
+        s_block = jnp.einsum(
+            "bhqd,bhkd->bhqk", qf, k_j.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        lk = j * block_kv + jnp.arange(block_kv)[None, :]  # local kv index
+        valid = lk < Tkv
+        if causal:
+            valid = valid & (qi >= kv_offset + lk)
+        if causal or pad:
+            s_block = jnp.where(valid, s_block, NEG_INF)
+        return _block_update(carry, s_block, v_j), None
+
+    # Carries derive from qf to inherit its device-varying axis type, so
+    # this scan also works nested inside shard_map (Ulysses path).
+    m0 = jnp.zeros_like(qf[..., :1]) + NEG_INF
+    l0 = jnp.zeros_like(qf[..., :1])
+    a0 = jnp.zeros_like(qf)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, a0), (jnp.arange(nblocks), kb, vb)
+    )
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+# ------------------------------------------------------------ pallas flash
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, block_q: int, block_kv: int,
+):
+    """Grid = (B*H, Tq/block_q, Tkv/block_kv); KV innermost, softmax state
+    carried across KV steps in VMEM scratch, output written on the last."""
+    import jax.experimental.pallas as pl  # deferred: TPU-path only
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal block skip: the whole KV block is in the future of the whole
+    # Q block iff j*block_kv > i*block_q + (block_q - 1).
+    should_run = True
+    if causal:
+        should_run = j * block_kv <= i * block_q + block_q - 1
+
+    @pl.when(should_run)
+    def _compute():
+        qb = q_ref[0].astype(jnp.float32) * scale  # [bq, D]
+        kb = k_ref[0].astype(jnp.float32)  # [bkv, D]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bkv]
+        if causal:
+            qi = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0
+            )
+            kj = j * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1
+            )
+            s = jnp.where(qi >= kj, s, NEG_INF)
+        m_prev, l_prev, acc_prev = m_scr[:], l_scr[:], acc_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = alpha * acc_prev + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:], l_scr[:], acc_scr[:] = m_new, l_new, acc
+
+    @pl.when(j == n_j - 1)
+    def _finish():
+        o_ref[0] = (
+            acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q, k, v, *, causal, scale, block_q, block_kv, interpret
+):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Tq, H, D = q.shape
+    Tkv = k.shape[1]
+    block_q = min(block_q, Tq)
+    block_kv = min(block_kv, Tkv)
+    if Tq % block_q or Tkv % block_kv:
+        raise ValueError(
+            f"seq lens ({Tq},{Tkv}) not divisible by blocks "
+            f"({block_q},{block_kv})"
+        )
+    s = _scale(q, scale)
+    # BTHD -> (B*H, T, D): contiguous per-head rows for clean 2D tiles.
+    qh = jnp.swapaxes(q, 1, 2).reshape(B * H, Tq, D)
+    kh = jnp.swapaxes(k, 1, 2).reshape(B * H, Tkv, D)
+    vh = jnp.swapaxes(v, 1, 2).reshape(B * H, Tkv, D)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q, Tkv // block_kv),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, D), lambda b, i, j: (b, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_kv, D), lambda b, i, j: (b, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_kv, D), lambda b, i, j: (b, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, D), lambda b, i, j: (b, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    return jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas TPU flash attention, BTHD in/out.
+
+    Forward is the fused kernel; backward recomputes through
+    :func:`blockwise_attention` (flash-style recompute-in-backward — the
+    O(T²) score matrix is never materialized in either pass).
+    ``interpret=True`` runs the same kernel on CPU for tests.
+    """
+    return _flash_forward(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+    )
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out = _flash_forward(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(
+            q, k, v, causal=causal, scale=scale, block_kv=block_kv
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatching entry point: ``impl`` in {auto, reference, blockwise,
+    flash}.  ``auto`` = flash kernel on TPU (when seq lens are
+    tile-aligned), blockwise elsewhere."""
+    if impl == "auto":
+        aligned = q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+        impl = (
+            "flash"
+            if jax.default_backend() == "tpu" and aligned
+            else "blockwise"
+        )
+    if impl == "reference":
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal, scale)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -12,7 +12,11 @@ is expected to provide on a named device mesh:
   parameter-server training (SURVEY.md §7.6) with deterministic replay and
   staleness accounting, for the async-vs-sync A/B the reference was built
   to run.
+- :mod:`.ring` — sequence/context parallelism: ring attention
+  (``ppermute``-rotated KV chunks over the ``seq`` axis) and
+  Ulysses-style all-to-all head/sequence resharding.
 """
 
 from distributed_tensorflow_models_tpu.parallel import async_ps  # noqa: F401
+from distributed_tensorflow_models_tpu.parallel import ring  # noqa: F401
 from distributed_tensorflow_models_tpu.parallel import tensor  # noqa: F401

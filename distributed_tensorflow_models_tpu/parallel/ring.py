@@ -1,0 +1,196 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Nothing in the reference scales sequence length beyond the PTB unroll
+(SURVEY.md §5.7); this module is the framework's long-context layer,
+sharding the *sequence* dimension over the ``seq`` mesh axis:
+
+- :func:`ring_attention` — each device holds a Q/K/V chunk; KV chunks
+  rotate around the ring via ``lax.ppermute`` (compiled to ICI
+  collective-permute) while every device folds each visiting chunk into
+  the streaming-softmax state (same recurrence as
+  :func:`...ops.attention.blockwise_attention`).  Attention over the full
+  sequence with O(T/n) memory per device and compute overlapped with
+  neighbor-only communication — the TPU-native ring form SURVEY.md §5.7
+  anticipates.
+- :func:`ulysses_attention` — the all-to-all alternative: resharding
+  [seq-sharded, all heads] → [full seq, head-sharded] with
+  ``lax.all_to_all``, local full-sequence attention, then the inverse
+  resharding.  Cheaper at moderate T (two all-to-alls total), requires
+  ``num_heads % seq_axis_size == 0``.
+
+Both are ``shard_map``-wrapped and nest inside an outer ``jax.jit``
+(composable with the data-parallel train step: batch stays sharded over
+``data`` while sequence shards over ``seq``).  Both are differentiable —
+``ppermute``/``all_to_all`` have transpose rules and the inner loop is a
+``lax.scan``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_models_tpu.core.mesh import AxisNames
+from distributed_tensorflow_models_tpu.ops import attention as attnlib
+
+
+def _ring_attention_local(
+    q, k, v, *, axis_name: str, causal: bool, scale: Optional[float]
+):
+    """Per-device body (inside shard_map): q/k/v are local chunks
+    [B, T_local, H, D]; returns the local output chunk."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    s = attnlib._scale(q, scale)
+
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * s  # [B,H,Tl,D]
+    q_off = my * Tl
+
+    # Derive the carries from qf so they inherit its varying-axis type
+    # (shard_map requires scan carries device-varying like the body output).
+    m0 = jnp.zeros_like(qf[..., :1]) + attnlib.NEG_INF
+    l0 = jnp.zeros_like(qf[..., :1])
+    a0 = jnp.zeros_like(qf)
+
+    # Rotate KV around the ring; at rotation r this device holds the chunk
+    # that originated on rank (my - r) mod n.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @jax.checkpoint
+    def body(carry, r):
+        # remat: backward recomputes each rotation's scores instead of
+        # stacking them, keeping backward memory O(T/n · T/n) per device.
+        m, l, acc, k_cur, v_cur = carry
+        src = (my - r) % n
+        kv_off = src * Tl
+
+        def fold(mla):
+            m, l, acc = mla
+            s_block = jnp.einsum(
+                "bhqd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            if causal:
+                qi = q_off + jnp.arange(Tl)[:, None]
+                kj = kv_off + jnp.arange(Tl)[None, :]
+                s_block = jnp.where(qi >= kj, s_block, attnlib.NEG_INF)
+            vb = jnp.swapaxes(v_cur, 1, 2)  # [B,H,Tl,D]
+            return attnlib._block_update((m, l, acc), s_block, vb)
+
+        if causal:
+            # Skip rotations whose KV chunk is entirely in this device's
+            # future — without this, causal rings waste ~half their FLOPs
+            # computing fully-masked blocks.
+            fully_masked = kv_off > q_off + Tl - 1
+            m, l, acc = jax.lax.cond(
+                fully_masked, lambda mla: mla, fold, (m, l, acc)
+            )
+        else:
+            m, l, acc = fold((m, l, acc))
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, acc, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        body, (m0, l0, a0, k, v), jnp.arange(n)
+    )
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    seq_axis: str = AxisNames.SEQ,
+    data_axis: str = AxisNames.DATA,
+) -> jax.Array:
+    """Full-sequence attention with Q/K/V sharded over ``seq_axis``.
+
+    Global BTHD arrays in, global BTHD out; batch sharded over
+    ``data_axis``, sequence over ``seq_axis``, causal masking computed in
+    global positions.  ``T`` must divide by the seq-axis size.
+    """
+    n = mesh.shape[seq_axis]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by seq axis {n}"
+        )
+    spec = P(data_axis, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local,
+            axis_name=seq_axis, causal=causal, scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def _ulysses_local(
+    q, k, v, *, axis_name: str, causal: bool, scale: Optional[float],
+    impl: str,
+):
+    """Inside shard_map: [B, T/n, H, D] → all_to_all → [B, T, H/n, D] →
+    local attention → inverse."""
+    # split heads across the axis, gather sequence: axes are
+    # (0=B, 1=T, 2=H, 3=D) — split axis 2, concat axis 1.
+    def scatter_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    out = attnlib.attention(
+        qh, kh, vh, causal=causal, scale=scale, impl=impl
+    )
+    return gather_heads(out)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    seq_axis: str = AxisNames.SEQ,
+    data_axis: str = AxisNames.DATA,
+    impl: str = "blockwise",
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style), BTHD
+    global in/out, sequence sharded over ``seq_axis``.  Heads must divide
+    by the seq-axis size."""
+    n = mesh.shape[seq_axis]
+    if q.shape[2] % n:
+        raise ValueError(
+            f"num heads {q.shape[2]} not divisible by seq axis {n}"
+        )
+    spec = P(data_axis, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ulysses_local,
+            axis_name=seq_axis, causal=causal, scale=scale, impl=impl,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
