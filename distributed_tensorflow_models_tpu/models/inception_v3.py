@@ -26,6 +26,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_tensorflow_models_tpu.models import register
+from distributed_tensorflow_models_tpu.ops.normalization import BatchNorm
 
 
 class ConvBN(nn.Module):
@@ -48,11 +49,10 @@ class ConvBN(nn.Module):
             use_bias=False,
             dtype=self.dtype,
         )(x)
-        x = nn.BatchNorm(
+        x = BatchNorm(
             use_running_average=not train,
             momentum=0.9997,  # slim inception BN decay
             epsilon=1e-3,
-            dtype=jnp.float32,
         )(x)
         return nn.relu(x)
 

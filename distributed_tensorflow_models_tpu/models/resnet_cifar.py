@@ -21,6 +21,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_tensorflow_models_tpu.models import register
+from distributed_tensorflow_models_tpu.ops.normalization import BatchNorm
 
 
 class BasicBlock(nn.Module):
@@ -33,11 +34,10 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         norm = partial(
-            nn.BatchNorm,
+            BatchNorm,
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=jnp.float32,
         )
         conv = partial(
             nn.Conv, kernel_size=(3, 3), padding="SAME", use_bias=False,
@@ -77,9 +77,9 @@ class CifarResNet(nn.Module):
             self.widths[0], (3, 3), padding="SAME", use_bias=False,
             dtype=self.dtype, name="conv_init",
         )(x)
-        x = nn.BatchNorm(
+        x = BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5,
-            dtype=jnp.float32, name="bn_init",
+            name="bn_init",
         )(x)
         x = nn.relu(x)
         for stage, width in enumerate(self.widths):

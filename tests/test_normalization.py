@@ -1,0 +1,93 @@
+"""Numerics of ops.normalization.BatchNorm vs flax.linen.BatchNorm.
+
+The TPU BatchNorm must be a drop-in for the flax module (same variable
+layout, same math in float32) with only dtype discipline changed; these
+tests pin that equivalence so model checkpoints stay interchangeable.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu.ops.normalization import BatchNorm
+
+
+def _flax_bn(**kw):
+    return nn.BatchNorm(use_fast_variance=True, **kw)
+
+
+@pytest.fixture
+def x32():
+    rng = np.random.RandomState(0)
+    return jnp.asarray(rng.randn(16, 8, 8, 24).astype(np.float32)) * 3.0 + 1.5
+
+
+def test_variable_layout_matches_flax(x32):
+    ours = BatchNorm(use_running_average=False)
+    theirs = _flax_bn(use_running_average=False)
+    v_ours = ours.init(jax.random.key(0), x32)
+    v_theirs = theirs.init(jax.random.key(0), x32)
+    assert jax.tree_util.tree_structure(
+        v_ours
+    ) == jax.tree_util.tree_structure(v_theirs)
+
+
+def test_train_mode_matches_flax_f32(x32):
+    ours = BatchNorm(use_running_average=False, momentum=0.9)
+    theirs = _flax_bn(use_running_average=False, momentum=0.9)
+    v = theirs.init(jax.random.key(0), x32)
+    y_ours, m_ours = ours.apply(v, x32, mutable=["batch_stats"])
+    y_theirs, m_theirs = theirs.apply(v, x32, mutable=["batch_stats"])
+    np.testing.assert_allclose(y_ours, y_theirs, atol=1e-4, rtol=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4),
+        m_ours["batch_stats"],
+        m_theirs["batch_stats"],
+    )
+
+
+def test_eval_mode_matches_flax_f32(x32):
+    ours = BatchNorm(use_running_average=True)
+    theirs = _flax_bn(use_running_average=True)
+    v = theirs.init(jax.random.key(0), x32)
+    # Non-trivial running stats.
+    v = {
+        "params": v["params"],
+        "batch_stats": {
+            "mean": jnp.full((24,), 0.7),
+            "var": jnp.full((24,), 2.3),
+        },
+    }
+    np.testing.assert_allclose(
+        ours.apply(v, x32), theirs.apply(v, x32), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_bf16_io_keeps_dtype_and_tracks_f32_reference(x32):
+    xb = x32.astype(jnp.bfloat16)
+    ours = BatchNorm(use_running_average=False)
+    v = ours.init(jax.random.key(0), x32)
+    y, mut = ours.apply(v, xb, mutable=["batch_stats"])
+    assert y.dtype == jnp.bfloat16
+    # Stats stay f32 and close to the f32-input reference.
+    stats = mut["batch_stats"]
+    assert stats["mean"].dtype == jnp.float32
+    y32, mut32 = ours.apply(v, x32, mutable=["batch_stats"])
+    np.testing.assert_allclose(
+        stats["mean"], mut32["batch_stats"]["mean"], atol=0.05, rtol=0.05
+    )
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(y32), atol=0.1, rtol=0.1
+    )
+
+
+def test_scale_init_zero_gives_pure_bias():
+    x = jnp.ones((4, 3, 3, 5))
+    bn = BatchNorm(
+        use_running_average=False, scale_init=nn.initializers.zeros
+    )
+    v = bn.init(jax.random.key(0), x)
+    y, _ = bn.apply(v, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-6)
