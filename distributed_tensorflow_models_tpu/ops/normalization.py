@@ -43,30 +43,43 @@ class BatchNorm(nn.Module):
 
     Attributes:
       use_running_average: eval mode — normalize with the stored running
-        statistics instead of batch statistics.
+        statistics instead of batch statistics.  As in flax, it may be left
+        ``None`` at construction and supplied at call time; leaving it
+        unspecified in both places is an error.
       momentum: running-statistics decay (slim inception uses 0.9997, the
         CIFAR/ResNet tutorials 0.9 — SURVEY.md §2.1 R4/R5).
       epsilon: numerical floor inside the rsqrt.
       axis_name: optional mapped axis to ``pmean`` statistics over (only
         needed under shard_map/pmap; under jit global-batch semantics are
         automatic).
-      dtype: accepted for flax.linen.BatchNorm signature compatibility;
-        ignored — the elementwise path always runs in the input dtype and
-        statistics always accumulate in float32.
       scale_init/bias_init: parameter initializers (zero ``scale_init`` is
         the ResNet last-BN identity-start trick).
+
+    Unlike ``flax.linen.BatchNorm`` there is no ``dtype`` attribute: the
+    elementwise path always runs in the *input* dtype and statistics always
+    accumulate in float32, so a dtype knob would either lie or reintroduce
+    the f32 activation round-trip this module exists to remove.  Passing
+    ``dtype=`` raises a ``TypeError`` at construction — loud, not silent.
     """
 
-    use_running_average: bool = True
+    use_running_average: Optional[bool] = None
     momentum: float = 0.9
     epsilon: float = 1e-5
     axis_name: Optional[str] = None
-    dtype: Optional[jnp.dtype] = None
     scale_init: nn.initializers.Initializer = nn.initializers.ones
     bias_init: nn.initializers.Initializer = nn.initializers.zeros
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        use_running_average: Optional[bool] = None,
+    ) -> jax.Array:
+        use_running_average = nn.merge_param(
+            "use_running_average",
+            self.use_running_average,
+            use_running_average,
+        )
         features = x.shape[-1]
         reduce_axes = tuple(range(x.ndim - 1))
 
@@ -89,7 +102,7 @@ class BatchNorm(nn.Module):
             (features,),
         )
 
-        if self.use_running_average:
+        if use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:
             xf = x.astype(jnp.float32)

@@ -83,6 +83,63 @@ def test_bf16_io_keeps_dtype_and_tracks_f32_reference(x32):
     )
 
 
+def test_use_running_average_merge_param_contract(x32):
+    bn = BatchNorm()  # unspecified at construction, flax-style
+    v = bn.init(jax.random.key(0), x32, use_running_average=False)
+    # Call-time override works in both directions.
+    y_train, _ = bn.apply(
+        v, x32, use_running_average=False, mutable=["batch_stats"]
+    )
+    y_eval = bn.apply(v, x32, use_running_average=True)
+    assert not np.allclose(np.asarray(y_train), np.asarray(y_eval))
+    # Never specifying it anywhere fails loudly, as in flax.
+    with pytest.raises(Exception):
+        bn.apply(v, x32)
+
+
+def test_dtype_kwarg_rejected_loudly():
+    with pytest.raises(TypeError):
+        BatchNorm(use_running_average=False, dtype=jnp.float32)
+
+
+def test_axis_name_pmean_matches_global_stats(x32):
+    """Under shard_map (per-shard reductions), axis_name must recover the
+    same output as unsharded global-batch statistics."""
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    bn_global = BatchNorm(use_running_average=False)
+    v = bn_global.init(jax.random.key(0), x32)
+    y_ref, m_ref = bn_global.apply(v, x32, mutable=["batch_stats"])
+
+    bn_sharded = BatchNorm(use_running_average=False, axis_name="data")
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P("data"),
+        out_specs=(P("data"), P()),
+    )
+    def sharded_apply(xs):
+        y, m = bn_sharded.apply(v, xs, mutable=["batch_stats"])
+        return y, m["batch_stats"]
+
+    y_sh, stats_sh = sharded_apply(x32)
+    np.testing.assert_allclose(
+        np.asarray(y_sh), np.asarray(y_ref), atol=1e-5, rtol=1e-5
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        ),
+        stats_sh,
+        m_ref["batch_stats"],
+    )
+
+
 def test_scale_init_zero_gives_pure_bias():
     x = jnp.ones((4, 3, 3, 5))
     bn = BatchNorm(
