@@ -80,7 +80,12 @@ def main(argv: list[str] | None = None) -> int:
             print(name)
         return 0
 
-    if args.multihost:
+    # Cluster facts from the launcher (DTM_* env, launch.py) take priority;
+    # --multihost without them falls back to managed-slice auto-detection.
+    from distributed_tensorflow_models_tpu import launch as launchlib
+
+    in_cluster = launchlib.initialize_from_env()
+    if args.multihost and not in_cluster:
         from distributed_tensorflow_models_tpu.core import mesh as meshlib
 
         meshlib.initialize_multihost()
@@ -90,7 +95,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "train":
         from distributed_tensorflow_models_tpu.harness import train as trainlib
 
-        result = trainlib.fit(cfg, args.workdir)
+        result = trainlib.recoverable_fit(cfg, args.workdir)
         print(json.dumps({"final_metrics": result.final_metrics}))
         return 0
 

@@ -171,6 +171,28 @@ class CheckpointHook(Hook):
         self._save(state, int(state.step))
 
 
+class FaultInjectionHook(Hook):
+    """Raise a chosen exception at a chosen step, once.
+
+    The reference has no fault injection anywhere (SURVEY.md §5.3); the
+    rebuild adds it as a first-class hook so the recovery path — the
+    analogue of ``_RecoverableSession``'s retry loop (TF
+    monitored_session.py:1261-1274) — is testable on demand rather than
+    only on real preemptions."""
+
+    def __init__(self, step: int, exc_factory=None):
+        self._step = step
+        self._fired = False
+        self._exc_factory = exc_factory or (
+            lambda: RuntimeError("injected preemption")
+        )
+
+    def after_step(self, state, metrics, step):
+        if step == self._step and not self._fired:
+            self._fired = True
+            raise self._exc_factory()
+
+
 class ProfilerHook(Hook):
     """Capture an XLA/TPU trace for steps [start, stop) into
     ``<workdir>/profile`` — the Timeline/FULL_TRACE replacement (SURVEY.md

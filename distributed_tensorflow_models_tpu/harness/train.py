@@ -193,11 +193,91 @@ def fit(
             steps_run += 1
             if not hooklib.run_hooks_after_step(all_hooks, state, metrics, step):
                 break
-    finally:
+    except BaseException:
+        # Already failing: run end hooks best-effort (the CheckpointHook
+        # end-save preserves crash-time progress when storage still works)
+        # but never let cleanup mask the original error or skip releasing
+        # the pipeline threads / checkpoint manager — recoverable_fit may
+        # re-enter fit on the same workdir right after this.
         for h in all_hooks:
-            h.end(state)
-        host.stop()
-        manager.close()
+            try:
+                h.end(state)
+            except Exception:
+                log.exception("hook %r end() failed during error cleanup", h)
+        _close_quietly(host, manager)
+        raise
+    else:
+        try:
+            for h in all_hooks:
+                h.end(state)
+        finally:
+            _close_quietly(host, manager)
 
     host_metrics = {k: float(v) for k, v in metrics.items()}
     return FitResult(state=state, final_metrics=host_metrics, steps_run=steps_run)
+
+
+def _close_quietly(host, manager) -> None:
+    try:
+        host.stop()
+    except Exception:
+        log.exception("host pipeline stop failed")
+    finally:
+        try:
+            manager.close()
+        except Exception:
+            log.exception("checkpoint manager close failed")
+
+
+def default_recoverable_errors() -> tuple[type[BaseException], ...]:
+    """Failure classes worth restarting on — *transient* ones only: device
+    runtime errors (the analogue of the AbortedError/UnavailableError set
+    ``_RecoverableSession`` retries on, TF monitored_session.py:1261-1274)
+    and connection/timeout failures to peers or storage.  Deliberately NOT
+    blanket ``OSError``: a PermissionError or FileNotFoundError from a bad
+    workdir is deterministic and retrying it would crash-loop."""
+    errors: list[type[BaseException]] = [ConnectionError, TimeoutError]
+    jax_err = getattr(jax.errors, "JaxRuntimeError", None)
+    if jax_err is not None:
+        errors.append(jax_err)
+    return tuple(errors)
+
+
+def recoverable_fit(
+    cfg: ExperimentConfig,
+    workdir: str,
+    *,
+    max_restarts: int = 3,
+    recover_on: tuple[type[BaseException], ...] | None = None,
+    **fit_kwargs,
+) -> FitResult:
+    """``fit`` wrapped in the reference's session-recovery loop.
+
+    ``_RecoverableSession`` catches preemption-class errors, recreates the
+    session, and resumes from the last checkpoint (TF monitored_session.py:
+    1238,1261-1274; workers re-poll via session_manager.py:419).  Here the
+    equivalent is simply calling ``fit`` again: restore-or-init picks up the
+    latest checkpoint — parameters, optimizer state, EMA, step, and the
+    input-pipeline position — so no progress is lost beyond the last save.
+    Bounded by ``max_restarts`` to avoid crash-looping on deterministic
+    failures (e.g. a NaN guard trip, which is *not* in the recoverable set).
+    """
+    if recover_on is None:
+        recover_on = default_recoverable_errors()
+    attempt = 0
+    while True:
+        try:
+            # steps_run counts the final (successful) attempt; overall
+            # progress is state.step, which spans attempts via checkpoints.
+            return fit(cfg, workdir, **fit_kwargs)
+        except recover_on as e:
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            log.warning(
+                "fit failed (%s: %s); restart %d/%d from latest checkpoint",
+                type(e).__name__,
+                e,
+                attempt,
+                max_restarts,
+            )

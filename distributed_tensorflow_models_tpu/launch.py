@@ -1,0 +1,240 @@
+"""Multi-process launcher — the L6 layer, TPU-native form.
+
+The reference's outermost layer is per-model shell scripts that spawn N
+``ps`` + M ``worker`` Python processes across hosts, passing ``--job_name``
+and ``--task_index`` flags that each driver turns into a ``ClusterSpec`` +
+``tf.train.Server`` (SURVEY.md §1 L6, §2.1 R1; TF training/server_lib.py:
+96,107-146,242).  There is no resource manager — placement is manual.
+
+The SPMD equivalent is radically smaller: every process runs the *same*
+program; the only per-process facts are ``(coordinator_address,
+num_processes, process_id)``, wired into ``jax.distributed.initialize``
+(control plane only — the data plane is compiled XLA collectives over
+ICI/DCN, SURVEY.md §5.8).  This module provides:
+
+- the ``DTM_*`` environment convention carrying those three facts
+  (the analogue of R1's ``--job_name/--task_index`` flags),
+- :func:`initialize_from_env` — process-side bootstrap,
+- :func:`launch_local` — spawn an N-process cluster on localhost
+  (the analogue of TF's in-process fake clusters via
+  ``Server.create_local_server``, SURVEY.md §4: multi-node protocol tests
+  on one machine with no real cluster),
+- a CLI: ``python -m distributed_tensorflow_models_tpu.launch``.
+
+On managed TPU slices none of this is needed — ``jax.distributed
+.initialize()`` auto-detects the slice topology and each host runs the same
+command; use the CLI only for manual clusters and localhost tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import Mapping, Sequence
+
+ENV_COORDINATOR = "DTM_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "DTM_NUM_PROCESSES"
+ENV_PROCESS_ID = "DTM_PROCESS_ID"
+ENV_CPU_DEVICES = "DTM_CPU_DEVICES_PER_PROCESS"
+
+DEFAULT_PORT = 9671
+
+
+def initialize_from_env() -> bool:
+    """Bootstrap ``jax.distributed`` from ``DTM_*`` env vars.
+
+    Returns True if a multi-process cluster was configured, False when the
+    env carries no cluster facts (single-process mode — the common case, and
+    the analogue of running a reference driver without ``--job_name``).
+
+    Must run before first backend use.  When ``DTM_CPU_DEVICES_PER_PROCESS``
+    is set the process is forced onto that many fake CPU devices first
+    (test clusters, SURVEY.md §4.3) and gloo cross-process collectives are
+    enabled so psum/all-gather actually cross process boundaries.
+    """
+    cpu_devices = os.environ.get(ENV_CPU_DEVICES)
+    if cpu_devices:
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={cpu_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            # Replace an inherited count (e.g. the test conftest's 8).
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", want, flags
+            )
+        else:
+            flags = f"{flags} {want}".strip()
+        os.environ["XLA_FLAGS"] = flags
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    coord = os.environ.get(ENV_COORDINATOR)
+    nproc = os.environ.get(ENV_NUM_PROCESSES)
+    pid = os.environ.get(ENV_PROCESS_ID)
+    if not (coord and nproc and pid):
+        return False
+
+    from distributed_tensorflow_models_tpu.core.mesh import (
+        initialize_multihost,
+    )
+
+    initialize_multihost(
+        coordinator_address=coord,
+        num_processes=int(nproc),
+        process_id=int(pid),
+    )
+    return True
+
+
+def launch_local(
+    num_processes: int,
+    argv: Sequence[str],
+    *,
+    port: int = DEFAULT_PORT,
+    cpu_devices_per_process: int | None = None,
+    extra_env: Mapping[str, str] | None = None,
+    timeout: float | None = None,
+) -> list[int]:
+    """Spawn ``num_processes`` copies of ``argv`` as a localhost cluster.
+
+    Each child gets the ``DTM_*`` cluster facts in its environment; process
+    0's stdout/stderr pass through, the rest stream into temp files and are
+    replayed only on failure (mirroring the reference launch scripts'
+    per-task logs, R1).  Files, not pipes: a sequentially-drained pipe
+    back-pressures a chatty child into blocking mid-step, which stalls the
+    whole cluster at its next collective.  ``timeout`` bounds the *total*
+    wall time of the cluster, not each child.  Returns the exit codes.
+    """
+    import tempfile
+    import time
+
+    procs: list[subprocess.Popen] = []
+    logs: list = [None]
+    try:
+        for i in range(num_processes):
+            env = dict(os.environ)
+            env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+            env[ENV_NUM_PROCESSES] = str(num_processes)
+            env[ENV_PROCESS_ID] = str(i)
+            if cpu_devices_per_process is not None:
+                env[ENV_CPU_DEVICES] = str(cpu_devices_per_process)
+            if extra_env:
+                env.update(extra_env)
+            log = None
+            if i != 0:
+                log = tempfile.TemporaryFile(
+                    mode="w+", prefix=f"dtm-launch-{i}-"
+                )
+                logs.append(log)
+            procs.append(
+                subprocess.Popen(
+                    list(argv),
+                    env=env,
+                    stdout=None if i == 0 else log,
+                    stderr=None if i == 0 else subprocess.STDOUT,
+                )
+            )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        codes = []
+        for i, p in enumerate(procs):
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                raise subprocess.TimeoutExpired(argv, timeout)
+            p.wait(timeout=remaining)
+            codes.append(p.returncode)
+            if p.returncode != 0 and i != 0:
+                logs[i].seek(0)
+                sys.stderr.write(
+                    f"--- process {i} (exit {p.returncode}) ---\n"
+                    f"{logs[i].read()}\n"
+                )
+        return codes
+    except BaseException:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
+    finally:
+        for log in logs:
+            if log is not None:
+                log.close()
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distributed_tensorflow_models_tpu.launch",
+        description=(
+            "Launch a command as an N-process jax.distributed cluster. "
+            "Localhost mode spawns all processes; multi-host mode "
+            "(--process-id given) configures this process only — run the "
+            "same command on every host with its own --process-id, like "
+            "the reference's per-host launch scripts."
+        ),
+    )
+    parser.add_argument("--num-processes", type=int, required=True)
+    parser.add_argument(
+        "--coordinator",
+        default=f"127.0.0.1:{DEFAULT_PORT}",
+        help="host:port of process 0's coordination service",
+    )
+    parser.add_argument(
+        "--process-id",
+        type=int,
+        default=None,
+        help="multi-host mode: this host's process index; omit for "
+        "localhost mode (spawns all processes here)",
+    )
+    parser.add_argument(
+        "--cpu-devices-per-process",
+        type=int,
+        default=None,
+        help="force N fake CPU devices per process (test clusters)",
+    )
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given (append: -- python your_driver.py)")
+
+    host, sep, port_str = args.coordinator.rpartition(":")
+    if not sep or not port_str.isdigit():
+        parser.error(
+            f"--coordinator must be host:port, got {args.coordinator!r}"
+        )
+
+    if args.process_id is None:
+        if host not in ("127.0.0.1", "localhost"):
+            parser.error(
+                "localhost mode spawns every process here; a non-local "
+                f"--coordinator host ({host!r}) requires --process-id "
+                "(run once per host)"
+            )
+        codes = launch_local(
+            args.num_processes,
+            command,
+            port=int(port_str),
+            cpu_devices_per_process=args.cpu_devices_per_process,
+        )
+        return max(codes, default=0)
+
+    env = os.environ
+    env[ENV_COORDINATOR] = args.coordinator
+    env[ENV_NUM_PROCESSES] = str(args.num_processes)
+    env[ENV_PROCESS_ID] = str(args.process_id)
+    if args.cpu_devices_per_process is not None:
+        env[ENV_CPU_DEVICES] = str(args.cpu_devices_per_process)
+    os.execvp(command[0], command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -227,6 +227,67 @@ def test_fit_auto_resume(mesh8, tmp_path):
     assert int(result3.state.step) == 8
 
 
+def test_recoverable_fit_survives_injected_fault(mesh8, tmp_path):
+    """_RecoverableSession semantics (TF monitored_session.py:1261-1274):
+    a preemption-class failure mid-training restarts from the latest
+    checkpoint and completes, losing no checkpointed progress."""
+
+    class Preempted(ConnectionError):
+        pass
+
+    cfg = _small_cfg(train_steps=8)
+    fault = hooklib.FaultInjectionHook(5, lambda: Preempted("chip lost"))
+    result = trainlib.recoverable_fit(
+        cfg,
+        str(tmp_path),
+        mesh=mesh8,
+        max_restarts=2,
+        extra_hooks=[fault],
+    )
+    assert int(result.state.step) == 8
+    # The retry resumed from the crash-time save (step 5), not from zero.
+    assert result.steps_run == 3
+    mgr = ckptlib.CheckpointManager(str(tmp_path))
+    assert mgr.latest_step() == 8
+    mgr.close()
+
+
+def test_recoverable_fit_gives_up_after_max_restarts(mesh8, tmp_path):
+    class Preempted(ConnectionError):
+        pass
+
+    class AlwaysFault(hooklib.Hook):
+        def after_step(self, state, metrics, step):
+            raise Preempted("flaky every attempt")
+
+    cfg = _small_cfg(train_steps=8)
+    with pytest.raises(Preempted):
+        trainlib.recoverable_fit(
+            cfg,
+            str(tmp_path),
+            mesh=mesh8,
+            max_restarts=2,
+            extra_hooks=[AlwaysFault()],
+        )
+
+
+def test_recoverable_fit_does_not_catch_nan_guard(mesh8, tmp_path):
+    """A NaN trip is deterministic, not a preemption — restarting would
+    crash-loop, so it must propagate (SURVEY.md §5.5 NanTensorHook role)."""
+    cfg = _small_cfg(train_steps=4)
+
+    class Poison(hooklib.Hook):
+        def after_step(self, state, metrics, step):
+            if step == 2:
+                # What NanGuardHook raises on a non-finite loss.
+                raise FloatingPointError("loss is nan at step 2")
+
+    with pytest.raises(FloatingPointError):
+        trainlib.recoverable_fit(
+            cfg, str(tmp_path), mesh=mesh8, extra_hooks=[Poison()]
+        )
+
+
 def test_fit_then_eval_classification(mesh8, tmp_path):
     cfg = _small_cfg(train_steps=20)
     trainlib.fit(cfg, str(tmp_path), mesh=mesh8)

@@ -1,0 +1,125 @@
+"""Launcher tests: a real 2-process localhost cluster with cross-process
+collectives — the analogue of the reference's in-process fake-cluster
+protocol tests (TF server_lib.py:216-239 ``create_local_server``,
+SURVEY.md §4).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from distributed_tensorflow_models_tpu import launch
+
+WORKER = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from distributed_tensorflow_models_tpu import launch
+    assert launch.initialize_from_env(), "cluster env missing"
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_tensorflow_models_tpu.core import mesh as meshlib
+
+    pid = jax.process_index()
+    assert jax.process_count() == 2
+    mesh = meshlib.data_parallel_mesh()
+    n = len(jax.devices())
+    assert n == 4, jax.devices()
+
+    local = np.full((len(jax.local_devices()), 4), pid, np.float32)
+    arrs = [
+        jax.device_put(local[i : i + 1], d)
+        for i, d in enumerate(jax.local_devices())
+    ]
+    garr = jax.make_array_from_single_device_arrays(
+        (n, 4), NamedSharding(mesh, P("data")), arrs
+    )
+    total = jax.jit(
+        lambda x: x.sum(), out_shardings=NamedSharding(mesh, P())
+    )(garr)
+    val = float(jax.device_get(total))
+    # sum over 2 procs x 2 devices x 4 cols of process_index = 8
+    assert val == 8.0, val
+    if pid == 0:
+        open({marker!r}, "w").write(str(val))
+    """
+)
+
+
+def test_two_process_localhost_cluster_psum(tmp_path):
+    marker = str(tmp_path / "psum_ok")
+    script = tmp_path / "worker.py"
+    repo = os.path.dirname(
+        os.path.dirname(os.path.abspath(launch.__file__))
+    )
+    script.write_text(WORKER.format(repo=repo, marker=marker))
+
+    codes = launch.launch_local(
+        2,
+        [sys.executable, str(script)],
+        port=9753,
+        cpu_devices_per_process=2,
+        timeout=240,
+    )
+    assert codes == [0, 0]
+    assert open(marker).read() == "8.0"
+
+
+def test_initialize_from_env_without_cluster_env(monkeypatch):
+    for var in (
+        launch.ENV_COORDINATOR,
+        launch.ENV_NUM_PROCESSES,
+        launch.ENV_PROCESS_ID,
+        launch.ENV_CPU_DEVICES,
+    ):
+        monkeypatch.delenv(var, raising=False)
+    assert launch.initialize_from_env() is False
+
+
+def test_cli_requires_command():
+    with pytest.raises(SystemExit):
+        launch.main(["--num-processes", "2", "--"])
+
+
+def test_cli_multihost_mode_sets_env_and_execs(monkeypatch):
+    """--process-id mode must export the cluster facts then exec the
+    command (one process per host, reference launch-script style)."""
+    seen = {}
+
+    def fake_exec(prog, argv):
+        seen["prog"], seen["argv"] = prog, argv
+        raise SystemExit(0)
+
+    monkeypatch.setattr(os, "execvp", fake_exec)
+    # main() mutates os.environ before exec; keep the DTM_* facts from
+    # leaking into later tests (initialize_from_env would try to join a
+    # nonexistent cluster).
+    for var in (
+        launch.ENV_COORDINATOR,
+        launch.ENV_NUM_PROCESSES,
+        launch.ENV_PROCESS_ID,
+        launch.ENV_CPU_DEVICES,
+    ):
+        monkeypatch.delenv(var, raising=False)
+    with pytest.raises(SystemExit):
+        launch.main(
+            [
+                "--num-processes",
+                "4",
+                "--coordinator",
+                "10.0.0.1:1234",
+                "--process-id",
+                "3",
+                "--",
+                "python",
+                "driver.py",
+            ]
+        )
+    assert seen["argv"] == ["python", "driver.py"]
+    assert os.environ[launch.ENV_COORDINATOR] == "10.0.0.1:1234"
+    assert os.environ[launch.ENV_NUM_PROCESSES] == "4"
+    assert os.environ[launch.ENV_PROCESS_ID] == "3"
