@@ -1,0 +1,149 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis (GPipe-style).
+
+The reference has no pipeline parallelism (SURVEY.md §2.4: "out of parity
+scope; design note only") — this module is the framework's design-headroom
+implementation of that note, in the TPU-idiomatic form: the microbatch
+schedule is a ``lax.scan`` whose body computes one stage-step on every pipe
+rank simultaneously and rotates activations to the next rank with
+``lax.ppermute`` (compiled to ICI collective-permute).  No host-side
+scheduler, no per-stage processes — one compiled SPMD program, exactly like
+the rest of the framework (SURVEY.md §7.1).
+
+Model contract: a *uniform* stage function ``stage_fn(stage_params, x) -> y``
+(e.g. a transformer block, an MLP block, an LSTM layer) with per-stage
+parameters stacked on a leading axis of size ``n_stages``.  The stacked
+params shard over ``pipe`` so each device holds one stage's weights; the
+batch is split into microbatches that stream through the ring.
+
+Differentiability is free: ``ppermute`` has a transpose rule and the
+schedule is a ``scan``, so ``jax.grad`` through :func:`pipeline_apply`
+yields the full pipelined backward pass (GPipe's fill-drain schedule in
+reverse) with no hand-written gradient code.  Composes with the ``data``
+axis (microbatches themselves batch-sharded) and with remat
+(``jax.checkpoint`` on ``stage_fn``) for activation memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_models_tpu.core.mesh import AxisNames
+
+PyTree = jax.Array | dict | tuple | list
+
+
+def split_microbatches(batch: jax.Array, num_microbatches: int) -> jax.Array:
+    """[B, ...] → [M, B/M, ...].  B must divide evenly (static shapes —
+    ragged microbatches would force recompilation, SURVEY.md §7 XLA
+    semantics)."""
+    b = batch.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches {num_microbatches}"
+        )
+    return batch.reshape((num_microbatches, b // num_microbatches) + batch.shape[1:])
+
+
+def merge_microbatches(x: jax.Array) -> jax.Array:
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+def stack_stage_params(stage_params: list[PyTree]) -> PyTree:
+    """[per-stage pytrees] → one pytree with leading stage axis, ready to
+    shard over ``pipe`` (P('pipe', ...) on every leaf)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def pipeline_spec(params_stacked: PyTree, axis: str = AxisNames.PIPE):
+    """PartitionSpecs placing each stage's weights on its pipe rank."""
+    return jax.tree.map(lambda _: P(axis), params_stacked)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    params_stacked: PyTree,
+    microbatches: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = AxisNames.PIPE,
+    data_axis: str | None = AxisNames.DATA,
+):
+    """Run ``microbatches`` [M, mb, ...] through the stage pipeline.
+
+    Schedule: ``M + n_stages - 1`` ticks.  At tick ``t`` every rank applies
+    its stage to its current activation, then activations rotate one rank
+    forward; rank 0 ingests microbatch ``t`` (while valid) and the last
+    rank's outputs are collected from tick ``n_stages - 1`` on.  The bubble
+    fraction is the usual GPipe ``(n-1)/(M+n-1)`` — pick ``M >= 4n`` to
+    amortise.
+
+    Composition with data parallelism is real, not nominal: the microbatch
+    *batch* dimension shards over ``data_axis`` (each data slice pipelines
+    its own slice of every microbatch; ``mb`` must divide the data-axis
+    size), while stage weights shard over ``axis``.  Pass
+    ``data_axis=None`` to replicate over data instead.
+
+    Returns [M, mb, ...] outputs (sharded over ``data_axis``, replicated
+    over ``axis``).
+    """
+    n_stages = mesh.shape[axis]
+    num_mb = microbatches.shape[0]
+    total_ticks = num_mb + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def per_device(params, mbs):
+        # params: [1, ...] — this rank's slice of the stage axis.
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        rank = lax.axis_index(axis)
+        # The carry is device-varying from tick 1 on (rank-dependent
+        # values); mark the zero init varying up front so the scan carry
+        # type is stable (same pattern as ring attention's carries).
+        state = lax.pcast(jnp.zeros_like(mbs[0]), axis, to="varying")
+
+        def tick(state, t):
+            # Rank 0 ingests microbatch t (clamped; masked when t >= M).
+            feed = mbs[jnp.minimum(t, num_mb - 1)]
+            x = jnp.where(rank == 0, feed, state)
+            y = stage_fn(params, x)
+            return lax.ppermute(y, axis, perm), y
+
+        _, ys = lax.scan(tick, state, jnp.arange(total_ticks))
+        # The last rank emitted microbatch m's result at tick m+n_stages-1:
+        # a static slice of the scan's stacked outputs.
+        outs = ys[n_stages - 1 :]
+        # Replicate over the ring: zero every rank but the last, then psum.
+        outs = jnp.where(rank == n_stages - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(outs, axis)
+
+    mb_spec = P(None, data_axis) if data_axis else P()
+    fn = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(pipeline_spec(params_stacked, axis), mb_spec),
+        out_specs=mb_spec,
+    )
+    return fn(params_stacked, microbatches)
+
+
+def sequential_apply(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    params_stacked: PyTree,
+    microbatches: jax.Array,
+) -> jax.Array:
+    """Reference semantics for tests/single-device: the same stages applied
+    back-to-back with no pipelining."""
+    n_stages = jax.tree_util.tree_leaves(params_stacked)[0].shape[0]
+
+    def one_mb(x):
+        for i in range(n_stages):
+            p = jax.tree.map(lambda q: q[i], params_stacked)
+            x = stage_fn(p, x)
+        return x
+
+    return jax.vmap(one_mb)(microbatches)

@@ -1,0 +1,108 @@
+"""Pipeline parallelism: the GPipe scan/ppermute schedule must match
+sequential stage application exactly — forward and gradient — and compose
+with the data axis."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu.core import mesh as meshlib
+from distributed_tensorflow_models_tpu.parallel import pipeline as pp
+
+N_STAGES = 4
+MB = 8  # microbatches
+MBS = 4  # microbatch size
+DIM = 16
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+@pytest.fixture(scope="module")
+def pipe_mesh():
+    return meshlib.create_mesh(meshlib.MeshSpec(data=2, pipe=N_STAGES))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.RandomState(0)
+    stages = [
+        {
+            "w": jnp.asarray(
+                rng.randn(DIM, DIM).astype(np.float32) / np.sqrt(DIM)
+            ),
+            "b": jnp.asarray(rng.randn(DIM).astype(np.float32) * 0.1),
+        }
+        for _ in range(N_STAGES)
+    ]
+    params = pp.stack_stage_params(stages)
+    x = jnp.asarray(rng.randn(MB * MBS, DIM).astype(np.float32))
+    return params, x
+
+
+def test_split_merge_roundtrip(setup):
+    _, x = setup
+    mbs = pp.split_microbatches(x, MB)
+    assert mbs.shape == (MB, MBS, DIM)
+    np.testing.assert_array_equal(pp.merge_microbatches(mbs), x)
+    with pytest.raises(ValueError):
+        pp.split_microbatches(x, 7)
+
+
+def test_pipeline_forward_matches_sequential(pipe_mesh, setup):
+    params, x = setup
+    mbs = pp.split_microbatches(x, MB)
+    ref = pp.sequential_apply(stage_fn, params, mbs)
+    out = jax.jit(
+        lambda p, m: pp.pipeline_apply(stage_fn, p, m, mesh=pipe_mesh)
+    )(params, mbs)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_pipeline_gradient_matches_sequential(pipe_mesh, setup):
+    """jax.grad through the scan/ppermute schedule == the unpipelined
+    gradient: GPipe backward for free via transpose rules."""
+    params, x = setup
+    mbs = pp.split_microbatches(x, MB)
+    target = jnp.ones((MB, MBS, DIM)) * 0.3
+
+    def loss_pipe(p):
+        out = pp.pipeline_apply(stage_fn, p, mbs, mesh=pipe_mesh)
+        return jnp.mean((out - target) ** 2)
+
+    def loss_seq(p):
+        out = pp.sequential_apply(stage_fn, p, mbs)
+        return jnp.mean((out - target) ** 2)
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    g_seq = jax.jit(jax.grad(loss_seq))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        ),
+        g_pipe,
+        g_seq,
+    )
+
+
+def test_pipeline_trains(pipe_mesh, setup):
+    """A few SGD steps through the pipelined loss must reduce it."""
+    params, x = setup
+    mbs = pp.split_microbatches(x, MB)
+    target = jnp.tanh(jnp.roll(x, 1, axis=-1)).reshape(MB, MBS, DIM)
+
+    def loss(p):
+        out = pp.pipeline_apply(stage_fn, p, mbs, mesh=pipe_mesh)
+        return jnp.mean((out - target) ** 2)
+
+    vg = jax.jit(jax.value_and_grad(loss))
+    l0, _ = vg(params)
+    for _ in range(12):
+        l, g = vg(params)
+        params = jax.tree.map(lambda p, d: p - 0.3 * d, params, g)
+    l_final, _ = vg(params)
+    assert float(l_final) < float(l0) * 0.7
