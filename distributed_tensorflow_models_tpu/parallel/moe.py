@@ -1,0 +1,212 @@
+"""Expert parallelism: Switch-style mixture-of-experts over the ``expert``
+mesh axis.
+
+The reference has no MoE (SURVEY.md §2.4: "out of scope") — like
+``parallel/pipeline.py`` this is the framework's design-headroom layer for
+the reserved ``expert`` axis, in the TPU-native form: expert FFN weights
+shard one-expert-per-rank over ``expert``; tokens are exchanged with
+``lax.all_to_all`` (compiled to ICI all-to-all), each rank runs its expert
+on the tokens routed to it, and a second all-to-all returns them.  One
+compiled SPMD program, no parameter servers, no host-side routing.
+
+Router: top-1 ("switch") gating with a per-expert capacity.  Tokens over
+capacity are *dropped* (their combine weight is zero and the residual path
+carries them) — the standard Switch-Transformer trade that keeps every
+shape static for XLA (SURVEY.md §7: no dynamic shapes).  The auxiliary
+load-balancing loss (fraction-dispatched x mean-gate per expert, scaled by
+E) is returned for the caller to add to the task loss.
+
+Everything is differentiable: ``all_to_all`` has a transpose rule, routing
+uses one-hot matmuls, and capacity masking is a multiply.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_models_tpu.core.mesh import AxisNames
+
+class MoEOutput(NamedTuple):
+    out: jax.Array  # [tokens, d_model] combined expert outputs
+    aux_loss: jax.Array  # scalar load-balancing loss
+    dropped_fraction: jax.Array  # scalar diagnostics
+
+
+def init_moe_params(
+    rng: jax.Array, num_experts: int, d_model: int, d_ff: int
+) -> dict:
+    """Per-expert FFN (w_in [E, d, f], w_out [E, f, d]) + router [d, E].
+    Shard the expert-stacked leaves over ``expert`` with
+    :func:`moe_param_spec`."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale_in = 1.0 / jnp.sqrt(d_model)
+    scale_out = 1.0 / jnp.sqrt(d_ff)
+    return {
+        "router": jax.random.normal(k1, (d_model, num_experts)) * scale_in,
+        "w_in": jax.random.normal(k2, (num_experts, d_model, d_ff))
+        * scale_in,
+        "w_out": jax.random.normal(k3, (num_experts, d_ff, d_model))
+        * scale_out,
+    }
+
+
+def moe_param_spec(axis: str = AxisNames.EXPERT) -> dict:
+    return {
+        "router": P(),
+        "w_in": P(axis),
+        "w_out": P(axis),
+    }
+
+
+def _route_local(x, router, num_experts: int, capacity: int):
+    """Top-1 routing of local tokens [n, d] → dispatch/combine tensors.
+
+    Returns (dispatch [n, E, C] 0/1, combine [n, E, C] gate-weighted,
+    aux_loss, dropped_fraction).  Position within an expert's capacity is
+    assigned in token order (cumsum), matching the Switch reference.
+    """
+    n = x.shape[0]
+    logits = x @ router  # [n, E] — router always in f32 for stable softmax
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [n]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)
+    # Position of each token in its expert's queue (0-based).
+    position = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [n, E]
+    pos = jnp.sum(position, axis=-1).astype(jnp.int32)  # [n]
+    # one_hot of an out-of-range pos is an all-zero row, which IS the
+    # capacity mask: over-capacity tokens get a zero dispatch slot.
+    pos_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+    dispatch_nec = onehot[:, :, None] * pos_onehot[:, None, :]  # [n,E,C]
+    combine_nec = dispatch_nec * gate[:, None, None]
+
+    # Switch aux loss: E * sum_e fraction_tokens(e) * mean_prob(e).
+    fraction = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(fraction * mean_prob)
+    dropped = 1.0 - jnp.sum(dispatch_nec) / n
+    return dispatch_nec, combine_nec, aux, dropped
+
+
+def moe_ffn(
+    params: dict,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    capacity_factor: float = 1.25,
+    axis: str = AxisNames.EXPERT,
+    activation=jax.nn.relu,
+) -> MoEOutput:
+    """Expert-parallel Switch FFN over tokens ``x`` [tokens, d_model].
+
+    Tokens shard over ``axis`` (each expert rank also holds a token shard
+    — the standard EP layout where the same devices carry both roles);
+    expert weights shard one-per-rank.  Two ``all_to_all`` collectives move
+    each token to its expert and back.
+    """
+    num_experts = params["w_in"].shape[0]
+    e_size = mesh.shape[axis]
+    if num_experts % e_size:
+        raise ValueError(
+            f"num_experts {num_experts} not divisible by expert axis {e_size}"
+        )
+    tokens = x.shape[0]
+    if tokens % e_size:
+        raise ValueError(
+            f"tokens {tokens} not divisible by expert axis {e_size}"
+        )
+    local_tokens = tokens // e_size
+    capacity = max(
+        1, int(capacity_factor * local_tokens / num_experts)
+    )
+
+    def per_device(params, x_local):
+        experts_local = num_experts // e_size
+        dispatch, combine, aux, dropped = _route_local(
+            x_local.astype(jnp.float32),
+            params["router"],
+            num_experts,
+            capacity,
+        )
+        # Gather expert inputs: [E, C, d] on the source rank...
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, x_local)
+        # ...reshape to [e_size, experts_local, C, d] and exchange so rank r
+        # receives every source's slots for its local experts.
+        expert_in = expert_in.reshape(
+            e_size, experts_local, capacity, -1
+        )
+        recv = lax.all_to_all(
+            expert_in, axis, split_axis=0, concat_axis=0, tiled=False
+        )  # [e_size(source), experts_local, C, d]
+
+        w_in = params["w_in"]  # [experts_local, d, f] (sharded slice)
+        w_out = params["w_out"]
+        h = activation(jnp.einsum("slcd,ldf->slcf", recv, w_in))
+        expert_out = jnp.einsum("slcf,lfd->slcd", h, w_out)
+
+        # Send results back to their source ranks.
+        back = lax.all_to_all(
+            expert_out, axis, split_axis=0, concat_axis=0, tiled=False
+        )  # [e_size(expert-group), experts_local, C, d]
+        back = back.reshape(num_experts, capacity, -1)
+        out = jnp.einsum("nec,ecd->nd", combine, back)
+        aux = lax.pmean(aux, axis)
+        dropped = lax.pmean(dropped, axis)
+        return out.astype(x_local.dtype), aux, dropped
+
+    fn = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(moe_param_spec(axis), P(axis)),
+        out_specs=(P(axis), P(), P()),
+    )
+    out, aux, dropped = fn(params, x)
+    return MoEOutput(out=out, aux_loss=aux, dropped_fraction=dropped)
+
+
+def moe_ffn_reference(
+    params: dict,
+    x: jax.Array,
+    *,
+    num_ranks: int,
+    capacity_factor: float = 1.25,
+    activation=jax.nn.relu,
+) -> MoEOutput:
+    """Single-device oracle with identical routing/capacity semantics
+    (including the per-source-rank capacity accounting EP implies):
+    processes the token shards rank-by-rank exactly as the EP layout
+    would."""
+    num_experts = params["w_in"].shape[0]
+    tokens = x.shape[0]
+    local_tokens = tokens // num_ranks
+    capacity = max(1, int(capacity_factor * local_tokens / num_experts))
+
+    outs, auxes, drops = [], [], []
+    for r in range(num_ranks):
+        xl = x[r * local_tokens : (r + 1) * local_tokens].astype(
+            jnp.float32
+        )
+        dispatch, combine, aux, dropped = _route_local(
+            xl, params["router"], num_experts, capacity
+        )
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, xl)
+        h = activation(
+            jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"])
+        )
+        expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+        outs.append(
+            jnp.einsum("nec,ecd->nd", combine, expert_out).astype(x.dtype)
+        )
+        auxes.append(aux)
+        drops.append(dropped)
+    return MoEOutput(
+        out=jnp.concatenate(outs, axis=0),
+        aux_loss=jnp.mean(jnp.stack(auxes)),
+        dropped_fraction=jnp.mean(jnp.stack(drops)),
+    )

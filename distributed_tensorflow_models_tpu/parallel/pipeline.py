@@ -25,7 +25,6 @@ axis (microbatches themselves batch-sharded) and with remat
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax
@@ -107,7 +106,12 @@ def pipeline_apply(
         state = lax.pcast(jnp.zeros_like(mbs[0]), axis, to="varying")
 
         def tick(state, t):
-            # Rank 0 ingests microbatch t (clamped; masked when t >= M).
+            # Rank 0 ingests microbatch t; drain ticks (t >= M) re-feed a
+            # clamped duplicate of microbatch M-1.  That duplicate is never
+            # masked — it is correct only because it cannot reach the last
+            # rank within total_ticks, so its outputs fall outside the
+            # ys[n_stages-1:] collection window below.  Extending the scan
+            # or collecting from another rank would break this invariant.
             feed = mbs[jnp.minimum(t, num_mb - 1)]
             x = jnp.where(rank == 0, feed, state)
             y = stage_fn(params, x)
