@@ -144,6 +144,41 @@ class MetricWriterHook(Hook):
             f.write(json.dumps(row) + "\n")
 
 
+class TensorBoardHook(Hook):
+    """Scalar summaries into TensorBoard event files every ``every_steps``
+    (default 100, the reference's SummarySaverHook cadence — TF
+    monitored_session.py:517-518), via the no-TF writer in
+    :mod:`harness.summary`."""
+
+    def __init__(self, workdir: str, every_steps: int = 100):
+        # Chief-only, like the reference's SummarySaverHook (TF
+        # monitored_session.py:566-609 chief hooks) — non-zero processes
+        # would write duplicate event streams.
+        self._writer = None
+        if jax.process_index() == 0:
+            from distributed_tensorflow_models_tpu.harness.summary import (
+                SummaryWriter,
+            )
+
+            self._writer = SummaryWriter(
+                os.path.join(workdir, "tensorboard")
+            )
+        self._every = every_steps
+
+    def after_step(self, state, metrics, step):
+        if self._writer is None or step % self._every:
+            return
+        self._writer.scalars(step, metrics)
+        # Flush each write (log-cadence, ~50 bytes): a live TensorBoard
+        # sees events immediately and a preemption (SIGKILL skips end())
+        # loses nothing buffered.
+        self._writer.flush()
+
+    def end(self, state):
+        if self._writer is not None:
+            self._writer.close()
+
+
 class CheckpointHook(Hook):
     """Save every ``every_secs`` (default 600 s, the reference's
     CheckpointSaverHook default — TF monitored_session.py:525-528) and at

@@ -172,6 +172,7 @@ def fit(
         hooklib.NanGuardHook(cfg.log_every_steps),
         hooklib.LoggingHook(cfg.log_every_steps, keys=("loss",)),
         hooklib.MetricWriterHook(workdir, cfg.log_every_steps),
+        hooklib.TensorBoardHook(workdir, cfg.log_every_steps),
         hooklib.CheckpointHook(
             save_fn, every_secs=cfg.checkpoint_every_secs
         ),

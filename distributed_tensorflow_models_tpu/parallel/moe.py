@@ -184,6 +184,10 @@ def moe_ffn_reference(
     would."""
     num_experts = params["w_in"].shape[0]
     tokens = x.shape[0]
+    if tokens % num_ranks:
+        raise ValueError(
+            f"tokens {tokens} not divisible by num_ranks {num_ranks}"
+        )
     local_tokens = tokens // num_ranks
     capacity = max(1, int(capacity_factor * local_tokens / num_experts))
 

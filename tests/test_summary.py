@@ -1,0 +1,68 @@
+"""Summary writer: our hand-encoded event files must be readable by
+TensorFlow's own summary_iterator — the strongest available oracle that
+TensorBoard will load them (SURVEY.md §5.1/§5.5)."""
+
+import glob
+import os
+
+import pytest
+
+from distributed_tensorflow_models_tpu.harness.summary import SummaryWriter
+
+
+def test_scalars_round_trip_through_tf_reader(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    with SummaryWriter(tmp_path) as w:
+        w.scalar("loss", 2.5, step=1)
+        w.scalars(2, {"loss": 1.25, "accuracy": 0.5})
+        path = w.path
+
+    events = list(tf.compat.v1.train.summary_iterator(path))
+    assert events[0].file_version == "brain.Event:2"
+    assert events[0].wall_time > 0
+
+    e1 = events[1]
+    assert e1.step == 1
+    assert {v.tag: v.simple_value for v in e1.summary.value} == {"loss": 2.5}
+
+    e2 = events[2]
+    assert e2.step == 2
+    got = {v.tag: round(v.simple_value, 6) for v in e2.summary.value}
+    assert got == {"loss": 1.25, "accuracy": 0.5}
+
+
+def test_non_numeric_values_skipped(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    with SummaryWriter(tmp_path) as w:
+        w.scalars(1, {"loss": 1.0, "junk": object()})
+        path = w.path
+    events = list(tf.compat.v1.train.summary_iterator(path))
+    tags = {v.tag for v in events[1].summary.value}
+    assert tags == {"loss"}
+
+
+def test_fit_writes_tensorboard_events(mesh8, tmp_path):
+    from distributed_tensorflow_models_tpu.harness import (
+        config as configlib,
+        train as trainlib,
+    )
+
+    cfg = configlib.get_config(
+        "lenet_mnist",
+        train_steps=4,
+        global_batch_size=32,
+        log_every_steps=2,
+        checkpoint_every_secs=10_000.0,
+    )
+    trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    files = glob.glob(
+        os.path.join(tmp_path, "tensorboard", "events.out.tfevents.*")
+    )
+    assert files, "no event files written"
+    tf = pytest.importorskip("tensorflow")
+    events = list(tf.compat.v1.train.summary_iterator(files[0]))
+    scalar_events = [e for e in events if len(e.summary.value)]
+    assert scalar_events, "no scalar events"
+    tags = {v.tag for e in scalar_events for v in e.summary.value}
+    assert "loss" in tags
