@@ -67,6 +67,24 @@ def main(argv: list[str] | None = None) -> int:
         help="re-evaluate as new checkpoints appear",
     )
     p_eval.add_argument("--max-batches", type=int, default=None)
+    p_ab = sub.add_parser(
+        "ab",
+        help="async-PS vs sync-replica comparison (the reference's "
+        "flagship experiment)",
+    )
+    p_ab.add_argument("--config", required=True)
+    p_ab.add_argument("--steps", type=int, default=50)
+    p_ab.add_argument("--async-workers", type=int, default=4)
+    p_ab.add_argument(
+        "--schedule", choices=("round_robin", "random"), default="round_robin"
+    )
+    p_ab.add_argument("--staleness-limit", type=int, default=None)
+    p_ab.add_argument("--batch-size", type=int, default=None)
+    p_ab.add_argument("--seed", type=int, default=None)
+    p_ab.add_argument("--mesh-model", type=int, default=None)
+    p_ab.add_argument("--multihost", action="store_true")
+    # Shared override plumbing (_overrides) expects these attributes.
+    p_ab.set_defaults(train_steps=None, workdir=None)
     sub.add_parser("list", help="list available configs")
     args = parser.parse_args(argv)
 
@@ -91,6 +109,19 @@ def main(argv: list[str] | None = None) -> int:
         meshlib.initialize_multihost()
 
     cfg = get_config(args.config, **_overrides(args))
+
+    if args.cmd == "ab":
+        from distributed_tensorflow_models_tpu.harness import experiment
+
+        result = experiment.async_vs_sync(
+            cfg,
+            args.steps,
+            num_workers=args.async_workers,
+            schedule=args.schedule,
+            staleness_limit=args.staleness_limit,
+        )
+        print(json.dumps(result.to_json()))
+        return 0
 
     if args.cmd == "train":
         from distributed_tensorflow_models_tpu.harness import train as trainlib

@@ -1,0 +1,166 @@
+"""The reference's flagship experiment: async-PS vs sync-replica A/B.
+
+SURVEY.md §2.1 R6 / §2.4: the whole point of the reference repo is the
+comparison between asynchronous parameter-server training and synchronous
+replica training on the same model and data [B:10].  This module packages
+that A/B as a first-class harness call (and ``cli.py ab`` subcommand): the
+same config, init, and batch stream run through
+
+- the **sync** path — the compiled SPMD step, gradient mean as one psum
+  (SURVEY.md §3.1-§3.2 collapsed), and
+- the **async** path — :class:`parallel.async_ps.AsyncPSEmulator` with K
+  virtual workers applying gradients in arrival order with logged
+  staleness (SURVEY.md §3.3, §7.6),
+
+and reports final losses, per-mode wall time, and the async staleness
+profile.  With ``num_workers=1`` the async trajectory reproduces the sync
+trajectory exactly (pinned by tests/test_parallel.py), so the A/B is
+apples-to-apples by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_models_tpu.core import mesh as meshlib
+from distributed_tensorflow_models_tpu.core import sharding as shardlib
+from distributed_tensorflow_models_tpu.core import train_loop
+from distributed_tensorflow_models_tpu.harness import train as trainlib
+from distributed_tensorflow_models_tpu.harness.config import ExperimentConfig
+from distributed_tensorflow_models_tpu.parallel.async_ps import (
+    AsyncConfig,
+    AsyncPSEmulator,
+)
+
+
+@dataclasses.dataclass
+class ABResult:
+    sync_losses: list[float]
+    async_losses: list[float]
+    sync_seconds: float
+    async_seconds: float
+    mean_staleness: float
+    dropped: int
+
+    def to_json(self) -> dict:
+        return {
+            "sync": {
+                "final_loss": self.sync_losses[-1],
+                "losses": self.sync_losses,
+                "seconds": round(self.sync_seconds, 3),
+            },
+            "async": {
+                "final_loss": self.async_losses[-1],
+                "losses": self.async_losses,
+                "seconds": round(self.async_seconds, 3),
+                "mean_staleness": round(self.mean_staleness, 3),
+                "dropped": self.dropped,
+            },
+        }
+
+
+def _loss_fn(cfg: ExperimentConfig, state):
+    if cfg.task == "lm":
+        return train_loop.lm_loss_fn(state.apply_fn)
+    return train_loop.classification_loss_fn(
+        state.apply_fn,
+        label_smoothing=cfg.label_smoothing,
+        weight_decay=cfg.weight_decay,
+        aux_loss_weight=cfg.aux_loss_weight,
+    )
+
+
+def async_vs_sync(
+    cfg: ExperimentConfig,
+    steps: int,
+    *,
+    num_workers: int = 4,
+    schedule: str = "round_robin",
+    staleness_limit: Optional[int] = None,
+    mesh=None,
+) -> ABResult:
+    """Run ``steps`` updates in each mode from an identical init and batch
+    stream; returns the paired trajectories."""
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if mesh is None:
+        mesh = meshlib.create_mesh(
+            meshlib.MeshSpec(data=cfg.mesh_data, model=cfg.mesh_model)
+        )
+    rng = jax.random.key(cfg.seed + 1)
+
+    # One materialised batch stream, replayed identically in both modes.
+    # Finite datasets (single-pass TFRecord readers) wrap around — the A/B
+    # needs `steps` batches regardless of epoch boundaries.
+    dataset = trainlib.build_dataset(cfg, "train")
+    batches = []
+    it = iter(dataset)
+    for _ in range(steps):
+        try:
+            batches.append(next(it))
+        except StopIteration:
+            it = iter(dataset)
+            try:
+                batches.append(next(it))
+            except StopIteration:
+                raise ValueError("dataset yielded no batches") from None
+    if hasattr(dataset, "close"):
+        dataset.close()
+
+    sharded = [shardlib.shard_batch(mesh, b) for b in batches]
+
+    # -- sync ---------------------------------------------------------
+    state = trainlib.build_state(cfg, mesh)
+    loss_fn = _loss_fn(cfg, state)
+    step_fn = train_loop.make_train_step(loss_fn)
+    # Warmup compiles the step before the clock starts, so 'seconds'
+    # compares steady-state mode cost, not compile counts.  The train
+    # step is functional — discarding the warmup outputs leaves the
+    # trajectory untouched.
+    jax.block_until_ready(step_fn(state, sharded[0], rng))
+    sync_losses = []
+    t0 = time.perf_counter()
+    for b in sharded:
+        state, metrics = step_fn(state, b, rng)
+        sync_losses.append(float(metrics["loss"]))
+    sync_seconds = time.perf_counter() - t0
+
+    # -- async --------------------------------------------------------
+    state = trainlib.build_state(cfg, mesh)
+    emu = AsyncPSEmulator(
+        state,
+        loss_fn,
+        AsyncConfig(
+            num_workers=num_workers,
+            schedule=schedule,
+            seed=cfg.seed,
+            staleness_limit=staleness_limit,
+        ),
+    )
+    # Warmup the emulator's grad/apply programs without touching its
+    # event state (direct calls, results discarded).
+    w_grads, w_aux = emu._grad(
+        emu.workers[0].params, emu.state, sharded[0], rng, 0
+    )
+    jax.block_until_ready(emu._apply(emu.state, w_grads, w_aux))
+    async_losses = []
+    t0 = time.perf_counter()
+    for b in sharded:
+        rec = emu.step(b, rng)
+        async_losses.append(float(rec["metrics"]["loss"]))
+    async_seconds = time.perf_counter() - t0
+
+    assert np.isfinite(sync_losses).all() and np.isfinite(async_losses).all()
+    return ABResult(
+        sync_losses=sync_losses,
+        async_losses=async_losses,
+        sync_seconds=sync_seconds,
+        async_seconds=async_seconds,
+        mean_staleness=emu.mean_staleness,
+        dropped=emu.dropped,
+    )

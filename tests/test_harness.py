@@ -319,6 +319,50 @@ def test_fit_lm_and_eval(mesh8, tmp_path):
     assert np.isfinite(res.metrics["perplexity"])
 
 
+def test_async_vs_sync_ab_experiment(mesh8):
+    """The reference's flagship A/B ([B:10], SURVEY.md §2.4) as a harness
+    call: same init + batch stream through both modes."""
+    from distributed_tensorflow_models_tpu.harness import experiment
+
+    cfg = _small_cfg(train_steps=12)
+    res = experiment.async_vs_sync(
+        cfg, 12, num_workers=2, mesh=mesh8
+    )
+    assert len(res.sync_losses) == 12 and len(res.async_losses) == 12
+    assert np.isfinite(res.sync_losses).all()
+    assert np.isfinite(res.async_losses).all()
+    # Both modes learn on the easy synthetic stream (per-event losses are
+    # noisy — stale-parameter forwards — so compare half-means).
+    assert np.mean(res.sync_losses[-4:]) < np.mean(res.sync_losses[:4])
+    assert np.mean(res.async_losses[-4:]) < np.mean(res.async_losses[:4])
+    # Round-robin with 2 workers: steady-state staleness 1.
+    assert res.mean_staleness > 0
+    j = res.to_json()
+    assert set(j) == {"sync", "async"}
+    assert j["async"]["mean_staleness"] > 0
+
+
+def test_cli_ab_subcommand(mesh8, capsys):
+    from distributed_tensorflow_models_tpu.harness import cli
+
+    rc = cli.main(
+        [
+            "ab",
+            "--config",
+            "lenet_mnist",
+            "--steps",
+            "4",
+            "--async-workers",
+            "2",
+            "--batch-size",
+            "32",
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "sync" in out and "async" in out
+
+
 def test_zaremba_schedule():
     sched = optim.zaremba_decay(1.0, steps_per_epoch=10, hold_epochs=4,
                                 decay_rate=0.5)

@@ -105,6 +105,17 @@ def test_inception_v3_train_returns_aux():
     assert aux.shape == (1, 5)
 
 
+def test_resnet50_param_count():
+    model = get_model("resnet50", dtype=jnp.float32)
+    shapes = jax.eval_shape(
+        lambda rng: model.init(rng, jnp.zeros((1, 224, 224, 3))),
+        jax.random.key(0),
+    )
+    count = n_params(shapes["params"])
+    # Canonical ResNet-50 v1: 25,557,032 (conv/fc weights + BN affine).
+    assert abs(count - 25_557_032) / 25_557_032 < 0.01, count
+
+
 def test_vgg16_param_count():
     model = get_model("vgg16", dtype=jnp.float32)
     shapes = jax.eval_shape(
