@@ -39,6 +39,18 @@ class ArrayDataset:
 
     ``transform(image, rng) -> image`` runs per sample with an rng derived
     from ``(seed, epoch, sample_position)`` — deterministic augmentation.
+
+    Multi-host (SURVEY.md §3.4 — each reference worker feeds its own input
+    stream): ``batch_size`` stays the *global* batch; with
+    ``process_count > 1`` each process materializes only its
+    ``batch_size/process_count`` row block of every global batch, drawn from
+    the same seeded permutation.  Process blocks are disjoint and their
+    process-order concatenation reproduces the single-process batch exactly
+    (``shard_batch`` assembles them in process order), so a multi-process
+    run is trajectory-identical to a single-process run at the same global
+    batch — the property the 2-process launcher test pins.  Augmentation
+    rngs are keyed by *global* sample position, so this holds under
+    transforms too.
     """
 
     def __init__(
@@ -51,13 +63,24 @@ class ArrayDataset:
         transform: Optional[Callable] = None,
         transform_key: str = "image",
         drop_remainder: bool = True,
+        process_index: int = 0,
+        process_count: int = 1,
     ):
         sizes = {k: len(v) for k, v in arrays.items()}
         if len(set(sizes.values())) != 1:
             raise ValueError(f"mismatched array lengths {sizes}")
+        if batch_size % process_count:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by "
+                f"process count {process_count}"
+            )
+        if not 0 <= process_index < process_count:
+            raise ValueError(f"bad process {process_index}/{process_count}")
         self._arrays = arrays
         self._n = next(iter(sizes.values()))
         self._batch_size = batch_size
+        self._local_batch = batch_size // process_count
+        self._local_lo = process_index * self._local_batch
         self._shuffle = shuffle
         self._seed = seed
         self._transform = transform
@@ -89,8 +112,8 @@ class ArrayDataset:
         while True:
             perm = self._perm()
             while self._batch_idx < self.batches_per_epoch:
-                lo = self._batch_idx * self._batch_size
-                idx = perm[lo : lo + self._batch_size]
+                lo = self._batch_idx * self._batch_size + self._local_lo
+                idx = perm[lo : lo + self._local_batch]
                 batch = {k: v[idx] for k, v in self._arrays.items()}
                 if self._transform is not None:
                     key = self._transform_key
@@ -153,15 +176,32 @@ def load_cifar10(split: str = "train") -> tuple[np.ndarray, np.ndarray]:
     return _synthetic_images(n, 32, 32, 3, 10, seed=3 if split == "train" else 4)
 
 
-def mnist_dataset(batch_size: int, split: str = "train", seed: int = 0):
+def mnist_dataset(
+    batch_size: int,
+    split: str = "train",
+    seed: int = 0,
+    *,
+    process_index: int = 0,
+    process_count: int = 1,
+):
     x, y = load_mnist(split)
     return ArrayDataset(
-        {"image": x, "label": y}, batch_size, shuffle=split == "train", seed=seed
+        {"image": x, "label": y},
+        batch_size,
+        shuffle=split == "train",
+        seed=seed,
+        process_index=process_index,
+        process_count=process_count,
     )
 
 
 def cifar10_dataset(
-    batch_size: int, split: str = "train", seed: int = 0
+    batch_size: int,
+    split: str = "train",
+    seed: int = 0,
+    *,
+    process_index: int = 0,
+    process_count: int = 1,
 ):
     x, y = load_cifar10(split)
     transform = (
@@ -175,6 +215,8 @@ def cifar10_dataset(
         shuffle=split == "train",
         seed=seed,
         transform=transform,
+        process_index=process_index,
+        process_count=process_count,
     )
 
 
@@ -189,6 +231,22 @@ class ImageNetTFRecordDataset:
     Record schema (inception convention): ``image/encoded`` JPEG bytes,
     ``image/class/label`` int64 (1-based in the reference's shards —
     ``label_offset`` subtracts it away), optional ``image/object/bbox/*``.
+
+    Multi-host, the reference's per-worker reader model (SURVEY.md §3.4,
+    [TF input.py:1089] — each worker's ``string_input_producer`` consumes
+    its own shard files):
+
+    - **train**: shard files round-robin by process
+      (``paths[process_index::process_count]``); each process decodes and
+      yields only its ``batch_size/process_count`` slice of the global
+      batch.  Falls back to replicated-read row-slicing when there are
+      fewer shard files than processes.
+    - **eval**: every process reads *all* files (one deterministic pass —
+      the counting loop of SURVEY.md §3.5 needs a stable global record
+      order) and yields its row block of each global batch; the final
+      partial batch is padded to the full global size with ``label=-1``
+      rows (masked by the padded-batch counting, core/train_loop.py) so
+      every process yields equal shapes.
     """
 
     def __init__(
@@ -201,14 +259,31 @@ class ImageNetTFRecordDataset:
         seed: int = 0,
         label_offset: int = 0,
         native: bool | None = None,
+        process_index: int = 0,
+        process_count: int = 1,
     ):
+        if batch_size % process_count:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by "
+                f"process count {process_count}"
+            )
+        self._local_batch = batch_size // process_count
+        self._process_index = process_index
+        self._process_count = process_count
+        # File-sharded mode: this process's stream IS its slice of the
+        # global batch, so only local_batch records are decoded per step.
+        self._file_sharded = (
+            train and process_count > 1 and len(paths) >= process_count
+        )
+        if self._file_sharded:
+            paths = list(paths)[process_index::process_count]
         # Eval is exactly one pass (the reference eval loop counts over the
         # validation set once per checkpoint, SURVEY.md §3.5); training
         # loops epochs forever.
         self._records = tfrecord.ShardedRecordIterator(
             paths,
             shuffle_shards=train,
-            seed=seed,
+            seed=seed + (process_index if self._file_sharded else 0),
             native=native,
             num_epochs=None if train else 1,
         )
@@ -242,7 +317,14 @@ class ImageNetTFRecordDataset:
                 np.float32,
             )
         if self._train:
-            rng = np.random.default_rng((self._seed, self._count))
+            # Replicated modes key by global record count so every process
+            # derives identical augmentations for the rows it owns
+            # (trajectory-match with single-process).  File-sharded mode has
+            # per-process counts, so the process index salts the key —
+            # without it all hosts would apply identical crop/flip
+            # parameters at each within-batch position.
+            salt = self._process_index if self._file_sharded else 0
+            rng = np.random.default_rng((self._seed, salt, self._count))
             img = augment.preprocess_imagenet_train(
                 img, rng, size=self._size, bbox=bbox
             )
@@ -251,20 +333,62 @@ class ImageNetTFRecordDataset:
         return img.astype(np.float32), label
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        if self._file_sharded:
+            # Own shard files == own slice of the global batch; nothing but
+            # local records are ever read or decoded.
+            images, labels = [], []
+            for raw in self._records:
+                img, label = self._parse(raw)
+                self._count += 1
+                images.append(img)
+                labels.append(label)
+                if len(images) == self._local_batch:
+                    yield {
+                        "image": np.stack(images),
+                        "label": np.asarray(labels, np.int32),
+                    }
+                    images, labels = [], []
+            return
+
+        # Replicated-read modes: all processes see the same global record
+        # stream; each parses only its row block [lo, hi) of every global
+        # batch.  ``_count`` advances globally (even past skipped rows), so
+        # augmentation rngs agree with a single-process run and the
+        # process-order concatenation reproduces its batches exactly.
+        lo = self._process_index * self._local_batch
+        hi = lo + self._local_batch
         images, labels = [], []
+        pos = 0
         for raw in self._records:
-            img, label = self._parse(raw)
+            if lo <= pos < hi:
+                img, label = self._parse(raw)
+                images.append(img)
+                labels.append(label)
             self._count += 1
-            images.append(img)
-            labels.append(label)
-            if len(images) == self._batch_size:
+            pos += 1
+            if pos == self._batch_size:
                 yield {
                     "image": np.stack(images),
                     "label": np.asarray(labels, np.int32),
                 }
                 images, labels = [], []
-        if images and not self._train:
-            # Partial final batch so a one-pass eval covers every record.
+                pos = 0
+        if pos and not self._train:
+            # Partial final global batch so a one-pass eval covers every
+            # record.  Single-process: yield it ragged (the eval driver
+            # pads).  Multi-process: pad every row block to equal shape
+            # with label=-1 rows, masked out by the padded-batch counting.
+            if self._process_count == 1:
+                if images:
+                    yield {
+                        "image": np.stack(images),
+                        "label": np.asarray(labels, np.int32),
+                    }
+                return
+            pad = self._local_batch - len(images)
+            fill = np.zeros((self._size, self._size, 3), np.float32)
+            images.extend([fill] * pad)
+            labels.extend([-1] * pad)
             yield {
                 "image": np.stack(images),
                 "label": np.asarray(labels, np.int32),
@@ -272,7 +396,12 @@ class ImageNetTFRecordDataset:
 
 
 def synthetic_imagenet_dataset(
-    batch_size: int, image_size: int = 224, seed: int = 0
+    batch_size: int,
+    image_size: int = 224,
+    seed: int = 0,
+    *,
+    process_index: int = 0,
+    process_count: int = 1,
 ):
     """On-host synthetic ImageNet batches (shapes/classes exact) — the
     throughput-benchmark input, the role slim's fake dataset played for the
@@ -280,7 +409,13 @@ def synthetic_imagenet_dataset(
     x, y = _synthetic_images(
         max(2 * batch_size, 256), image_size, image_size, 3, 1000, seed
     )
-    return ArrayDataset({"image": x, "label": y}, batch_size, seed=seed)
+    return ArrayDataset(
+        {"image": x, "label": y},
+        batch_size,
+        seed=seed,
+        process_index=process_index,
+        process_count=process_count,
+    )
 
 
 # --------------------------------------------------------------------------
@@ -293,13 +428,32 @@ class PTBDataset:
     ``[batch_size, -1]`` and cut into consecutive ``num_steps`` windows;
     ``targets`` are inputs shifted by one.  Consecutive batches are
     consecutive in the stream, which is what makes threading the LSTM carry
-    across steps meaningful (truncated BPTT, SURVEY.md §7.4.5)."""
+    across steps meaningful (truncated BPTT, SURVEY.md §7.4.5).
+
+    Multi-host: ``batch_size`` is global; each process holds the row block
+    ``[process_index*local : (process_index+1)*local]`` of the
+    ``[batch_size, -1]`` token layout.  Rows are stable across steps, so
+    each process's carry slice stays aligned with its rows, and the
+    process-order concatenation equals the single-process batch."""
 
     def __init__(
-        self, tokens: np.ndarray, batch_size: int, num_steps: int
+        self,
+        tokens: np.ndarray,
+        batch_size: int,
+        num_steps: int,
+        *,
+        process_index: int = 0,
+        process_count: int = 1,
     ):
+        if batch_size % process_count:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by "
+                f"process count {process_count}"
+            )
         n_batches = len(tokens) // batch_size
         data = tokens[: n_batches * batch_size].reshape(batch_size, n_batches)
+        local = batch_size // process_count
+        data = data[process_index * local : (process_index + 1) * local]
         self._data = data
         self._num_steps = num_steps
         self._epoch_size = (n_batches - 1) // num_steps
@@ -362,8 +516,18 @@ def load_ptb_tokens(split: str = "train", vocab_size: int = 10000) -> np.ndarray
 
 
 def ptb_dataset(
-    batch_size: int, num_steps: int, split: str = "train", vocab_size: int = 10000
+    batch_size: int,
+    num_steps: int,
+    split: str = "train",
+    vocab_size: int = 10000,
+    *,
+    process_index: int = 0,
+    process_count: int = 1,
 ) -> PTBDataset:
     return PTBDataset(
-        load_ptb_tokens(split, vocab_size), batch_size, num_steps
+        load_ptb_tokens(split, vocab_size),
+        batch_size,
+        num_steps,
+        process_index=process_index,
+        process_count=process_count,
     )

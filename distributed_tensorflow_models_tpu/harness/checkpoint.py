@@ -12,6 +12,16 @@ reference's queues lose their position on restart (SURVEY.md §5.4 gap).
 What is saved per step: the array leaves of :class:`TrainState`
 (step/params/batch_stats/opt_state/ema_params/carry) plus a JSON blob with
 the dataset iterator state.
+
+Multi-host: orbax saves are collective (every process calls ``save``; array
+shards are written by their owning hosts, the JSON by the primary).  The
+dataset-state JSON therefore records process 0's iterator position.  For
+the array- and PTB-backed datasets that position is identical on every
+process (same epoch/batch counters), so resume is exact; for the
+file-sharded ImageNet stream each process's shard position differs and a
+restore realigns all processes to process 0's position — an approximate
+(within-epoch) resume, still strictly beyond the reference, whose queue
+pipeline cannot resume input position at all (SURVEY.md §5.4).
 """
 
 from __future__ import annotations

@@ -36,6 +36,14 @@ class Hook:
 
     def end(self, state) -> None: ...
 
+    def abort(self, state) -> None:
+        """Cleanup on the *failure* path.  Defaults to :meth:`end`; hooks
+        whose ``end`` performs a multi-host collective must override this —
+        a single failing process entering a collective while its peers are
+        blocked elsewhere turns a clean per-process error into a
+        cluster-wide hang."""
+        self.end(state)
+
 
 class StopRequested(Exception):
     """Raised by hooks to end training (StopAtStepHook's mechanism)."""
@@ -183,27 +191,68 @@ class CheckpointHook(Hook):
     """Save every ``every_secs`` (default 600 s, the reference's
     CheckpointSaverHook default — TF monitored_session.py:525-528) and at
     ``end``.  ``save_fn(state, step)`` is provided by the driver so the hook
-    stays agnostic of checkpoint layout."""
+    stays agnostic of checkpoint layout.
+
+    Multi-host: orbax saves are collective, so every process must decide
+    "save now" at the *same step*.  A per-process wall clock cannot
+    guarantee that (clocks cross the threshold at different steps and the
+    early process deadlocks in the save barrier while the others run ahead).
+    With ``process_count > 1`` the chief alone reads the clock and its
+    decision is broadcast, polled every ``poll_every_steps`` steps to keep
+    the collective off the per-step hot path; step-based triggers
+    (``every_steps``) are deterministic on every process and need no sync.
+    """
 
     def __init__(self, save_fn, every_secs: float = 600.0,
-                 every_steps: Optional[int] = None):
+                 every_steps: Optional[int] = None,
+                 poll_every_steps: int = 20):
         self._save = save_fn
         self._every_secs = every_secs
         self._every_steps = every_steps
+        self._poll = max(1, poll_every_steps)
         self._last_time = time.time()
+        self._multiproc = jax.process_count() > 1
 
-    def after_step(self, state, metrics, step):
-        due_time = (
-            self._every_secs is not None
+    def _time_due(self, step: int) -> bool:
+        if self._every_secs is None:
+            return False
+        if not self._multiproc:
+            return time.time() - self._last_time >= self._every_secs
+        if step % self._poll:
+            return False
+        from jax.experimental import multihost_utils
+
+        chief_due = (
+            jax.process_index() == 0
             and time.time() - self._last_time >= self._every_secs
         )
+        return bool(
+            multihost_utils.broadcast_one_to_all(
+                np.asarray(chief_due, np.int32)
+            )
+        )
+
+    def after_step(self, state, metrics, step):
         due_step = self._every_steps and step % self._every_steps == 0
-        if due_time or due_step:
+        if due_step or self._time_due(step):
             self._save(state, step)
             self._last_time = time.time()
 
     def end(self, state):
         self._save(state, int(state.step))
+
+    def abort(self, state):
+        # Crash-time save is safe (and valuable) single-process; with peers
+        # it is a collective this lone failing process must NOT enter — the
+        # others are blocked in the next step's all-reduce, not the save
+        # barrier.  Recovery then restores the last *scheduled* checkpoint.
+        if not self._multiproc:
+            self._save(state, int(state.step))
+        else:
+            log.warning(
+                "skipping crash-time checkpoint save on multi-host failure "
+                "(collective save cannot run from one process)"
+            )
 
 
 class FaultInjectionHook(Hook):

@@ -39,14 +39,26 @@ log = logging.getLogger("dtm")
 
 
 def build_dataset(cfg: ExperimentConfig, split: str = "train"):
-    """Dataset factory keyed by config (the L3 wiring of each driver)."""
+    """Dataset factory keyed by config (the L3 wiring of each driver).
+
+    Multi-host: each process builds a dataset yielding only its
+    ``global_batch/process_count`` slice (SURVEY.md §3.4 — each reference
+    worker reads its own shard stream); ``shard_batch`` assembles the
+    process-local slices into the global device array.
+    """
+    pid, nproc = jax.process_index(), jax.process_count()
+    proc = dict(process_index=pid, process_count=nproc)
     if cfg.dataset == "mnist":
-        return datalib.mnist_dataset(cfg.global_batch_size, split, cfg.seed)
+        return datalib.mnist_dataset(
+            cfg.global_batch_size, split, cfg.seed, **proc
+        )
     if cfg.dataset == "cifar10":
-        return datalib.cifar10_dataset(cfg.global_batch_size, split, cfg.seed)
+        return datalib.cifar10_dataset(
+            cfg.global_batch_size, split, cfg.seed, **proc
+        )
     if cfg.dataset == "imagenet_synthetic":
         return datalib.synthetic_imagenet_dataset(
-            cfg.global_batch_size, cfg.image_size, cfg.seed
+            cfg.global_batch_size, cfg.image_size, cfg.seed, **proc
         )
     if cfg.dataset == "imagenet":
         import glob
@@ -63,7 +75,7 @@ def build_dataset(cfg: ExperimentConfig, split: str = "train"):
                 "no ImageNet shards under %s; using synthetic data", pattern
             )
             return datalib.synthetic_imagenet_dataset(
-                cfg.global_batch_size, cfg.image_size, cfg.seed
+                cfg.global_batch_size, cfg.image_size, cfg.seed, **proc
             )
         return datalib.ImageNetTFRecordDataset(
             paths,
@@ -72,10 +84,15 @@ def build_dataset(cfg: ExperimentConfig, split: str = "train"):
             image_size=cfg.image_size,
             seed=cfg.seed,
             label_offset=1,
+            **proc,
         )
     if cfg.dataset == "ptb":
         return datalib.ptb_dataset(
-            cfg.global_batch_size, cfg.num_steps, split, cfg.vocab_size
+            cfg.global_batch_size,
+            cfg.num_steps,
+            split,
+            cfg.vocab_size,
+            **proc,
         )
     raise ValueError(f"unknown dataset {cfg.dataset!r}")
 
@@ -164,15 +181,28 @@ def fit(
         # the batches the train loop has consumed, so resume never skips.
         manager.save(s, {"dataset": device_it.get_state()})
 
+    # Writer hooks run on process 0 only (the reference's chief-writes-
+    # summaries convention, TF monitored_session.py:566-609); the NaN guard
+    # runs everywhere so all processes abort together (metrics are global,
+    # identical on every process); the checkpoint hook runs everywhere —
+    # orbax saves are collective.
+    is_chief = jax.process_index() == 0
+    chief_hooks: list[hooklib.Hook] = (
+        [
+            hooklib.StepCounterHook(
+                cfg.log_every_steps, cfg.global_batch_size
+            ),
+            hooklib.LoggingHook(cfg.log_every_steps, keys=("loss",)),
+            hooklib.MetricWriterHook(workdir, cfg.log_every_steps),
+            hooklib.TensorBoardHook(workdir, cfg.log_every_steps),
+        ]
+        if is_chief
+        else []
+    )
     all_hooks: list[hooklib.Hook] = [
         hooklib.StopAtStepHook(cfg.train_steps),
-        hooklib.StepCounterHook(
-            cfg.log_every_steps, cfg.global_batch_size
-        ),
+        *chief_hooks,
         hooklib.NanGuardHook(cfg.log_every_steps),
-        hooklib.LoggingHook(cfg.log_every_steps, keys=("loss",)),
-        hooklib.MetricWriterHook(workdir, cfg.log_every_steps),
-        hooklib.TensorBoardHook(workdir, cfg.log_every_steps),
         hooklib.CheckpointHook(
             save_fn, every_secs=cfg.checkpoint_every_secs
         ),
@@ -195,16 +225,17 @@ def fit(
             if not hooklib.run_hooks_after_step(all_hooks, state, metrics, step):
                 break
     except BaseException:
-        # Already failing: run end hooks best-effort (the CheckpointHook
-        # end-save preserves crash-time progress when storage still works)
+        # Already failing: run abort hooks best-effort (single-process, the
+        # CheckpointHook crash-save preserves progress when storage still
+        # works; multi-host it skips its collective save — see Hook.abort)
         # but never let cleanup mask the original error or skip releasing
         # the pipeline threads / checkpoint manager — recoverable_fit may
         # re-enter fit on the same workdir right after this.
         for h in all_hooks:
             try:
-                h.end(state)
+                h.abort(state)
             except Exception:
-                log.exception("hook %r end() failed during error cleanup", h)
+                log.exception("hook %r abort() failed during error cleanup", h)
         _close_quietly(host, manager)
         raise
     else:

@@ -406,3 +406,170 @@ def test_device_prefetcher(mesh8):
     assert batch["image"].shape == (8, 8)
     # Sharded over the data axis of the mesh.
     assert not batch["image"].sharding.is_fully_replicated
+
+
+# --------------------------------------------------------------------------
+# Multi-host sharding (SURVEY.md §3.4: per-worker input streams)
+# --------------------------------------------------------------------------
+
+
+def test_array_dataset_process_shards_concat_to_global_batch():
+    """Process-order concatenation of per-process slices must reproduce the
+    single-process global batch exactly — including deterministic
+    augmentation (rngs keyed by global sample position)."""
+    full = datasets.cifar10_dataset(8, "train", seed=3)
+    parts = [
+        datasets.cifar10_dataset(
+            8, "train", seed=3, process_index=p, process_count=2
+        )
+        for p in range(2)
+    ]
+    fit, pits = iter(full), [iter(p) for p in parts]
+    for _ in range(3):  # spans an epoch boundary reshuffle at 8192/8
+        fb = next(fit)
+        pbs = [next(it) for it in pits]
+        assert all(pb["image"].shape[0] == 4 for pb in pbs)
+        np.testing.assert_array_equal(
+            fb["image"], np.concatenate([pb["image"] for pb in pbs])
+        )
+        np.testing.assert_array_equal(
+            fb["label"], np.concatenate([pb["label"] for pb in pbs])
+        )
+
+
+def test_array_dataset_rejects_indivisible_process_count():
+    with pytest.raises(ValueError):
+        datasets.mnist_dataset(8, process_index=0, process_count=3)
+
+
+def test_ptb_dataset_process_shards_are_row_blocks():
+    tokens = np.arange(100, dtype=np.int32)
+    full = datasets.PTBDataset(tokens, batch_size=4, num_steps=5)
+    parts = [
+        datasets.PTBDataset(
+            tokens,
+            batch_size=4,
+            num_steps=5,
+            process_index=p,
+            process_count=2,
+        )
+        for p in range(2)
+    ]
+    fb = next(iter(full))
+    pbs = [next(iter(p)) for p in parts]
+    np.testing.assert_array_equal(
+        fb["inputs"], np.concatenate([pb["inputs"] for pb in pbs])
+    )
+    np.testing.assert_array_equal(
+        fb["targets"], np.concatenate([pb["targets"] for pb in pbs])
+    )
+
+
+def _write_imagenet_shards(tmp_path, n_shards, per_shard, prefix="train"):
+    paths = []
+    for s in range(n_shards):
+        recs = []
+        for i in range(per_shard):
+            img = np.full((24, 24, 3), (s * per_shard + i) * 5, np.uint8)
+            recs.append(
+                example_proto.build_example(
+                    {
+                        "image/encoded": [augment.encode_jpeg(img)],
+                        "image/class/label": [s * per_shard + i],
+                    }
+                )
+            )
+        p = str(tmp_path / f"{prefix}-{s:05d}")
+        tfrecord.write_records(p, recs)
+        paths.append(p)
+    return paths
+
+
+def test_imagenet_train_file_sharding_is_disjoint(tmp_path):
+    paths = _write_imagenet_shards(tmp_path, n_shards=2, per_shard=6)
+    parts = [
+        datasets.ImageNetTFRecordDataset(
+            paths,
+            4,
+            train=True,
+            image_size=16,
+            process_index=p,
+            process_count=2,
+        )
+        for p in range(2)
+    ]
+    seen = []
+    for part in parts:
+        it = iter(part)
+        labels = np.concatenate([next(it)["label"] for _ in range(3)])
+        assert len(labels) == 6  # local batch 2, file of 6 records
+        seen.append(set(labels.tolist()))
+    # Each process consumed exactly one whole shard file; no overlap.
+    assert seen[0] | seen[1] == set(range(12))
+    assert not (seen[0] & seen[1])
+
+
+def test_imagenet_train_replicated_fallback_matches_global(tmp_path):
+    """With fewer shard files than processes the dataset falls back to
+    replicated reads + row slicing, which must reproduce the single-process
+    batches exactly (augment rng keyed by global record count)."""
+    paths = _write_imagenet_shards(tmp_path, n_shards=1, per_shard=8)
+    full = datasets.ImageNetTFRecordDataset(
+        paths, 4, train=True, image_size=16, seed=7
+    )
+    parts = [
+        datasets.ImageNetTFRecordDataset(
+            paths,
+            4,
+            train=True,
+            image_size=16,
+            seed=7,
+            process_index=p,
+            process_count=2,
+        )
+        for p in range(2)
+    ]
+    fb = next(iter(full))
+    pbs = [next(iter(p)) for p in parts]
+    np.testing.assert_array_equal(
+        fb["image"], np.concatenate([pb["image"] for pb in pbs])
+    )
+    np.testing.assert_array_equal(
+        fb["label"], np.concatenate([pb["label"] for pb in pbs])
+    )
+
+
+def test_imagenet_eval_multiprocess_pads_final_batch(tmp_path):
+    paths = _write_imagenet_shards(
+        tmp_path, n_shards=1, per_shard=10, prefix="val"
+    )
+    parts = [
+        datasets.ImageNetTFRecordDataset(
+            paths,
+            4,
+            train=False,
+            image_size=16,
+            process_index=p,
+            process_count=2,
+        )
+        for p in range(2)
+    ]
+    batches = [list(p) for p in parts]
+    # 10 records, global batch 4 -> 3 global batches, last padded.
+    assert [len(bs) for bs in batches] == [3, 3]
+    for bs in batches:
+        assert all(b["label"].shape == (2,) for b in bs)
+    labels = np.stack(
+        [np.concatenate([b["label"] for b in bs]) for bs in batches]
+    )
+    # Row blocks interleave back into the global record order.
+    merged = np.concatenate(
+        [
+            np.stack([labels[0, i * 2 : i * 2 + 2],
+                      labels[1, i * 2 : i * 2 + 2]]).reshape(-1)
+            for i in range(3)
+        ]
+    )
+    np.testing.assert_array_equal(
+        merged, np.array([0, 1, 2, 3, 4, 5, 6, 7, 8, 9, -1, -1])
+    )

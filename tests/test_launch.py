@@ -123,3 +123,88 @@ def test_cli_multihost_mode_sets_env_and_execs(monkeypatch):
     assert os.environ[launch.ENV_COORDINATOR] == "10.0.0.1:1234"
     assert os.environ[launch.ENV_NUM_PROCESSES] == "4"
     assert os.environ[launch.ENV_PROCESS_ID] == "3"
+
+
+FIT_WORKER = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, {repo!r})
+    import json
+    from distributed_tensorflow_models_tpu import launch
+    assert launch.initialize_from_env(), "cluster env missing"
+    import jax
+    from distributed_tensorflow_models_tpu.harness import train as trainlib
+    from distributed_tensorflow_models_tpu.harness.config import get_config
+
+    assert jax.process_count() == 2
+    cfg = get_config(
+        "lenet_mnist",
+        train_steps=4,
+        global_batch_size=32,
+        log_every_steps=1,
+        checkpoint_every_secs=1e9,
+    )
+    res = trainlib.fit(cfg, {workdir!r})
+    if jax.process_index() == 0:
+        json.dump(
+            {{
+                "loss": res.final_metrics["loss"],
+                "step": int(res.state.step),
+            }},
+            open({out!r}, "w"),
+        )
+    """
+)
+
+
+def test_two_process_fit_matches_single_process(tmp_path):
+    """A real 2-process ``fit`` on disjoint per-process data shards must
+    reproduce the single-process trajectory at the same global batch —
+    the multi-host ingestion contract (SURVEY.md §3.4: each reference
+    worker feeds its own shard of the input; sync aggregation makes the
+    effective batch global)."""
+    out = str(tmp_path / "result.json")
+    script = tmp_path / "fit_worker.py"
+    repo = os.path.dirname(
+        os.path.dirname(os.path.abspath(launch.__file__))
+    )
+    script.write_text(
+        FIT_WORKER.format(
+            repo=repo, workdir=str(tmp_path / "multi"), out=out
+        )
+    )
+    codes = launch.launch_local(
+        2,
+        [sys.executable, str(script)],
+        port=9761,
+        cpu_devices_per_process=2,
+        timeout=300,
+    )
+    assert codes == [0, 0]
+    import json
+
+    multi = json.load(open(out))
+    assert multi["step"] == 4
+
+    # Single-process reference run: same config, same 4-device total.
+    import jax
+
+    from distributed_tensorflow_models_tpu.core import mesh as meshlib
+    from distributed_tensorflow_models_tpu.harness import train as trainlib
+    from distributed_tensorflow_models_tpu.harness.config import get_config
+
+    cfg = get_config(
+        "lenet_mnist",
+        train_steps=4,
+        global_batch_size=32,
+        log_every_steps=1,
+        checkpoint_every_secs=1e9,
+    )
+    mesh = meshlib.create_mesh(
+        meshlib.MeshSpec(), devices=jax.devices()[:4]
+    )
+    res = trainlib.fit(cfg, str(tmp_path / "single"), mesh=mesh)
+    assert abs(multi["loss"] - res.final_metrics["loss"]) < 1e-4, (
+        multi,
+        res.final_metrics,
+    )

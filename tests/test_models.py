@@ -166,3 +166,87 @@ class TestPTBLSTM:
         )
         assert set(PTB_CONFIGS) == {"small", "medium", "large"}
         assert PTB_CONFIGS["medium"]["hidden_size"] == 650
+
+
+# --------------------------------------------------------------------------
+# Inception-v3 architecture oracle vs tf_keras (VERDICT r1 item 7)
+# --------------------------------------------------------------------------
+
+
+class TestInceptionV3KerasOracle:
+    """Pin the layer schedule against an independent implementation:
+    ``tf_keras.applications.InceptionV3`` builds the same Szegedy et al.
+    architecture the reference's slim builder does.  Shape tests can't
+    catch a transposed branch width (e.g. swapping Mixed_6b's 128-wide
+    factorized-7x7 branch with Mixed_6e's 192) — the conv-kernel multiset
+    comparison here does.
+
+    Documented deliberate divergences from keras/slim:
+    - our ``BatchNorm`` keeps a trainable ``scale`` (gamma); keras
+      applications and slim's inception arg_scope use ``scale=False``.
+      Accounted for exactly in the param-count assertion.
+    - the aux head (``aux_head=True``) exists in slim but not in keras
+      applications; compared with ``aux_head=False``.
+    """
+
+    @pytest.fixture(scope="class")
+    def keras_model(self):
+        tf_keras = pytest.importorskip("tf_keras")
+        return tf_keras.applications.InceptionV3(
+            weights=None, include_top=True, classes=1000
+        )
+
+    @pytest.fixture(scope="class")
+    def our_variables(self):
+        model = get_model("inception_v3", aux_head=False)
+        return init_shapes(model, jnp.zeros((1, 299, 299, 3), jnp.float32))
+
+    def _our_leaves(self, variables):
+        return jax.tree_util.tree_leaves_with_path(variables["params"])
+
+    def test_conv_kernel_multiset_matches(self, keras_model, our_variables):
+        import tf_keras
+
+        ref = sorted(
+            tuple(int(d) for d in layer.kernel.shape)
+            for layer in keras_model.layers
+            if isinstance(layer, tf_keras.layers.Conv2D)
+        )
+        ours = sorted(
+            tuple(leaf.shape)
+            for path, leaf in self._our_leaves(our_variables)
+            if path[-1].key == "kernel" and len(leaf.shape) == 4
+        )
+        assert len(ours) == len(ref) == 94
+        assert ours == ref
+
+    def test_dense_head_matches(self, keras_model, our_variables):
+        import tf_keras
+
+        (ref_dense,) = [
+            tuple(int(d) for d in layer.kernel.shape)
+            for layer in keras_model.layers
+            if isinstance(layer, tf_keras.layers.Dense)
+        ]
+        (our_dense,) = [
+            tuple(leaf.shape)
+            for path, leaf in self._our_leaves(our_variables)
+            if path[-1].key == "kernel" and len(leaf.shape) == 2
+        ]
+        assert our_dense == ref_dense == (2048, 1000)
+
+    def test_param_count_matches_modulo_bn_scale(
+        self, keras_model, our_variables
+    ):
+        ref_total = keras_model.count_params()
+        our_total = n_params(our_variables["params"]) + n_params(
+            our_variables["batch_stats"]
+        )
+        # Our one deliberate divergence: a trainable gamma per BN feature.
+        gammas = sum(
+            leaf.size
+            for path, leaf in self._our_leaves(our_variables)
+            if path[-1].key == "scale"
+        )
+        assert gammas > 0
+        assert our_total - gammas == ref_total
