@@ -475,12 +475,23 @@ def _orchestrate(args):
             env.pop("PALLAS_AXON_POOL_IPS", None)
         try:
             if args.in_process:
-                if force_cpu:
-                    import jax
+                import jax
 
+                if force_cpu:
+                    # The axon plugin registered at interpreter start (the
+                    # sitecustomize ran before main); pinning the config
+                    # keeps jax from ever *initializing* that backend, and
+                    # clearing the env var keeps child processes clean.
                     jax.config.update("jax_platforms", "cpu")
+                    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
                 results[name] = run_one(
                     name, BUILDERS[name], args.steps, args.batch or None
+                )
+                dev = jax.devices()[0]
+                results[name].update(
+                    platform=dev.platform,
+                    device=dev.device_kind,
+                    n_devices=len(jax.devices()),
                 )
             else:
                 proc = subprocess.run(

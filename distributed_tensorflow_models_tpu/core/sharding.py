@@ -85,7 +85,9 @@ def tree_param_shardings(
     return jax.tree_util.tree_map_with_path(one, params)
 
 
-def shard_batch(mesh: Mesh, batch: PyTree) -> PyTree:
+def shard_batch(
+    mesh: Mesh, batch: PyTree, seq_dim: int | None = None
+) -> PyTree:
     """Place a host batch onto the mesh, sharded along the data axis.
 
     Replaces the dequeue-from-batch-queue boundary of the reference input
@@ -94,9 +96,26 @@ def shard_batch(mesh: Mesh, batch: PyTree) -> PyTree:
     the mesh's data axis.  Works for both single-host (this process holds the
     full batch) and multi-host (this process holds its slice) by going
     through ``jax.make_array_from_process_local_data``.
+
+    ``seq_dim`` additionally shards that dimension over the ``seq`` axis
+    (sequence/context parallelism — token batches land pre-split for ring /
+    Ulysses attention instead of being resharded at the first shard_map
+    boundary).  Applied only to leaves wide enough to split evenly.
     """
+    n_seq = mesh.shape[AxisNames.SEQ]
+
     def one(x):
-        sharding = batch_sharding(mesh, x.ndim)
+        if (
+            seq_dim is not None
+            and n_seq > 1
+            and x.ndim > seq_dim
+            and x.shape[seq_dim] % n_seq == 0
+        ):
+            axes = [AxisNames.DATA] + [None] * (x.ndim - 1)
+            axes[seq_dim] = AxisNames.SEQ
+            sharding = NamedSharding(mesh, P(*axes))
+        else:
+            sharding = batch_sharding(mesh, x.ndim)
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree.map(one, batch)

@@ -116,21 +116,34 @@ def lm_loss_fn(apply_fn: Callable) -> LossFn:
 
     Metrics include ``nll`` (mean per-token negative log-likelihood);
     perplexity = ``exp(nll)`` as the reference reports it.
+
+    Models may ``sow`` scalar regularizers into the ``losses`` collection
+    (the transformer's Switch-MoE load-balancing loss does); every leaf is
+    summed into the objective but kept out of ``nll`` so perplexity stays
+    comparable across dense and MoE configs.
     """
 
     def loss_fn(params, state, batch, rngs):
-        logits, new_carry = apply_fn(
+        (logits, new_carry), updated = apply_fn(
             {"params": params},
             batch["inputs"],
             carry=state.carry,
             train=True,
             rngs=dict(rngs),
+            mutable=["losses"],
         )
         nll = jnp.mean(
             losslib.softmax_cross_entropy(logits, batch["targets"])
         )
-        metrics = {"loss": nll, "nll": nll}
-        return nll, {"metrics": metrics, "carry": new_carry}
+        aux = sum(
+            jnp.sum(leaf)
+            for leaf in jax.tree_util.tree_leaves(updated.get("losses", {}))
+        )
+        loss = nll + aux
+        metrics = {"loss": loss, "nll": nll}
+        if updated.get("losses"):
+            metrics["aux_loss"] = aux
+        return loss, {"metrics": metrics, "carry": new_carry}
 
     return loss_fn
 

@@ -24,6 +24,20 @@ from distributed_tensorflow_models_tpu.data import augment, example_proto, tfrec
 DATA_DIR = os.environ.get("DTM_DATA_DIR", "/root/data")
 
 
+def _validate_process_shard(
+    batch_size: int, process_index: int, process_count: int
+) -> int:
+    """Common multi-host shard validation; returns the local batch size."""
+    if batch_size % process_count:
+        raise ValueError(
+            f"global batch {batch_size} not divisible by "
+            f"process count {process_count}"
+        )
+    if not 0 <= process_index < process_count:
+        raise ValueError(f"bad process {process_index}/{process_count}")
+    return batch_size // process_count
+
+
 # --------------------------------------------------------------------------
 # Generic array dataset
 # --------------------------------------------------------------------------
@@ -69,17 +83,12 @@ class ArrayDataset:
         sizes = {k: len(v) for k, v in arrays.items()}
         if len(set(sizes.values())) != 1:
             raise ValueError(f"mismatched array lengths {sizes}")
-        if batch_size % process_count:
-            raise ValueError(
-                f"global batch {batch_size} not divisible by "
-                f"process count {process_count}"
-            )
-        if not 0 <= process_index < process_count:
-            raise ValueError(f"bad process {process_index}/{process_count}")
         self._arrays = arrays
         self._n = next(iter(sizes.values()))
         self._batch_size = batch_size
-        self._local_batch = batch_size // process_count
+        self._local_batch = _validate_process_shard(
+            batch_size, process_index, process_count
+        )
         self._local_lo = process_index * self._local_batch
         self._shuffle = shuffle
         self._seed = seed
@@ -262,12 +271,9 @@ class ImageNetTFRecordDataset:
         process_index: int = 0,
         process_count: int = 1,
     ):
-        if batch_size % process_count:
-            raise ValueError(
-                f"global batch {batch_size} not divisible by "
-                f"process count {process_count}"
-            )
-        self._local_batch = batch_size // process_count
+        self._local_batch = _validate_process_shard(
+            batch_size, process_index, process_count
+        )
         self._process_index = process_index
         self._process_count = process_count
         # File-sharded mode: this process's stream IS its slice of the
@@ -445,14 +451,11 @@ class PTBDataset:
         process_index: int = 0,
         process_count: int = 1,
     ):
-        if batch_size % process_count:
-            raise ValueError(
-                f"global batch {batch_size} not divisible by "
-                f"process count {process_count}"
-            )
+        local = _validate_process_shard(
+            batch_size, process_index, process_count
+        )
         n_batches = len(tokens) // batch_size
         data = tokens[: n_batches * batch_size].reshape(batch_size, n_batches)
-        local = batch_size // process_count
         data = data[process_index * local : (process_index + 1) * local]
         self._data = data
         self._num_steps = num_steps

@@ -122,13 +122,18 @@ class DevicePrefetcher:
     batches sitting in this buffer.
     """
 
-    def __init__(self, iterator, mesh, *, depth: int = 2):
+    def __init__(self, iterator, mesh, *, depth: int = 2,
+                 seq_dim: Optional[int] = None):
+        import functools
+
         from distributed_tensorflow_models_tpu.core import sharding
 
         self._it = iter(iterator)
         self._source = iterator
         self._mesh = mesh
-        self._shard = sharding.shard_batch
+        self._shard = functools.partial(
+            sharding.shard_batch, seq_dim=seq_dim
+        )
         self._buf: list[tuple[PyTree, Optional[dict]]] = []
         self._depth = depth
         self._state: Optional[dict] = (

@@ -33,6 +33,28 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="tensor-parallel axis size (default 1)",
     )
     p.add_argument(
+        "--mesh-seq", type=int, default=None,
+        help="sequence-parallel axis size (default 1)",
+    )
+    p.add_argument(
+        "--mesh-pipe", type=int, default=None,
+        help="pipeline axis size (default 1)",
+    )
+    p.add_argument(
+        "--mesh-expert", type=int, default=None,
+        help="expert-parallel axis size (default 1)",
+    )
+    p.add_argument(
+        "--seq-impl", choices=("ring", "ulysses"), default=None,
+        help="sequence-parallelism strategy over the seq axis",
+    )
+    p.add_argument(
+        "--attn-impl",
+        choices=("auto", "reference", "blockwise", "flash"),
+        default=None,
+        help="attention kernel (auto = Pallas flash on TPU)",
+    )
+    p.add_argument(
         "--multihost", action="store_true",
         help="initialize jax.distributed (multi-host SPMD)",
     )
@@ -46,8 +68,16 @@ def _overrides(args) -> dict:
         out["global_batch_size"] = args.batch_size
     if args.seed is not None:
         out["seed"] = args.seed
-    if args.mesh_model is not None:
-        out["mesh_model"] = args.mesh_model
+    for attr, key in (
+        ("mesh_model", "mesh_model"),
+        ("mesh_seq", "mesh_seq"),
+        ("mesh_pipe", "mesh_pipe"),
+        ("mesh_expert", "mesh_expert"),
+        ("seq_impl", "seq_impl"),
+        ("attn_impl", "attn_impl"),
+    ):
+        if getattr(args, attr, None) is not None:
+            out[key] = getattr(args, attr)
     return out
 
 

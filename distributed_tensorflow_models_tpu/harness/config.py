@@ -119,6 +119,19 @@ class ExperimentConfig:
     # Mesh axis sizes; -1 absorbs remaining devices (data axis).
     mesh_data: int = -1
     mesh_model: int = 1
+    mesh_seq: int = 1
+    mesh_pipe: int = 1
+    mesh_expert: int = 1
+    # Attention implementation for attention models: auto | reference |
+    # blockwise | flash ("auto" = Pallas flash on TPU when tile-aligned,
+    # blockwise elsewhere — ops/attention.py).
+    attn_impl: str = "auto"
+    # Sequence/context parallelism over the ``seq`` axis: None | "ring"
+    # (ppermute KV rotation) | "ulysses" (all_to_all head scatter).
+    seq_impl: Optional[str] = None
+    # Named tensor-parallel rule set (parallel/tensor.py RULE_SETS) applied
+    # when mesh_model > 1; "" = fully replicated params.
+    param_rules: str = ""
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)
@@ -256,6 +269,47 @@ for _size, _lr_decay, _clip, _hold, _total, _nsteps in (
             train_steps=_spe * _total,
         )
     )
+
+
+# --- Transformer LM — the long-context/beyond-parity flagship. -----------
+# Consumes the attention stack (ops/attention.py flash/blockwise), the
+# sequence-parallel layer (parallel/ring.py via seq_impl + mesh_seq), the
+# TP rule set (parallel/tensor.py via param_rules + mesh_model), and — in
+# the _moe variant — expert parallelism (parallel/moe.py via mesh_expert).
+_add(
+    ExperimentConfig(
+        name="transformer_lm",
+        model="transformer_lm",
+        task="lm",
+        model_kwargs={
+            "num_layers": 4,
+            "num_heads": 8,
+            "d_model": 256,
+            "d_ff": 1024,
+            "max_len": 512,
+            "dropout_rate": 0.1,
+        },
+        dataset="ptb",
+        global_batch_size=16,
+        num_steps=256,  # sequence length per segment
+        vocab_size=10000,
+        optimizer=OptimizerConfig(
+            name="adam", learning_rate=3e-4, clip_global_norm=1.0
+        ),
+        param_rules="transformer_tp",
+        train_steps=10_000,
+    )
+)
+
+_add(
+    _CONFIGS["transformer_lm"].replace(
+        name="transformer_lm_moe",
+        model_kwargs={
+            **_CONFIGS["transformer_lm"].model_kwargs,
+            "num_experts": 4,
+        },
+    )
+)
 
 
 def get_config(name: str, **overrides) -> ExperimentConfig:

@@ -46,6 +46,9 @@ class ABResult:
     async_seconds: float
     mean_staleness: float
     dropped: int
+    # staleness value -> event count (the distribution the reference's
+    # accumulator drop-policy acts on, SURVEY.md §2.2 F4).
+    staleness_hist: dict[int, int] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> dict:
         return {
@@ -59,6 +62,9 @@ class ABResult:
                 "losses": self.async_losses,
                 "seconds": round(self.async_seconds, 3),
                 "mean_staleness": round(self.mean_staleness, 3),
+                "staleness_hist": {
+                    str(k): v for k, v in sorted(self.staleness_hist.items())
+                },
                 "dropped": self.dropped,
             },
         }
@@ -158,6 +164,9 @@ def async_vs_sync(
     async_seconds = time.perf_counter() - t0
 
     assert np.isfinite(sync_losses).all() and np.isfinite(async_losses).all()
+    values, counts = np.unique(
+        np.asarray(emu.staleness_log, np.int64), return_counts=True
+    )
     return ABResult(
         sync_losses=sync_losses,
         async_losses=async_losses,
@@ -165,4 +174,5 @@ def async_vs_sync(
         async_seconds=async_seconds,
         mean_staleness=emu.mean_staleness,
         dropped=emu.dropped,
+        staleness_hist={int(v): int(c) for v, c in zip(values, counts)},
     )

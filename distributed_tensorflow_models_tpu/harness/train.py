@@ -97,6 +97,43 @@ def build_dataset(cfg: ExperimentConfig, split: str = "train"):
     raise ValueError(f"unknown dataset {cfg.dataset!r}")
 
 
+def _mesh_model_kwargs(cfg: ExperimentConfig, mesh) -> dict:
+    """Mesh-dependent model kwargs for attention models: the attention
+    implementation and, when ``seq_impl``/``mesh_expert`` are configured,
+    the sequence-parallel attention fn and the MoE mesh.  These change how
+    the model *computes*, never what parameters it declares — so init can
+    use the plain (mesh-free) model on a tiny sample while the training
+    ``apply_fn`` comes from the mesh-aware instance."""
+    if cfg.model != "transformer_lm":
+        return {}
+    kwargs: dict = {"attn_impl": cfg.attn_impl}
+    if cfg.seq_impl:
+        from distributed_tensorflow_models_tpu.parallel import ring as ringlib
+
+        if cfg.seq_impl == "ring":
+            if cfg.attn_impl != "auto":
+                log.warning(
+                    "attn_impl=%r is ignored under seq_impl='ring': ring "
+                    "attention folds KV chunks through its own fused "
+                    "streaming-softmax recurrence (parallel/ring.py)",
+                    cfg.attn_impl,
+                )
+            kwargs["attention_fn"] = lambda q, k, v, causal=True: (
+                ringlib.ring_attention(q, k, v, mesh, causal=causal)
+            )
+        elif cfg.seq_impl == "ulysses":
+            kwargs["attention_fn"] = lambda q, k, v, causal=True: (
+                ringlib.ulysses_attention(
+                    q, k, v, mesh, causal=causal, impl=cfg.attn_impl
+                )
+            )
+        else:
+            raise ValueError(f"unknown seq_impl {cfg.seq_impl!r}")
+    if cfg.model_kwargs.get("num_experts", 0) > 0:
+        kwargs["moe_mesh"] = mesh
+    return kwargs
+
+
 def build_state(cfg: ExperimentConfig, mesh) -> TrainState:
     model = get_model(cfg.model, **cfg.model_kwargs)
     tx = cfg.optimizer.make()
@@ -104,7 +141,11 @@ def build_state(cfg: ExperimentConfig, mesh) -> TrainState:
         sample = jnp.zeros(
             (2, cfg.num_steps), jnp.int32
         )
-        carry = model.initial_carry(cfg.global_batch_size)
+        carry = (
+            model.initial_carry(cfg.global_batch_size)
+            if hasattr(model, "initial_carry")
+            else None
+        )
         state = TrainState.create(
             model,
             tx,
@@ -113,6 +154,15 @@ def build_state(cfg: ExperimentConfig, mesh) -> TrainState:
             ema_decay=cfg.ema_decay,
             carry=carry,
         )
+        mesh_kwargs = _mesh_model_kwargs(cfg, mesh)
+        if mesh_kwargs:
+            # Dict-merge (not **,**) so an explicit model_kwargs entry for
+            # the same key overrides the config-derived default instead of
+            # raising a duplicate-kwarg TypeError.
+            mesh_model = get_model(
+                cfg.model, **{**mesh_kwargs, **cfg.model_kwargs}
+            )
+            state = state.replace(apply_fn=mesh_model.apply)
     else:
         sample = jnp.zeros(
             (2, cfg.image_size, cfg.image_size, 3 if cfg.image_size > 28 else 1),
@@ -123,7 +173,11 @@ def build_state(cfg: ExperimentConfig, mesh) -> TrainState:
         state = TrainState.create(
             model, tx, jax.random.key(cfg.seed), sample, ema_decay=cfg.ema_decay
         )
-    return train_loop.place_state(state, mesh)
+    from distributed_tensorflow_models_tpu.parallel import tensor as tensorlib
+
+    return train_loop.place_state(
+        state, mesh, tensorlib.get_rules(cfg.param_rules)
+    )
 
 
 def build_step(cfg: ExperimentConfig, state: TrainState):
@@ -157,7 +211,13 @@ def fit(
     checkpoint exists.  Returns the final (host-fetched) state."""
     if mesh is None:
         mesh = meshlib.create_mesh(
-            meshlib.MeshSpec(data=cfg.mesh_data, model=cfg.mesh_model)
+            meshlib.MeshSpec(
+                data=cfg.mesh_data,
+                model=cfg.mesh_model,
+                seq=cfg.mesh_seq,
+                pipe=cfg.mesh_pipe,
+                expert=cfg.mesh_expert,
+            )
         )
     state = build_state(cfg, mesh)
     manager = ckptlib.CheckpointManager(workdir, keep=cfg.keep_checkpoints)
@@ -172,7 +232,12 @@ def fit(
         dataset.set_state(data_state["dataset"])
 
     host = pipelib.HostPipeline(dataset, prefetch=4)
-    device_it = pipelib.DevicePrefetcher(host, mesh, depth=2)
+    seq_dim = (
+        1
+        if cfg.task == "lm" and mesh.shape[meshlib.AxisNames.SEQ] > 1
+        else None
+    )
+    device_it = pipelib.DevicePrefetcher(host, mesh, depth=2, seq_dim=seq_dim)
     step_fn = build_step(cfg, state)
 
     def save_fn(s, _step):

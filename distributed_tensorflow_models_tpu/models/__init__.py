@@ -43,6 +43,7 @@ from distributed_tensorflow_models_tpu.models import inception_v3  # noqa: E402
 from distributed_tensorflow_models_tpu.models import vgg  # noqa: E402
 from distributed_tensorflow_models_tpu.models import alexnet  # noqa: E402
 from distributed_tensorflow_models_tpu.models import ptb_lstm  # noqa: E402
+from distributed_tensorflow_models_tpu.models import transformer_lm  # noqa: E402
 
 from distributed_tensorflow_models_tpu.models.lenet import LeNet  # noqa: E402
 from distributed_tensorflow_models_tpu.models.resnet_cifar import (  # noqa: E402
@@ -55,3 +56,6 @@ from distributed_tensorflow_models_tpu.models.inception_v3 import (  # noqa: E40
 from distributed_tensorflow_models_tpu.models.vgg import VGG16  # noqa: E402
 from distributed_tensorflow_models_tpu.models.alexnet import AlexNet  # noqa: E402
 from distributed_tensorflow_models_tpu.models.ptb_lstm import PTBLSTM  # noqa: E402
+from distributed_tensorflow_models_tpu.models.transformer_lm import (  # noqa: E402
+    TransformerLM,
+)

@@ -1,0 +1,260 @@
+"""Decoder-only transformer LM — the consumer of the long-context stack.
+
+The reference's only sequence model is the PTB LSTM (SURVEY.md §2.1 R8);
+this model is the framework's beyond-parity flagship for the reserved
+``seq``/``model``/``expert`` mesh axes (SURVEY.md §5.7, §7.5): a standard
+pre-LN causal transformer whose attention is routed through
+:mod:`...ops.attention` (reference / blockwise / Pallas flash) or, when the
+harness passes an ``attention_fn``, through the sequence-parallel layer
+(:func:`...parallel.ring.ring_attention` / :func:`ulysses_attention`), and
+whose FFN blocks can be Switch-MoE layers over the ``expert`` axis
+(:func:`...parallel.moe.moe_ffn`).
+
+Parameter naming is pinned to :func:`...parallel.tensor.transformer_tp_rules`
+(attn/query|key|value|out, mlp/up|down, embedding, head) so tensor
+parallelism is a placement rule set, not a model change.
+
+TPU notes: bf16 compute with fp32 LayerNorm and logits; attention and MLP
+matmuls are [B·T, d]-shaped for the MXU; causal masking is positional (no
+materialized [T, T] mask when the blockwise/flash paths run).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_models_tpu.models import register
+from distributed_tensorflow_models_tpu.ops import attention as attnlib
+
+
+class SelfAttention(nn.Module):
+    """Causal multi-head self-attention with pluggable attention impl."""
+
+    num_heads: int
+    d_model: int
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "auto"
+    # Sequence-parallel override: (q, k, v, causal=...) -> out, BTHD.
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, T, _ = x.shape
+        H = self.num_heads
+        Dh = self.d_model // H
+        dense = lambda name: nn.Dense(
+            self.d_model, dtype=self.dtype, name=name
+        )
+        q = dense("query")(x).reshape(B, T, H, Dh)
+        k = dense("key")(x).reshape(B, T, H, Dh)
+        v = dense("value")(x).reshape(B, T, H, Dh)
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v, causal=True)
+        else:
+            out = attnlib.attention(q, k, v, causal=True, impl=self.attn_impl)
+        out = out.reshape(B, T, self.d_model)
+        out = nn.Dense(self.d_model, dtype=self.dtype, name="out")(out)
+        if self.dropout_rate:
+            out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+        return out
+
+
+class MLP(nn.Module):
+    d_model: int
+    d_ff: int
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Dense(self.d_ff, dtype=self.dtype, name="up")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, dtype=self.dtype, name="down")(h)
+        if self.dropout_rate:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return h
+
+
+class MoEFFN(nn.Module):
+    """Switch-MoE FFN block: flax param declaration around
+    :func:`...parallel.moe.moe_ffn` (expert-parallel all_to_all exchange
+    over the ``expert`` axis).  The load-balancing aux loss is sowed into
+    the ``losses`` collection, which :func:`...core.train_loop.lm_loss_fn`
+    sums into the objective."""
+
+    num_experts: int
+    d_model: int
+    d_ff: int
+    mesh: Any  # jax.sharding.Mesh; static module attribute
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from distributed_tensorflow_models_tpu.parallel import moe as moelib
+
+        B, T, d = x.shape
+        scale_in = 1.0 / jnp.sqrt(jnp.float32(d))
+        scale_out = 1.0 / jnp.sqrt(jnp.float32(self.d_ff))
+        params = {
+            "router": self.param(
+                "router",
+                lambda rng: jax.random.normal(rng, (d, self.num_experts))
+                * scale_in,
+            ),
+            "w_in": self.param(
+                "w_in",
+                lambda rng: jax.random.normal(
+                    rng, (self.num_experts, d, self.d_ff)
+                )
+                * scale_in,
+            ),
+            "w_out": self.param(
+                "w_out",
+                lambda rng: jax.random.normal(
+                    rng, (self.num_experts, self.d_ff, d)
+                )
+                * scale_out,
+            ),
+        }
+        if self.mesh is None:
+            # Mesh-free path (init/eval_shape): the single-rank oracle with
+            # identical routing semantics.
+            res = moelib.moe_ffn_reference(
+                params, x.reshape(B * T, d), num_ranks=1,
+                capacity_factor=self.capacity_factor,
+            )
+        else:
+            res = moelib.moe_ffn(
+                params,
+                x.reshape(B * T, d),
+                mesh=self.mesh,
+                capacity_factor=self.capacity_factor,
+            )
+        self.sow(
+            "losses",
+            "moe_aux",
+            self.aux_loss_weight * res.aux_loss,
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
+        return res.out.reshape(B, T, d).astype(x.dtype)
+
+
+class Block(nn.Module):
+    num_heads: int
+    d_model: int
+    d_ff: int
+    dropout_rate: float
+    dtype: jnp.dtype
+    attn_impl: str
+    attention_fn: Optional[Callable]
+    use_moe: bool = False
+    num_experts: int = 0
+    moe_mesh: Any = None
+    moe_capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        x = x + SelfAttention(
+            self.num_heads,
+            self.d_model,
+            self.dropout_rate,
+            self.dtype,
+            self.attn_impl,
+            self.attention_fn,
+            name="attn",
+        )(h, train=train)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        if self.use_moe:
+            ffn = MoEFFN(
+                self.num_experts,
+                self.d_model,
+                self.d_ff,
+                self.moe_mesh,
+                capacity_factor=self.moe_capacity_factor,
+                dtype=self.dtype,
+                name="moe",
+            )
+        else:
+            ffn = MLP(
+                self.d_model,
+                self.d_ff,
+                self.dropout_rate,
+                self.dtype,
+                name="mlp",
+            )
+        return x + ffn(h, train=train)
+
+
+class TransformerLM(nn.Module):
+    """Input ``tokens [B, T]`` int32; returns ``(logits [B, T, V], carry)``
+    — the ``carry`` passthrough keeps the LM train-step contract shared
+    with the PTB LSTM (:func:`...core.train_loop.lm_loss_fn`); a
+    transformer has no recurrent state, so it is returned unchanged."""
+
+    vocab_size: int = 10000
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 256
+    d_ff: int = 1024
+    max_len: int = 1024
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "auto"
+    attention_fn: Optional[Callable] = None
+    # Every other block becomes a Switch-MoE FFN when num_experts > 0
+    # (the standard Switch placement).
+    num_experts: int = 0
+    moe_mesh: Any = None
+    moe_capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, tokens, carry=None, train: bool = False):
+        B, T = tokens.shape
+        x = nn.Embed(
+            self.vocab_size,
+            self.d_model,
+            dtype=self.dtype,
+            name="embedding",
+        )(tokens)
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.d_model),
+        )
+        x = x + pos[:T].astype(self.dtype)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for i in range(self.num_layers):
+            x = Block(
+                self.num_heads,
+                self.d_model,
+                self.d_ff,
+                self.dropout_rate,
+                self.dtype,
+                self.attn_impl,
+                self.attention_fn,
+                use_moe=self.num_experts > 0 and i % 2 == 1,
+                num_experts=self.num_experts,
+                moe_mesh=self.moe_mesh,
+                moe_capacity_factor=self.moe_capacity_factor,
+                name=f"blocks_{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(
+            self.vocab_size, dtype=jnp.float32, name="head"
+        )(x)
+        return logits, carry
+
+
+@register("transformer_lm")
+def build_transformer_lm(**kwargs) -> TransformerLM:
+    return TransformerLM(**kwargs)

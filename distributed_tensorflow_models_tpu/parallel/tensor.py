@@ -80,6 +80,24 @@ def head_tp_rules() -> list[ShardingRule]:
     return shardlib.head_tensor_parallel_rules()
 
 
+# Named rule sets, selectable from ExperimentConfig.param_rules.
+RULE_SETS = {
+    "transformer_tp": transformer_tp_rules,
+    "lstm_tp": lstm_tp_rules,
+    "cnn_tp": cnn_tp_rules,
+    "head_tp": head_tp_rules,
+}
+
+
+def get_rules(name: str) -> list[ShardingRule]:
+    """Resolve a named rule set; '' means no rules (replicated params)."""
+    if not name:
+        return []
+    if name not in RULE_SETS:
+        raise KeyError(f"unknown rule set {name!r}; have {sorted(RULE_SETS)}")
+    return RULE_SETS[name]()
+
+
 def constrain(x: jax.Array, *axes: str | None) -> jax.Array:
     """Pin an activation's sharding inside jitted code.
 
