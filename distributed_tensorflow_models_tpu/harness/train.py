@@ -106,6 +106,12 @@ def _mesh_model_kwargs(cfg: ExperimentConfig, mesh) -> dict:
     ``apply_fn`` comes from the mesh-aware instance."""
     if cfg.model != "transformer_lm":
         return {}
+    if cfg.mesh_pipe > 1 and cfg.seq_impl:
+        raise ValueError(
+            "mesh_pipe and seq_impl cannot combine: the pipelined block "
+            "stack schedules whole blocks per stage and does not route "
+            "through the sequence-parallel attention_fn"
+        )
     kwargs: dict = {"attn_impl": cfg.attn_impl}
     if cfg.seq_impl:
         from distributed_tensorflow_models_tpu.parallel import ring as ringlib
@@ -131,11 +137,34 @@ def _mesh_model_kwargs(cfg: ExperimentConfig, mesh) -> dict:
             raise ValueError(f"unknown seq_impl {cfg.seq_impl!r}")
     if cfg.model_kwargs.get("num_experts", 0) > 0:
         kwargs["moe_mesh"] = mesh
+    if cfg.mesh_pipe > 1:
+        kwargs["pipe_mesh"] = mesh
+    return kwargs
+
+
+def _init_model_kwargs(cfg: ExperimentConfig) -> dict:
+    """Kwargs for the mesh-free *init* model.  Must declare the identical
+    parameter structure the mesh-aware apply model uses — the pipelined
+    block stack changes the layout (stacked per-layer params), so that
+    switch is the one mesh-dependent kwarg also applied at init."""
+    kwargs = dict(cfg.model_kwargs)
+    if cfg.model == "transformer_lm" and cfg.mesh_pipe > 1:
+        kwargs.setdefault("pipelined", True)
+        if kwargs.get("dropout_rate", 1) != 0:
+            # The pipelined stage schedule has no dropout-rng plumbing yet;
+            # running dropout-free (loudly) beats making --mesh-pipe
+            # unreachable for configs that default dropout on.
+            log.warning(
+                "mesh_pipe > 1: pipelined block stack runs dropout-free; "
+                "overriding dropout_rate=%s -> 0.0",
+                kwargs.get("dropout_rate", "default"),
+            )
+            kwargs["dropout_rate"] = 0.0
     return kwargs
 
 
 def build_state(cfg: ExperimentConfig, mesh) -> TrainState:
-    model = get_model(cfg.model, **cfg.model_kwargs)
+    model = get_model(cfg.model, **_init_model_kwargs(cfg))
     tx = cfg.optimizer.make()
     if cfg.task == "lm":
         sample = jnp.zeros(
@@ -160,7 +189,7 @@ def build_state(cfg: ExperimentConfig, mesh) -> TrainState:
             # the same key overrides the config-derived default instead of
             # raising a duplicate-kwarg TypeError.
             mesh_model = get_model(
-                cfg.model, **{**mesh_kwargs, **cfg.model_kwargs}
+                cfg.model, **{**mesh_kwargs, **_init_model_kwargs(cfg)}
             )
             state = state.replace(apply_fn=mesh_model.apply)
     else:
@@ -224,8 +253,16 @@ def fit(
     state, data_state, restored = ckptlib.restore_or_init(manager, state)
     if restored:
         # Restored arrays arrive with default placement; re-lay them out on
-        # the mesh exactly as the fresh template was.
-        state = train_loop.place_state(state, mesh)
+        # the mesh exactly as the fresh template was — including the
+        # tensor-parallel rules, or a resumed TP run would silently come
+        # back fully replicated.
+        from distributed_tensorflow_models_tpu.parallel import (
+            tensor as tensorlib,
+        )
+
+        state = train_loop.place_state(
+            state, mesh, tensorlib.get_rules(cfg.param_rules)
+        )
 
     dataset = build_dataset(cfg, "train")
     if restored and data_state.get("dataset") and hasattr(dataset, "set_state"):

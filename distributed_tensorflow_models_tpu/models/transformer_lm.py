@@ -194,6 +194,128 @@ class Block(nn.Module):
         return x + ffn(h, train=train)
 
 
+class PipelinedBlocks(nn.Module):
+    """The block stack with per-layer-stacked parameters, executed as a
+    GPipe microbatch pipeline over the ``pipe`` axis
+    (:func:`...parallel.pipeline.pipeline_apply`) when ``pipe_mesh`` is
+    set, and by the sequential reference schedule otherwise — the same
+    parameter structure either way, so the two paths are interchangeable
+    on identical variables (pinned by tests).
+
+    Parameters are declared stacked ``[L, ...]`` (per-layer fan-correct
+    init via vmapped initializers), reshaped to ``[n_stages, L/n, ...]``
+    at call time; each pipeline stage applies its ``L/n`` pre-LN blocks.
+    Restrictions of the pipelined path: dense FFN only and
+    ``dropout_rate == 0`` (rng plumbing through shard_map stages is not
+    wired); tensor-parallel rules don't target the stacked layout.
+    """
+
+    num_layers: int
+    num_heads: int
+    d_model: int
+    d_ff: int
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "auto"
+    pipe_mesh: Any = None
+    num_microbatches: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from distributed_tensorflow_models_tpu.parallel import (
+            pipeline as pplib,
+        )
+
+        L, d, f = self.num_layers, self.d_model, self.d_ff
+
+        def stacked(name, shape, stddev):
+            def init(rng):
+                ks = jax.random.split(rng, L)
+                return jax.vmap(
+                    lambda k: jax.random.normal(k, shape, jnp.float32)
+                    * stddev
+                )(ks)
+
+            return self.param(name, init)
+
+        params = {
+            "ln1_scale": self.param(
+                "ln1_scale", lambda _: jnp.ones((L, d), jnp.float32)
+            ),
+            "ln1_bias": self.param(
+                "ln1_bias", lambda _: jnp.zeros((L, d), jnp.float32)
+            ),
+            "wq": stacked("wq", (d, d), d**-0.5),
+            "wk": stacked("wk", (d, d), d**-0.5),
+            "wv": stacked("wv", (d, d), d**-0.5),
+            "wo": stacked("wo", (d, d), d**-0.5),
+            "ln2_scale": self.param(
+                "ln2_scale", lambda _: jnp.ones((L, d), jnp.float32)
+            ),
+            "ln2_bias": self.param(
+                "ln2_bias", lambda _: jnp.zeros((L, d), jnp.float32)
+            ),
+            "w_up": stacked("w_up", (d, f), d**-0.5),
+            "w_down": stacked("w_down", (f, d), f**-0.5),
+        }
+
+        H = self.num_heads
+        Dh = d // H
+        dtype = self.dtype
+        attn_impl = self.attn_impl
+
+        def _ln(x, scale, bias):
+            x32 = x.astype(jnp.float32)
+            mu = x32.mean(-1, keepdims=True)
+            var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+            return (x32 - mu) * jax.lax.rsqrt(var + 1e-6) * scale + bias
+
+        def one_layer(p, x):
+            B, T, _ = x.shape
+            h = _ln(x, p["ln1_scale"], p["ln1_bias"]).astype(dtype)
+            q = (h @ p["wq"].astype(dtype)).reshape(B, T, H, Dh)
+            k = (h @ p["wk"].astype(dtype)).reshape(B, T, H, Dh)
+            v = (h @ p["wv"].astype(dtype)).reshape(B, T, H, Dh)
+            a = attnlib.attention(q, k, v, causal=True, impl=attn_impl)
+            x = x + a.reshape(B, T, d) @ p["wo"].astype(dtype)
+            h = _ln(x, p["ln2_scale"], p["ln2_bias"]).astype(dtype)
+            h = nn.gelu(h @ p["w_up"].astype(dtype))
+            return x + h @ p["w_down"].astype(dtype)
+
+        n_stages = (
+            self.pipe_mesh.shape["pipe"] if self.pipe_mesh is not None else 1
+        )
+        if L % n_stages:
+            raise ValueError(
+                f"num_layers {L} not divisible by pipe axis {n_stages}"
+            )
+        per_stage = L // n_stages
+        staged = jax.tree.map(
+            lambda a: a.reshape((n_stages, per_stage) + a.shape[1:]), params
+        )
+
+        def stage_fn(stage_params, x):
+            for i in range(per_stage):
+                x = one_layer(
+                    jax.tree.map(lambda a: a[i], stage_params), x
+                )
+            return x
+
+        m = self.num_microbatches
+        if self.pipe_mesh is None and x.shape[0] % m:
+            # Mesh-free path (init on a tiny sample / oracle runs): the
+            # schedule is sequential anyway, so clamp rather than reject —
+            # parameters do not depend on the microbatch count.
+            m = 1
+        mbs = pplib.split_microbatches(x, m)
+        if self.pipe_mesh is None:
+            out = pplib.sequential_apply(stage_fn, staged, mbs)
+        else:
+            out = pplib.pipeline_apply(
+                stage_fn, staged, mbs, mesh=self.pipe_mesh
+            )
+        return pplib.merge_microbatches(out)
+
+
 class TransformerLM(nn.Module):
     """Input ``tokens [B, T]`` int32; returns ``(logits [B, T, V], carry)``
     — the ``carry`` passthrough keeps the LM train-step contract shared
@@ -215,6 +337,12 @@ class TransformerLM(nn.Module):
     num_experts: int = 0
     moe_mesh: Any = None
     moe_capacity_factor: float = 1.25
+    # Pipeline parallelism: stacked-parameter block stack scheduled by
+    # GPipe over the ``pipe`` axis.  ``pipelined=True`` switches the
+    # parameter layout (also without a mesh, for oracle comparisons).
+    pipelined: bool = False
+    pipe_mesh: Any = None
+    pipeline_microbatches: int = 4
 
     @nn.compact
     def __call__(self, tokens, carry=None, train: bool = False):
@@ -233,21 +361,38 @@ class TransformerLM(nn.Module):
         x = x + pos[:T].astype(self.dtype)
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        for i in range(self.num_layers):
-            x = Block(
+        if self.pipelined or self.pipe_mesh is not None:
+            if self.num_experts or self.dropout_rate:
+                raise ValueError(
+                    "pipelined path supports dense FFN with dropout_rate=0"
+                )
+            x = PipelinedBlocks(
+                self.num_layers,
                 self.num_heads,
                 self.d_model,
                 self.d_ff,
-                self.dropout_rate,
                 self.dtype,
                 self.attn_impl,
-                self.attention_fn,
-                use_moe=self.num_experts > 0 and i % 2 == 1,
-                num_experts=self.num_experts,
-                moe_mesh=self.moe_mesh,
-                moe_capacity_factor=self.moe_capacity_factor,
-                name=f"blocks_{i}",
+                self.pipe_mesh,
+                self.pipeline_microbatches,
+                name="pipeline",
             )(x, train=train)
+        else:
+            for i in range(self.num_layers):
+                x = Block(
+                    self.num_heads,
+                    self.d_model,
+                    self.d_ff,
+                    self.dropout_rate,
+                    self.dtype,
+                    self.attn_impl,
+                    self.attention_fn,
+                    use_moe=self.num_experts > 0 and i % 2 == 1,
+                    num_experts=self.num_experts,
+                    moe_mesh=self.moe_mesh,
+                    moe_capacity_factor=self.moe_capacity_factor,
+                    name=f"blocks_{i}",
+                )(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(
             self.vocab_size, dtype=jnp.float32, name="head"
