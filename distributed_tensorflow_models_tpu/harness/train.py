@@ -112,6 +112,13 @@ def _mesh_model_kwargs(cfg: ExperimentConfig, mesh) -> dict:
             "stack schedules whole blocks per stage and does not route "
             "through the sequence-parallel attention_fn"
         )
+    if cfg.mesh_pipe > 1 and cfg.mesh_model > 1:
+        raise ValueError(
+            "mesh_pipe and mesh_model cannot combine: the tensor-parallel "
+            "rule sets target per-block parameter names, which the "
+            "pipelined stacked layout does not use — TP would silently "
+            "fall back to replication"
+        )
     kwargs: dict = {"attn_impl": cfg.attn_impl}
     if cfg.seq_impl:
         from distributed_tensorflow_models_tpu.parallel import ring as ringlib

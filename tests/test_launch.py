@@ -97,32 +97,39 @@ def test_cli_multihost_mode_sets_env_and_execs(monkeypatch):
     monkeypatch.setattr(os, "execvp", fake_exec)
     # main() mutates os.environ before exec; keep the DTM_* facts from
     # leaking into later tests (initialize_from_env would try to join a
-    # nonexistent cluster).
-    for var in (
+    # nonexistent cluster).  monkeypatch.delenv on an *absent* var records
+    # nothing to restore, so main()'s writes would survive teardown — the
+    # finally-pop is the actual cleanup.
+    env_vars = (
         launch.ENV_COORDINATOR,
         launch.ENV_NUM_PROCESSES,
         launch.ENV_PROCESS_ID,
         launch.ENV_CPU_DEVICES,
-    ):
+    )
+    for var in env_vars:
         monkeypatch.delenv(var, raising=False)
-    with pytest.raises(SystemExit):
-        launch.main(
-            [
-                "--num-processes",
-                "4",
-                "--coordinator",
-                "10.0.0.1:1234",
-                "--process-id",
-                "3",
-                "--",
-                "python",
-                "driver.py",
-            ]
-        )
-    assert seen["argv"] == ["python", "driver.py"]
-    assert os.environ[launch.ENV_COORDINATOR] == "10.0.0.1:1234"
-    assert os.environ[launch.ENV_NUM_PROCESSES] == "4"
-    assert os.environ[launch.ENV_PROCESS_ID] == "3"
+    try:
+        with pytest.raises(SystemExit):
+            launch.main(
+                [
+                    "--num-processes",
+                    "4",
+                    "--coordinator",
+                    "10.0.0.1:1234",
+                    "--process-id",
+                    "3",
+                    "--",
+                    "python",
+                    "driver.py",
+                ]
+            )
+        assert seen["argv"] == ["python", "driver.py"]
+        assert os.environ[launch.ENV_COORDINATOR] == "10.0.0.1:1234"
+        assert os.environ[launch.ENV_NUM_PROCESSES] == "4"
+        assert os.environ[launch.ENV_PROCESS_ID] == "3"
+    finally:
+        for var in env_vars:
+            os.environ.pop(var, None)
 
 
 FIT_WORKER = textwrap.dedent(

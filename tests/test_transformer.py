@@ -225,3 +225,9 @@ def test_tp_resume_preserves_sharding(tmp_path):
     assert int(res.state.step) == 4
     spec = res.state.params["blocks_0"]["attn"]["query"]["kernel"].sharding.spec
     assert AxisNames.MODEL in spec, spec
+
+
+def test_pipe_rejects_tp_combo():
+    cfg = tiny_cfg(global_batch_size=16, mesh_pipe=2, mesh_model=2)
+    with pytest.raises(ValueError, match="mesh_model"):
+        trainlib.fit(cfg, tempfile.mkdtemp())
