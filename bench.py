@@ -485,6 +485,15 @@ ORDER = [
 CHILD_MODES = sorted(BUILDERS) + ["flash_check"]
 
 
+def run_mode(name, args):
+    """Single dispatch point for both the child process and the
+    --in-process path: train-loop configs go through run_one; standalone
+    microbenches run directly."""
+    if name == "flash_check":
+        return run_flash_check(args)
+    return run_one(name, BUILDERS[name], args.steps, args.batch or None)
+
+
 def run_child(args):
     """--child mode: run exactly one config in this process and print its
     result as one JSON line.  Any failure still prints a JSON line."""
@@ -493,15 +502,7 @@ def run_child(args):
 
         if os.environ.get("DTM_BENCH_FORCE_CPU"):
             jax.config.update("jax_platforms", "cpu")
-        if args.child == "flash_check":
-            result = run_flash_check(args)
-        else:
-            result = run_one(
-                args.child,
-                BUILDERS[args.child],
-                args.steps,
-                args.batch or None,
-            )
+        result = run_mode(args.child, args)
         result["platform"] = jax.devices()[0].platform
         result["device"] = jax.devices()[0].device_kind
         result["n_devices"] = len(jax.devices())
@@ -586,6 +587,11 @@ def _orchestrate(args):
     attempts = run_info["attempts"]
 
     names = list(ORDER) if args.config == "all" else [args.config]
+    if force_cpu and "flash_check" in names and args.config == "all":
+        # No point paying a subprocess JAX startup just to learn the
+        # Mosaic kernel needs the TPU we already know is unusable.
+        names.remove("flash_check")
+        log("skipping flash_check: TPU backend unusable")
     results, errors = {}, {}
     for name in names:
         # Each config runs in its own subprocess: a wedged backend call
@@ -622,12 +628,7 @@ def _orchestrate(args):
                     # clearing the env var keeps child processes clean.
                     jax.config.update("jax_platforms", "cpu")
                     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-                if name == "flash_check":
-                    results[name] = run_flash_check(args)
-                else:
-                    results[name] = run_one(
-                        name, BUILDERS[name], args.steps, args.batch or None
-                    )
+                results[name] = run_mode(name, args)
                 dev = jax.devices()[0]
                 results[name].update(
                     platform=dev.platform,

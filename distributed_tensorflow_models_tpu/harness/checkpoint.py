@@ -14,14 +14,14 @@ What is saved per step: the array leaves of :class:`TrainState`
 the dataset iterator state.
 
 Multi-host: orbax saves are collective (every process calls ``save``; array
-shards are written by their owning hosts, the JSON by the primary).  The
-dataset-state JSON therefore records process 0's iterator position.  For
-the array- and PTB-backed datasets that position is identical on every
-process (same epoch/batch counters), so resume is exact; for the
-file-sharded ImageNet stream each process's shard position differs and a
-restore realigns all processes to process 0's position — an approximate
-(within-epoch) resume, still strictly beyond the reference, whose queue
-pipeline cannot resume input position at all (SURVEY.md §5.4).
+shards are written by their owning hosts, the JSON by the primary), so the
+orbax JSON records process 0's iterator position.  With more than one
+process each process *additionally* writes its own dataset state to a
+per-step sidecar (``checkpoints/dataset_states/<step>/p<pid>.json``,
+atomic rename, pruned alongside orbax's keep-k GC) and restores from its
+own sidecar — exact per-process resume even for the file-sharded ImageNet
+stream, where every process's shard position differs.  The reference's
+queue pipeline cannot resume input position at all (SURVEY.md §5.4).
 """
 
 from __future__ import annotations
@@ -51,14 +51,41 @@ def _array_tree(state: TrainState) -> dict:
 
 
 class CheckpointManager:
-    """keep-last-k, async, atomic checkpoints under ``workdir/checkpoints``."""
+    """keep-last-k, async, atomic checkpoints under ``workdir/checkpoints``.
 
-    def __init__(self, workdir: str, keep: int = 5):
+    ``process_index``/``process_count`` default to the live jax values;
+    they are injectable so the per-process sidecar path is unit-testable
+    without a real multi-process cluster.
+    """
+
+    def __init__(
+        self,
+        workdir: str,
+        keep: int = 5,
+        *,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+    ):
+        self._dir = f"{workdir}/checkpoints"
         self._mgr = ocp.CheckpointManager(
-            f"{workdir}/checkpoints",
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=keep, create=True
             ),
+        )
+        self._pid = (
+            jax.process_index() if process_index is None else process_index
+        )
+        self._nproc = (
+            jax.process_count() if process_count is None else process_count
+        )
+
+    def _sidecar(self, step: int, pid: Optional[int] = None) -> str:
+        import os
+
+        pid = self._pid if pid is None else pid
+        return os.path.join(
+            self._dir, "dataset_states", str(step), f"p{pid}.json"
         )
 
     def save(
@@ -77,9 +104,30 @@ class CheckpointManager:
             ),
             force=force,
         )
+        if saved and self._nproc > 1 and dataset_state is not None:
+            self._write_sidecar(step, dataset_state)
         if saved:
             log.info("saved checkpoint at step %d", step)
         return saved
+
+    def _write_sidecar(self, step: int, dataset_state: dict) -> None:
+        """Per-process dataset position (atomic rename), pruned to the
+        steps orbax retains."""
+        import json
+        import os
+        import shutil
+
+        path = self._sidecar(step)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(dataset_state, f)
+        os.replace(tmp, path)
+        base = os.path.join(self._dir, "dataset_states")
+        keep = {str(s) for s in self._mgr.all_steps()} | {str(step)}
+        for name in os.listdir(base):
+            if name not in keep:
+                shutil.rmtree(os.path.join(base, name), ignore_errors=True)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -113,7 +161,22 @@ class CheckpointManager:
             ema_params=tree["ema_params"],
             carry=tree["carry"],
         )
-        return state, dict(out.data or {})
+        data = dict(out.data or {})
+        if self._nproc > 1:
+            import json
+            import os
+
+            path = self._sidecar(step)
+            if os.path.exists(path):
+                with open(path) as f:
+                    data = json.load(f)
+            else:
+                log.warning(
+                    "no per-process dataset sidecar at %s; using the "
+                    "primary's position (approximate resume)",
+                    path,
+                )
+        return state, data
 
     def wait(self) -> None:
         """Block until pending async saves are durable."""
