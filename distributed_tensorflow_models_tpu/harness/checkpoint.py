@@ -26,7 +26,10 @@ queue pipeline cannot resume input position at all (SURVEY.md §5.4).
 
 from __future__ import annotations
 
+import json
 import logging
+import os
+import shutil
 from typing import Any, Optional
 
 import jax
@@ -81,8 +84,6 @@ class CheckpointManager:
         )
 
     def _sidecar(self, step: int, pid: Optional[int] = None) -> str:
-        import os
-
         pid = self._pid if pid is None else pid
         return os.path.join(
             self._dir, "dataset_states", str(step), f"p{pid}.json"
@@ -112,16 +113,14 @@ class CheckpointManager:
 
     def _write_sidecar(self, step: int, dataset_state: dict) -> None:
         """Per-process dataset position (atomic rename), pruned to the
-        steps orbax retains."""
-        import json
-        import os
-        import shutil
-
+        steps orbax retains.  The process count is recorded alongside: a
+        sidecar written under a different shard topology must not be
+        restored as an exact position."""
         path = self._sidecar(step)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
-            json.dump(dataset_state, f)
+            json.dump({"nproc": self._nproc, "state": dataset_state}, f)
         os.replace(tmp, path)
         base = os.path.join(self._dir, "dataset_states")
         keep = {str(s) for s in self._mgr.all_steps()} | {str(step)}
@@ -163,18 +162,22 @@ class CheckpointManager:
         )
         data = dict(out.data or {})
         if self._nproc > 1:
-            import json
-            import os
-
             path = self._sidecar(step)
+            wrapped = None
             if os.path.exists(path):
                 with open(path) as f:
-                    data = json.load(f)
+                    wrapped = json.load(f)
+            if wrapped is not None and wrapped.get("nproc") == self._nproc:
+                data = wrapped["state"]
             else:
                 log.warning(
-                    "no per-process dataset sidecar at %s; using the "
+                    "per-process dataset sidecar at %s is %s; using the "
                     "primary's position (approximate resume)",
                     path,
+                    "missing"
+                    if wrapped is None
+                    else f"from a {wrapped.get('nproc')}-process run, "
+                    f"not {self._nproc}",
                 )
         return state, data
 

@@ -17,7 +17,6 @@ from typing import Optional
 import jax
 import numpy as np
 
-from distributed_tensorflow_models_tpu.core import mesh as meshlib
 from distributed_tensorflow_models_tpu.core import sharding
 from distributed_tensorflow_models_tpu.core import train_loop
 from distributed_tensorflow_models_tpu.harness import checkpoint as ckptlib
@@ -45,9 +44,7 @@ def evaluate_classification(
     """One eval pass at the latest checkpoint: top-1/top-5 over the
     validation split (counting scheme of the reference's eval loop)."""
     if mesh is None:
-        mesh = meshlib.create_mesh(
-            meshlib.MeshSpec(data=cfg.mesh_data, model=cfg.mesh_model)
-        )
+        mesh = trainlib.mesh_from_config(cfg)
     template = trainlib.build_state(cfg, mesh)
     manager = ckptlib.CheckpointManager(workdir, keep=cfg.keep_checkpoints)
     state, _ = manager.restore(template)
@@ -115,9 +112,7 @@ def evaluate_lm(
     """Perplexity over the validation stream (R8's ``run_epoch`` eval):
     fresh zero carry, threaded across the whole split, ppl = exp(mean nll)."""
     if mesh is None:
-        mesh = meshlib.create_mesh(
-            meshlib.MeshSpec(data=cfg.mesh_data, model=cfg.mesh_model)
-        )
+        mesh = trainlib.mesh_from_config(cfg)
     template = trainlib.build_state(cfg, mesh)
     manager = ckptlib.CheckpointManager(workdir, keep=cfg.keep_checkpoints)
     state, _ = manager.restore(template)

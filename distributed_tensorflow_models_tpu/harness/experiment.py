@@ -27,7 +27,6 @@ from typing import Optional
 import jax
 import numpy as np
 
-from distributed_tensorflow_models_tpu.core import mesh as meshlib
 from distributed_tensorflow_models_tpu.core import sharding as shardlib
 from distributed_tensorflow_models_tpu.core import train_loop
 from distributed_tensorflow_models_tpu.harness import train as trainlib
@@ -95,9 +94,7 @@ def async_vs_sync(
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
     if mesh is None:
-        mesh = meshlib.create_mesh(
-            meshlib.MeshSpec(data=cfg.mesh_data, model=cfg.mesh_model)
-        )
+        mesh = trainlib.mesh_from_config(cfg)
     rng = jax.random.key(cfg.seed + 1)
 
     # One materialised batch stream, replayed identically in both modes.

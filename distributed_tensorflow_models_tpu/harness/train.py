@@ -97,6 +97,21 @@ def build_dataset(cfg: ExperimentConfig, split: str = "train"):
     raise ValueError(f"unknown dataset {cfg.dataset!r}")
 
 
+def mesh_from_config(cfg: ExperimentConfig):
+    """The one place a config becomes a mesh — every driver (fit, the eval
+    loops, the A/B experiment) must agree on axis sizes or a config trained
+    on a seq/pipe/expert mesh would be evaluated on a different topology."""
+    return meshlib.create_mesh(
+        meshlib.MeshSpec(
+            data=cfg.mesh_data,
+            model=cfg.mesh_model,
+            seq=cfg.mesh_seq,
+            pipe=cfg.mesh_pipe,
+            expert=cfg.mesh_expert,
+        )
+    )
+
+
 def _mesh_model_kwargs(cfg: ExperimentConfig, mesh) -> dict:
     """Mesh-dependent model kwargs for attention models: the attention
     implementation and, when ``seq_impl``/``mesh_expert`` are configured,
@@ -246,15 +261,7 @@ def fit(
     """Train ``cfg`` to ``cfg.train_steps``, resuming from ``workdir`` if a
     checkpoint exists.  Returns the final (host-fetched) state."""
     if mesh is None:
-        mesh = meshlib.create_mesh(
-            meshlib.MeshSpec(
-                data=cfg.mesh_data,
-                model=cfg.mesh_model,
-                seq=cfg.mesh_seq,
-                pipe=cfg.mesh_pipe,
-                expert=cfg.mesh_expert,
-            )
-        )
+        mesh = mesh_from_config(cfg)
     state = build_state(cfg, mesh)
     manager = ckptlib.CheckpointManager(workdir, keep=cfg.keep_checkpoints)
     state, data_state, restored = ckptlib.restore_or_init(manager, state)

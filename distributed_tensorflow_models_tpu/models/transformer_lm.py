@@ -343,6 +343,10 @@ class TransformerLM(nn.Module):
     pipelined: bool = False
     pipe_mesh: Any = None
     pipeline_microbatches: int = 4
+    # Rematerialize each block in backward (jax.checkpoint): trades ~1/3
+    # more FLOPs for O(num_layers) less activation HBM — the standard TPU
+    # long-context memory lever (SURVEY.md TPU notes).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, carry=None, train: bool = False):
@@ -362,9 +366,10 @@ class TransformerLM(nn.Module):
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         if self.pipelined or self.pipe_mesh is not None:
-            if self.num_experts or self.dropout_rate:
+            if self.num_experts or self.dropout_rate or self.remat:
                 raise ValueError(
-                    "pipelined path supports dense FFN with dropout_rate=0"
+                    "pipelined path supports dense FFN with dropout_rate=0 "
+                    "and remat=False (remat the stage_fn instead)"
                 )
             x = PipelinedBlocks(
                 self.num_layers,
@@ -378,8 +383,13 @@ class TransformerLM(nn.Module):
                 name="pipeline",
             )(x, train=train)
         else:
+            block_cls = (
+                nn.remat(Block, static_argnums=(2,))
+                if self.remat
+                else Block
+            )
             for i in range(self.num_layers):
-                x = Block(
+                x = block_cls(
                     self.num_heads,
                     self.d_model,
                     self.d_ff,
@@ -392,7 +402,7 @@ class TransformerLM(nn.Module):
                     moe_mesh=self.moe_mesh,
                     moe_capacity_factor=self.moe_capacity_factor,
                     name=f"blocks_{i}",
-                )(x, train=train)
+                )(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(
             self.vocab_size, dtype=jnp.float32, name="head"

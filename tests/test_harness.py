@@ -515,3 +515,22 @@ def test_checkpoint_sidecar_pruned_with_keep_k(tmp_path):
     base = os.path.join(str(tmp_path), "checkpoints/dataset_states")
     assert sorted(os.listdir(base)) == ["2"]
     mgr.close()
+
+
+def test_checkpoint_sidecar_topology_mismatch_falls_back(tmp_path):
+    """A sidecar from an N-process run must not be restored as exact when
+    resuming with a different process count."""
+    state = _tiny_state().replace(step=jnp.asarray(5, jnp.int32))
+    mgr4 = ckptlib.CheckpointManager(
+        str(tmp_path), process_index=1, process_count=4
+    )
+    assert mgr4.save(state, {"pos": "4way"})
+    mgr4.wait()
+    # Same pid, different topology: falls back to the primary JSON.
+    mgr2 = ckptlib.CheckpointManager(
+        str(tmp_path), process_index=1, process_count=2
+    )
+    _, data = mgr2.restore(_tiny_state())
+    assert data == {"pos": "4way"}  # orbax primary copy, not the sidecar
+    mgr4.close()
+    mgr2.close()
