@@ -592,6 +592,17 @@ def _orchestrate(args):
         # Mosaic kernel needs the TPU we already know is unusable.
         names.remove("flash_check")
         log("skipping flash_check: TPU backend unusable")
+    if force_cpu:
+        # CPU numbers are evidence-of-life, not performance: shrink the
+        # workload so every config finishes inside its timeout on a
+        # 2-core host (a batch-256 ResNet-50 would burn the whole budget).
+        if not args.batch:
+            args.batch = 4
+        args.steps = min(args.steps, 3)
+        log(
+            f"CPU fallback: shrinking workload to steps={args.steps}, "
+            f"batch={args.batch}/chip"
+        )
     results, errors = {}, {}
     for name in names:
         # Each config runs in its own subprocess: a wedged backend call

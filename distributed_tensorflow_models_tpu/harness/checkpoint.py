@@ -167,17 +167,25 @@ class CheckpointManager:
             if os.path.exists(path):
                 with open(path) as f:
                     wrapped = json.load(f)
-            if wrapped is not None and wrapped.get("nproc") == self._nproc:
+            if wrapped is None:
+                log.warning(
+                    "no per-process dataset sidecar at %s; using the "
+                    "primary's position (approximate resume)",
+                    path,
+                )
+            elif "nproc" not in wrapped:
+                # Legacy bare-dict sidecar (pre-topology-stamp): same
+                # format, assume same topology.
+                data = wrapped
+            elif wrapped["nproc"] == self._nproc:
                 data = wrapped["state"]
             else:
                 log.warning(
-                    "per-process dataset sidecar at %s is %s; using the "
-                    "primary's position (approximate resume)",
+                    "dataset sidecar at %s is from a %s-process run, not "
+                    "%d; using the primary's position (approximate resume)",
                     path,
-                    "missing"
-                    if wrapped is None
-                    else f"from a {wrapped.get('nproc')}-process run, "
-                    f"not {self._nproc}",
+                    wrapped["nproc"],
+                    self._nproc,
                 )
         return state, data
 

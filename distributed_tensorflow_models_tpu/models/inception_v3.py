@@ -225,7 +225,14 @@ class InceptionV3(nn.Module):
         x = InceptionB(160, self.dtype, name="Mixed_6d")(x, train=train)
         x = InceptionB(192, self.dtype, name="Mixed_6e")(x, train=train)
         aux = None
-        if self.aux_head and train:
+        if self.aux_head:
+            # Run (not just declare) the aux head regardless of mode so a
+            # plain eval-mode init creates its parameters — the harness
+            # inits with train=False and then trains with train=True, and
+            # lazily-created aux params would be missing from the
+            # TrainState (found by the bench's CPU-fallback run).  At eval
+            # the unused result is dead-code-eliminated by XLA; only the
+            # train path returns it.
             aux = AuxHead(self.num_classes, self.dtype, name="AuxHead")(
                 x, train=train
             )
@@ -237,7 +244,7 @@ class InceptionV3(nn.Module):
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = x.astype(jnp.float32)
         logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
-        if aux is not None:
+        if aux is not None and train:
             return logits, aux
         return logits
 

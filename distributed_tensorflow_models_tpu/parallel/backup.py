@@ -1,0 +1,153 @@
+"""Backup-replica sync training (straggler mitigation), emulated.
+
+Reference semantics (SURVEY.md §2.4 row 3; TF sync_replicas_optimizer.py:
+155-162,184): with ``total_num_replicas > replicas_to_aggregate`` every
+worker computes a gradient each step but the accumulators' ``take_grad(N)``
+averages only the FIRST N to arrive — late (straggler) gradients carry a
+stale ``local_step`` stamp and are dropped at the next round.  The point
+was hiding slow workers behind ``M - N`` spares.
+
+A synchronous ICI TPU slice has no stragglers inside the collective, so
+this cannot (and should not) change the compiled SPMD step — SURVEY.md
+calls the flag "not meaningful" there.  What *can* be reproduced exactly
+is the semantics, for A/B studies of the reference's trade-off: this
+emulator runs ``M`` virtual replicas on their own batch shards from the
+same canonical parameters, draws a seeded arrival order per step, averages
+the first ``N`` gradients, and discards the rest — deterministic replay,
+same anchor style as :class:`...parallel.async_ps.AsyncPSEmulator`.
+
+With ``N == M`` and equal shard sizes the update equals the sync SPMD step
+on the concatenated batch (mean of per-shard mean-loss gradients == the
+global-mean gradient), which is the correctness anchor the tests pin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_models_tpu.core import train_loop
+from distributed_tensorflow_models_tpu.core.train_loop import LossFn
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+
+PyTree = Any
+Batch = Mapping[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class BackupConfig:
+    """``total_replicas`` = the reference's ``total_num_replicas``;
+    ``replicas_to_aggregate`` = how many gradients each step averages.
+    ``seed`` drives the per-step arrival permutation (deterministic
+    replay)."""
+
+    total_replicas: int = 5
+    replicas_to_aggregate: int = 4
+    seed: int = 0
+
+    def __post_init__(self):
+        if not 1 <= self.replicas_to_aggregate <= self.total_replicas:
+            raise ValueError(
+                f"need 1 <= replicas_to_aggregate "
+                f"({self.replicas_to_aggregate}) <= total_replicas "
+                f"({self.total_replicas})"
+            )
+
+
+class SyncBackupEmulator:
+    """First-N-of-M gradient aggregation over a compiled grad/apply pair."""
+
+    def __init__(
+        self,
+        state: TrainState,
+        loss_fn: LossFn,
+        config: BackupConfig = BackupConfig(),
+        rng_names: Sequence[str] = ("dropout",),
+    ):
+        self.config = config
+        self.state = state
+        self._rng_names = tuple(rng_names)
+        self._sched_rng = np.random.RandomState(config.seed)
+        self.discarded: int = 0
+        self._event = 0
+
+        def grad_fn(params, state, batch, rng, event):
+            rngs = train_loop.per_step_rngs(rng, event, self._rng_names)
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, state, batch, rngs
+            )
+            return grads, aux
+
+        self._grad = jax.jit(grad_fn)
+
+        def apply_mean(state, grads_list, aux):
+            mean = jax.tree.map(
+                lambda *gs: sum(gs) / len(gs), *grads_list
+            )
+            return train_loop.apply_gradients(state, mean, aux)
+
+        self._apply = jax.jit(apply_mean)
+
+    def step(self, shard_batches: Sequence[Batch], rng: jax.Array) -> dict:
+        """One aggregation round.
+
+        ``shard_batches``: one batch per replica (the reference's
+        per-worker input streams).  All replicas read the same canonical
+        parameters (sync mode); a seeded arrival permutation decides which
+        ``replicas_to_aggregate`` gradients win; the rest are discarded.
+        (The emulator skips the stragglers' gradient computation entirely
+        — in the reference that compute happened and was wasted; only the
+        *update semantics* are reproduced here, not the FLOP economics.)
+        """
+        M, N = self.config.total_replicas, self.config.replicas_to_aggregate
+        if len(shard_batches) != M:
+            raise ValueError(
+                f"need {M} shard batches, got {len(shard_batches)}"
+            )
+        order = self._sched_rng.permutation(M)
+        chosen, late = order[:N], order[N:]
+        grads_list, aux = [], None
+        for ridx in chosen:
+            # Per-replica rng salt (event*M + replica): the reference's
+            # workers drew independent randomness; a shared mask would
+            # bias dropout-averaging studies.
+            grads, aux = self._grad(
+                self.state.params,
+                self.state,
+                shard_batches[int(ridx)],
+                rng,
+                self._event * M + int(ridx),
+            )
+            grads_list.append(grads)
+        # aux (BN stats / carry / metrics) from the last arriving included
+        # replica: PS-resident aux variables were last-writer-wins.
+        self.state = self._apply(self.state, grads_list, aux)
+        self.discarded += len(late)
+        self._event += 1
+        return {
+            "chosen": [int(i) for i in chosen],
+            "discarded": [int(i) for i in late],
+            "metrics": aux.get("metrics", {}),
+        }
+
+    def run(
+        self,
+        shard_batch_stream: Sequence[Sequence[Batch]],
+        rng: jax.Array,
+    ) -> list[dict]:
+        return [self.step(bs, rng) for bs in shard_batch_stream]
+
+
+def split_into_shards(batch: Batch, num_shards: int) -> list[Batch]:
+    """Cut a global batch into equal per-replica shards (row blocks)."""
+    n = next(iter(batch.values())).shape[0]
+    if n % num_shards:
+        raise ValueError(f"batch {n} not divisible by {num_shards} shards")
+    k = n // num_shards
+    return [
+        {key: v[i * k : (i + 1) * k] for key, v in batch.items()}
+        for i in range(num_shards)
+    ]

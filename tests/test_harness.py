@@ -520,17 +520,74 @@ def test_checkpoint_sidecar_pruned_with_keep_k(tmp_path):
 def test_checkpoint_sidecar_topology_mismatch_falls_back(tmp_path):
     """A sidecar from an N-process run must not be restored as exact when
     resuming with a different process count."""
+    import json
+    import os
+
     state = _tiny_state().replace(step=jnp.asarray(5, jnp.int32))
     mgr4 = ckptlib.CheckpointManager(
         str(tmp_path), process_index=1, process_count=4
     )
-    assert mgr4.save(state, {"pos": "4way"})
+    assert mgr4.save(state, {"pos": "primary"})
     mgr4.wait()
-    # Same pid, different topology: falls back to the primary JSON.
+    # Make the sidecar's payload distinct from the orbax primary copy so
+    # the assertion discriminates which path restore() actually took.
+    sidecar = os.path.join(
+        str(tmp_path), "checkpoints/dataset_states/5/p1.json"
+    )
+    with open(sidecar, "w") as f:
+        json.dump({"nproc": 4, "state": {"pos": "sidecar"}}, f)
+    # Same pid, different topology: must fall back to the primary JSON.
     mgr2 = ckptlib.CheckpointManager(
         str(tmp_path), process_index=1, process_count=2
     )
     _, data = mgr2.restore(_tiny_state())
-    assert data == {"pos": "4way"}  # orbax primary copy, not the sidecar
-    mgr4.close()
-    mgr2.close()
+    assert data == {"pos": "primary"}
+    # Matching topology: the sidecar is exact and wins.
+    mgr4b = ckptlib.CheckpointManager(
+        str(tmp_path), process_index=1, process_count=4
+    )
+    _, data4 = mgr4b.restore(_tiny_state())
+    assert data4 == {"pos": "sidecar"}
+    # Legacy bare-dict sidecar (no topology stamp): same format, restored.
+    with open(sidecar, "w") as f:
+        json.dump({"pos": "legacy"}, f)
+    mgr4c = ckptlib.CheckpointManager(
+        str(tmp_path), process_index=1, process_count=4
+    )
+    _, datal = mgr4c.restore(_tiny_state())
+    assert datal == {"pos": "legacy"}
+    for m in (mgr4, mgr2, mgr4b, mgr4c):
+        m.close()
+
+
+def test_inception_harness_state_traces_train_step():
+    """build_state inits with train=False; the train step applies with
+    train=True.  Every parameter the train path uses (incl. the aux head)
+    must exist in that state — pinned at trace level so the full 299x299
+    model costs no FLOPs here.  Regression: aux params used to be created
+    only under train=True init, crashing inception training."""
+    import numpy as np
+
+    from distributed_tensorflow_models_tpu.core import train_loop
+    from distributed_tensorflow_models_tpu.harness.config import get_config
+
+    cfg = get_config("inception_v3_imagenet", global_batch_size=2)
+    mesh = trainlib.mesh_from_config(cfg)
+    state = trainlib.build_state(cfg, mesh)
+    loss_fn = train_loop.classification_loss_fn(
+        state.apply_fn,
+        label_smoothing=cfg.label_smoothing,
+        weight_decay=cfg.weight_decay,
+        aux_loss_weight=cfg.aux_loss_weight,
+    )
+    step_fn = train_loop.make_train_step_fn(loss_fn)
+    batch = {
+        "image": np.zeros((2, 299, 299, 3), np.float32),
+        "label": np.zeros((2,), np.int32),
+    }
+    out_state, metrics = jax.eval_shape(
+        step_fn, state, batch, jax.random.key(0)
+    )
+    assert metrics["loss"].shape == ()
+    # Aux head params must be in the state (declared at eval-mode init).
+    assert "AuxHead" in state.params

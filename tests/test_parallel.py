@@ -204,3 +204,96 @@ def test_async_loss_decreases():
         float(emu.step(batch, rng)["metrics"]["loss"]) for _ in range(30)
     ]
     assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+# --------------------------------------------------------------------------
+# Backup replicas (SURVEY.md §2.4 row 3 — first-N-of-M aggregation)
+# --------------------------------------------------------------------------
+
+
+class TestSyncBackupEmulator:
+    def _setup(self, total, aggregate, seed=0):
+        from distributed_tensorflow_models_tpu.models import get_model
+        from distributed_tensorflow_models_tpu.ops import optim
+        from distributed_tensorflow_models_tpu.parallel import backup
+
+        model = get_model("lenet", dropout_rate=0.0)
+        tx = optim.sgd(0.1)
+        state = TrainState.create(
+            model, tx, jax.random.key(0), jnp.zeros((2, 28, 28, 1))
+        )
+        loss_fn = train_loop.classification_loss_fn(model.apply)
+        emu = backup.SyncBackupEmulator(
+            state,
+            loss_fn,
+            backup.BackupConfig(
+                total_replicas=total,
+                replicas_to_aggregate=aggregate,
+                seed=seed,
+            ),
+        )
+        return emu, state, loss_fn
+
+    def _batches(self, n, seed=0):
+        rng = np.random.RandomState(seed)
+        return {
+            "image": rng.rand(n, 28, 28, 1).astype(np.float32),
+            "label": rng.randint(0, 10, (n,)),
+        }
+
+    def test_full_aggregation_matches_sync_step(self):
+        """N == M anchors to the compiled sync step on the global batch:
+        mean of per-shard mean-loss gradients == the global-mean gradient."""
+        from distributed_tensorflow_models_tpu.parallel import backup
+
+        emu, state, loss_fn = self._setup(total=4, aggregate=4)
+        global_batch = self._batches(16)
+        shards = backup.split_into_shards(global_batch, 4)
+        rng = jax.random.key(7)
+        emu.step(shards, rng)
+
+        step_fn = train_loop.make_train_step(loss_fn, donate=False)
+        ref_state, _ = step_fn(
+            state, jax.tree.map(jnp.asarray, global_batch), rng
+        )
+        for a, b in zip(
+            jax.tree.leaves(emu.state.params),
+            jax.tree.leaves(ref_state.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-6
+            )
+        assert emu.discarded == 0
+
+    def test_straggler_gradients_are_discarded(self):
+        """The late M-N replicas' data must not influence the update —
+        the first-N-win semantics of take_grad(N)."""
+        from distributed_tensorflow_models_tpu.parallel import backup
+
+        emu1, _, _ = self._setup(total=3, aggregate=2, seed=5)
+        emu2, _, _ = self._setup(total=3, aggregate=2, seed=5)
+        rng = jax.random.key(7)
+        shards1 = backup.split_into_shards(self._batches(12, seed=1), 3)
+        shards2 = [dict(s) for s in shards1]
+        rec = emu1.step(shards1, rng)
+        (late_idx,) = rec["discarded"]
+        # Poison ONLY the discarded replica's batch in the second run.
+        shards2[late_idx] = {
+            "image": np.zeros_like(shards2[late_idx]["image"]),
+            "label": shards2[late_idx]["label"] * 0,
+        }
+        emu2.step(shards2, rng)
+        for a, b in zip(
+            jax.tree.leaves(emu1.state.params),
+            jax.tree.leaves(emu2.state.params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert emu1.discarded == emu2.discarded == 1
+
+    def test_config_validation(self):
+        from distributed_tensorflow_models_tpu.parallel import backup
+
+        with pytest.raises(ValueError):
+            backup.BackupConfig(total_replicas=2, replicas_to_aggregate=3)
+        with pytest.raises(ValueError):
+            backup.split_into_shards({"x": np.zeros((5, 2))}, 2)
