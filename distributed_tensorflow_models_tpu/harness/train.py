@@ -172,16 +172,6 @@ def _init_model_kwargs(cfg: ExperimentConfig) -> dict:
     kwargs = dict(cfg.model_kwargs)
     if cfg.model == "transformer_lm" and cfg.mesh_pipe > 1:
         kwargs.setdefault("pipelined", True)
-        if kwargs.get("dropout_rate", 1) != 0:
-            # The pipelined stage schedule has no dropout-rng plumbing yet;
-            # running dropout-free (loudly) beats making --mesh-pipe
-            # unreachable for configs that default dropout on.
-            log.warning(
-                "mesh_pipe > 1: pipelined block stack runs dropout-free; "
-                "overriding dropout_rate=%s -> 0.0",
-                kwargs.get("dropout_rate", "default"),
-            )
-            kwargs["dropout_rate"] = 0.0
     return kwargs
 
 

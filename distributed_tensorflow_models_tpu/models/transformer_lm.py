@@ -205,9 +205,12 @@ class PipelinedBlocks(nn.Module):
     Parameters are declared stacked ``[L, ...]`` (per-layer fan-correct
     init via vmapped initializers), reshaped to ``[n_stages, L/n, ...]``
     at call time; each pipeline stage applies its ``L/n`` pre-LN blocks.
-    Restrictions of the pipelined path: dense FFN only and
-    ``dropout_rate == 0`` (rng plumbing through shard_map stages is not
-    wired); tensor-parallel rules don't target the stacked layout.
+    Dropout works through the stages: the step's dropout key rides with
+    the stage parameter slices (raw uint32) and masks are derived per
+    (layer, sublayer, global batch row), so the pipelined and sequential
+    schedules produce identical masks and data-shards stay independent.
+    Restrictions of the pipelined path: dense FFN only; tensor-parallel
+    rules don't target the stacked layout.
     """
 
     num_layers: int
@@ -218,6 +221,7 @@ class PipelinedBlocks(nn.Module):
     attn_impl: str = "auto"
     pipe_mesh: Any = None
     num_microbatches: int = 4
+    dropout_rate: float = 0.0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -262,6 +266,10 @@ class PipelinedBlocks(nn.Module):
         Dh = d // H
         dtype = self.dtype
         attn_impl = self.attn_impl
+        rate = self.dropout_rate if train else 0.0
+        dropout_key = (
+            jax.random.key_data(self.make_rng("dropout")) if rate else None
+        )
 
         def _ln(x, scale, bias):
             x32 = x.astype(jnp.float32)
@@ -269,17 +277,38 @@ class PipelinedBlocks(nn.Module):
             var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
             return (x32 - mu) * jax.lax.rsqrt(var + 1e-6) * scale + bias
 
-        def one_layer(p, x):
+        def _dropout(x, p, row_ids, sub):
+            # Keyed per (layer, sublayer, GLOBAL batch row): row-level
+            # keying makes masks identical between the pipelined and
+            # sequential schedules AND independent across data-shards —
+            # inside shard_map each data-rank holds different rows of the
+            # microbatch, so shape-keyed generation from the shared key
+            # would hand every rank the same mask (caught by the
+            # oracle-equality test).
+            if rate == 0.0:
+                return x
+            key = jax.random.wrap_key_data(p["dropout_key"])
+            key = jax.random.fold_in(key, p["layer_id"] * 2 + sub)
+            keep = jax.vmap(
+                lambda r: jax.random.bernoulli(
+                    jax.random.fold_in(key, r), 1.0 - rate, x.shape[1:]
+                )
+            )(row_ids)
+            return jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)
+
+        def one_layer(p, x, row_ids):
             B, T, _ = x.shape
             h = _ln(x, p["ln1_scale"], p["ln1_bias"]).astype(dtype)
             q = (h @ p["wq"].astype(dtype)).reshape(B, T, H, Dh)
             k = (h @ p["wk"].astype(dtype)).reshape(B, T, H, Dh)
             v = (h @ p["wv"].astype(dtype)).reshape(B, T, H, Dh)
             a = attnlib.attention(q, k, v, causal=True, impl=attn_impl)
-            x = x + a.reshape(B, T, d) @ p["wo"].astype(dtype)
+            a = a.reshape(B, T, d) @ p["wo"].astype(dtype)
+            x = x + _dropout(a, p, row_ids, 0)
             h = _ln(x, p["ln2_scale"], p["ln2_bias"]).astype(dtype)
             h = nn.gelu(h @ p["w_up"].astype(dtype))
-            return x + h @ p["w_down"].astype(dtype)
+            h = h @ p["w_down"].astype(dtype)
+            return x + _dropout(h, p, row_ids, 1)
 
         n_stages = (
             self.pipe_mesh.shape["pipe"] if self.pipe_mesh is not None else 1
@@ -292,13 +321,29 @@ class PipelinedBlocks(nn.Module):
         staged = jax.tree.map(
             lambda a: a.reshape((n_stages, per_stage) + a.shape[1:]), params
         )
+        # Non-parameter constants riding with the stage slices: global
+        # layer ids (dropout keying) and the step's dropout key (raw
+        # uint32 so it shards/permutes like any other leaf).
+        staged["layer_id"] = jnp.arange(L, dtype=jnp.int32).reshape(
+            n_stages, per_stage
+        )
+        if dropout_key is not None:
+            staged["dropout_key"] = jnp.broadcast_to(
+                dropout_key, (n_stages,) + dropout_key.shape
+            )
 
-        def stage_fn(stage_params, x):
+        def stage_fn(stage_params, xm):
+            sp = dict(stage_params)
+            # The dropout key is per-stage, not per-layer: keep it out of
+            # the per-layer slice.
+            dk = sp.pop("dropout_key", None)
+            x, row_ids = xm["x"], xm["rid"]
             for i in range(per_stage):
-                x = one_layer(
-                    jax.tree.map(lambda a: a[i], stage_params), x
-                )
-            return x
+                p = jax.tree.map(lambda a: a[i], sp)
+                if dk is not None:
+                    p["dropout_key"] = dk
+                x = one_layer(p, x, row_ids)
+            return {"x": x, "rid": xm["rid"]}
 
         m = self.num_microbatches
         if self.pipe_mesh is None and x.shape[0] % m:
@@ -307,13 +352,20 @@ class PipelinedBlocks(nn.Module):
             # parameters do not depend on the microbatch count.
             m = 1
         mbs = pplib.split_microbatches(x, m)
+        mb_size = mbs.shape[1]
+        # Global batch-row ids travel with their rows (contiguous blocks,
+        # matching split_microbatches' reshape).
+        row_ids = jnp.arange(m * mb_size, dtype=jnp.int32).reshape(
+            m, mb_size
+        )
+        tree = {"x": mbs, "rid": row_ids}
         if self.pipe_mesh is None:
-            out = pplib.sequential_apply(stage_fn, staged, mbs)
+            out = pplib.sequential_apply(stage_fn, staged, tree)
         else:
             out = pplib.pipeline_apply(
-                stage_fn, staged, mbs, mesh=self.pipe_mesh
+                stage_fn, staged, tree, mesh=self.pipe_mesh
             )
-        return pplib.merge_microbatches(out)
+        return pplib.merge_microbatches(out["x"])
 
 
 class TransformerLM(nn.Module):
@@ -366,10 +418,10 @@ class TransformerLM(nn.Module):
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         if self.pipelined or self.pipe_mesh is not None:
-            if self.num_experts or self.dropout_rate or self.remat:
+            if self.num_experts or self.remat:
                 raise ValueError(
-                    "pipelined path supports dense FFN with dropout_rate=0 "
-                    "and remat=False (remat the stage_fn instead)"
+                    "pipelined path supports dense FFN with remat=False "
+                    "(remat the stage_fn instead)"
                 )
             x = PipelinedBlocks(
                 self.num_layers,
@@ -380,6 +432,7 @@ class TransformerLM(nn.Module):
                 self.attn_impl,
                 self.pipe_mesh,
                 self.pipeline_microbatches,
+                self.dropout_rate,
                 name="pipeline",
             )(x, train=train)
         else:

@@ -65,15 +65,21 @@ def pipeline_spec(params_stacked: PyTree, axis: str = AxisNames.PIPE):
 
 
 def pipeline_apply(
-    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    stage_fn: Callable[[PyTree, PyTree], PyTree],
     params_stacked: PyTree,
-    microbatches: jax.Array,
+    microbatches: PyTree,
     *,
     mesh: Mesh,
     axis: str = AxisNames.PIPE,
     data_axis: str | None = AxisNames.DATA,
 ):
     """Run ``microbatches`` [M, mb, ...] through the stage pipeline.
+
+    ``microbatches`` may be a single array or a pytree whose leaves all
+    carry the leading [M, mb] dims — e.g. ``(activations, mb_ids)`` so a
+    stage can derive per-microbatch randomness (dropout keys) from data
+    that travels *with* the activation through the ring; ``stage_fn`` must
+    return the same structure.
 
     Schedule: ``M + n_stages - 1`` ticks.  At tick ``t`` every rank applies
     its stage to its current activation, then activations rotate one rank
@@ -92,7 +98,7 @@ def pipeline_apply(
     over ``axis``).
     """
     n_stages = mesh.shape[axis]
-    num_mb = microbatches.shape[0]
+    num_mb = jax.tree.leaves(microbatches)[0].shape[0]
     total_ticks = num_mb + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
@@ -103,7 +109,10 @@ def pipeline_apply(
         # The carry is device-varying from tick 1 on (rank-dependent
         # values); mark the zero init varying up front so the scan carry
         # type is stable (same pattern as ring attention's carries).
-        state = lax.pcast(jnp.zeros_like(mbs[0]), axis, to="varying")
+        state = jax.tree.map(
+            lambda m: lax.pcast(jnp.zeros_like(m[0]), axis, to="varying"),
+            mbs,
+        )
 
         def tick(state, t):
             # Rank 0 ingests microbatch t; drain ticks (t >= M) re-feed a
@@ -112,36 +121,54 @@ def pipeline_apply(
             # rank within total_ticks, so its outputs fall outside the
             # ys[n_stages-1:] collection window below.  Extending the scan
             # or collecting from another rank would break this invariant.
-            feed = mbs[jnp.minimum(t, num_mb - 1)]
-            x = jnp.where(rank == 0, feed, state)
+            x = jax.tree.map(
+                lambda m, s: jnp.where(
+                    rank == 0, m[jnp.minimum(t, num_mb - 1)], s
+                ),
+                mbs,
+                state,
+            )
             y = stage_fn(params, x)
-            return lax.ppermute(y, axis, perm), y
+            return jax.tree.map(
+                lambda leaf: lax.ppermute(leaf, axis, perm), y
+            ), y
 
         _, ys = lax.scan(tick, state, jnp.arange(total_ticks))
         # The last rank emitted microbatch m's result at tick m+n_stages-1:
-        # a static slice of the scan's stacked outputs.
-        outs = ys[n_stages - 1 :]
-        # Replicate over the ring: zero every rank but the last, then psum.
-        outs = jnp.where(rank == n_stages - 1, outs, jnp.zeros_like(outs))
-        return lax.psum(outs, axis)
+        # a static slice of the scan's stacked outputs.  Replicate over the
+        # ring: zero every rank but the last, then psum.
+        return jax.tree.map(
+            lambda leaf: lax.psum(
+                jnp.where(
+                    rank == n_stages - 1,
+                    leaf[n_stages - 1 :],
+                    jnp.zeros_like(leaf[n_stages - 1 :]),
+                ),
+                axis,
+            ),
+            ys,
+        )
 
     mb_spec = P(None, data_axis) if data_axis else P()
+    mb_specs = jax.tree.map(lambda _: mb_spec, microbatches)
     fn = jax.shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(pipeline_spec(params_stacked, axis), mb_spec),
-        out_specs=mb_spec,
+        in_specs=(pipeline_spec(params_stacked, axis), mb_specs),
+        out_specs=mb_specs,
     )
     return fn(params_stacked, microbatches)
 
 
 def sequential_apply(
-    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    stage_fn: Callable[[PyTree, PyTree], PyTree],
     params_stacked: PyTree,
-    microbatches: jax.Array,
-) -> jax.Array:
+    microbatches: PyTree,
+) -> PyTree:
     """Reference semantics for tests/single-device: the same stages applied
-    back-to-back with no pipelining."""
+    back-to-back with no pipelining.  Accepts the same array-or-pytree
+    microbatches contract as :func:`pipeline_apply` (the two must stay
+    interchangeable — tests pin them against each other)."""
     n_stages = jax.tree_util.tree_leaves(params_stacked)[0].shape[0]
 
     def one_mb(x):

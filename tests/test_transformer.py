@@ -254,3 +254,42 @@ def test_remat_matches_non_remat():
         tiny_cfg(model_kwargs={**TINY, "remat": True}), tempfile.mkdtemp()
     )
     assert abs(r1.final_metrics["loss"] - r2.final_metrics["loss"]) < 1e-3
+
+
+def test_pipelined_dropout_matches_sequential():
+    """Dropout masks must be identical between the pipelined and
+    sequential schedules: keys ride with the stage params and are derived
+    per (layer, sublayer, global batch row) — row-level keying also keeps
+    masks independent across data-shards inside shard_map, where
+    shape-keyed generation from the shared key would hand every rank the
+    same mask."""
+    mesh = meshlib.create_mesh(meshlib.MeshSpec(data=-1, pipe=2))
+    kwargs = {**TINY, "dtype": jnp.float32, "dropout_rate": 0.3}
+    seq_model = get_model("transformer_lm", **kwargs, pipelined=True)
+    pipe_model = get_model("transformer_lm", **kwargs, pipe_mesh=mesh)
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, 10000, (16, 16)), jnp.int32
+    )
+    variables = seq_model.init(jax.random.key(0), toks)
+    rngs = {"dropout": jax.random.key(3)}
+    ref, _ = seq_model.apply(variables, toks, train=True, rngs=rngs)
+    got, _ = pipe_model.apply(variables, toks, train=True, rngs=rngs)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), atol=2e-5, rtol=2e-5
+    )
+    # Dropout actually fires (train vs eval outputs differ).
+    ev, _ = seq_model.apply(variables, toks)
+    assert float(jnp.abs(ref - ev).max()) > 1e-3
+
+
+def test_fit_pipeline_with_stock_dropout():
+    """The stock config (dropout 0.1) trains via --mesh-pipe with real
+    dropout — no silent dropout-off override."""
+    cfg = tiny_cfg(
+        model_kwargs={**TINY, "dropout_rate": 0.1},
+        global_batch_size=16,
+        mesh_pipe=2,
+    )
+    res = trainlib.fit(cfg, tempfile.mkdtemp())
+    assert res.steps_run == 3
+    assert np.isfinite(res.final_metrics["loss"])
