@@ -126,21 +126,39 @@ def probe_backend(max_attempts, timeout_s, backoff_s):
     return False, max_attempts, err
 
 
-def _flops_per_step_per_chip(compiled, name, items_per_chip, n_steps):
-    """Per-chip FLOPs for one train step.  XLA cost analysis reports the
-    post-SPMD-partition *per-device* module, so it is already per-chip; the
-    analytic fallback is scaled by the per-chip item count to match."""
+def _flops_per_step_global(single_step_lowered, name, items_per_step):
+    """GLOBAL (all-chip) FLOPs for one train step, from HLO cost analysis
+    of a SINGLE-step lowering (trace-only — no extra backend compile).
+    Callers divide by device count for per-chip numbers.
+
+    Two traps this sidesteps, both verified empirically on this machine:
+
+    - XLA cost analysis visits a while-loop body ONCE, ignoring the trip
+      count, so analysing the timed `lax.scan(steps)` program and dividing
+      by `steps` understates FLOPs/step by exactly `steps` (the round-2
+      session measured identical flops for scan length 1 and 10).
+      Analysing one un-scanned step avoids the division entirely.
+    - Pallas kernels are opaque custom-calls with zero counted FLOPs, so
+      configs routing attention through Mosaic report a conservative MFU
+      (the dense-matmul floor), never an inflated one.
+
+    Unoptimized-HLO flops match compiled flops for matmul/conv-dominated
+    graphs (fusion changes elementwise ops only; measured 33.62M vs 33.55M
+    on a 256x256 matmul scan body).  SPMD note: the lowering is of the
+    global program, so cost analysis reports global FLOPs; the analytic
+    fallback is scaled by the global item count to match.
+    """
     try:
-        cost = compiled.cost_analysis()
+        cost = single_step_lowered.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         flops = float(cost["flops"])
         if flops > 0:
-            return flops / n_steps, "xla_cost_analysis"
+            return flops, "xla_cost_analysis_single_step"
     except Exception as e:  # noqa: BLE001 — any failure falls back
         log(f"cost_analysis unavailable ({e}); using analytic FLOPs")
     return (
-        ANALYTIC_TRAIN_FLOPS_PER_ITEM[name] * items_per_chip,
+        ANALYTIC_TRAIN_FLOPS_PER_ITEM[name] * items_per_step,
         "analytic",
     )
 
@@ -179,9 +197,14 @@ def run_one(name, builder, steps, batch_override):
     t0 = time.time()
     compiled = jax.jit(fn).lower(state, batch, rng).compile()
     log(f"{name}: compiled in {time.time()-t0:.1f}s")
-    flops_chip, flops_src = _flops_per_step_per_chip(
-        compiled, name, items_per_chip, steps
+    # FLOPs from a single-step lowering (trace-only; see helper docstring).
+    # The lowering sees the global-batch program: divide by chip count.
+    flops_global, flops_src = _flops_per_step_global(
+        jax.jit(step_fn).lower(state, batch, rng),
+        name,
+        items_per_step,
     )
+    flops_chip = flops_global / n_chips
 
     # Warmup == one untimed run of the exact timed program.
     state, losses = compiled(state, batch, rng)
