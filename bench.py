@@ -66,6 +66,9 @@ ANALYTIC_TRAIN_FLOPS_PER_ITEM = {
     "ptb_lstm": 3 * 2.65e7,  # medium: 2 LSTM layers 4*650*1300 MACs + head
     # 8L x d512 transformer @T512: ~6*12*L*d^2 + attention terms per token
     "transformer_lm": 3 * 6.0e7,
+    # same model @T4096 with remat (~4x fwd instead of 3x) and 8x the
+    # per-token attention term
+    "transformer_lm_long": 4 * 1.0e8,
 }
 
 
@@ -390,6 +393,63 @@ def build_transformer_lm(n_chips, batch_override):
         d_ff=2048,
         max_len=T,
         dropout_rate=0.0,
+        # DTM_BENCH_ATTN_IMPL pins the attention impl — used by
+        # experiments/recompute_mfu.py to lower a FLOPs-accounting program
+        # consistent with MFU convention (see that script's docstring).
+        attn_impl=os.environ.get("DTM_BENCH_ATTN_IMPL", "auto"),
+    )
+    tx = optax.chain(optim.clip_by_global_norm(1.0), optim.adam(3e-4))
+    state = TrainState.create(
+        model, tx, jax.random.key(0), jnp.zeros((2, T), jnp.int32)
+    )
+    state = train_loop.place_state(state, mesh)
+    step_fn = train_loop.make_train_step_fn(
+        train_loop.lm_loss_fn(model.apply)
+    )
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 10000, (batch_size, T + 1))
+    batch = shardlib.shard_batch(
+        mesh,
+        {
+            "inputs": tokens[:, :-1].astype(np.int32),
+            "targets": tokens[:, 1:].astype(np.int32),
+        },
+    )
+    return state, batch, step_fn, per_chip_batch * T, "tokens/sec/chip"
+
+
+def build_transformer_lm_long(n_chips, batch_override):
+    """Long-context showcase: T=4096 through the Pallas flash kernel (auto
+    on TPU), remat'd blocks — the regime the blockwise/flash stack exists
+    for.  At this length an O(T^2)-materializing attention would need
+    ~16M-element score buffers per head; flash keeps it at O(T·block).
+    Unit: tokens/sec/chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_models_tpu.core import mesh as meshlib
+    from distributed_tensorflow_models_tpu.core import sharding as shardlib
+    from distributed_tensorflow_models_tpu.core import train_loop
+    from distributed_tensorflow_models_tpu.core.train_state import TrainState
+    from distributed_tensorflow_models_tpu.models import get_model
+    from distributed_tensorflow_models_tpu.ops import optim
+
+    T = 4096
+    per_chip_batch = batch_override or 4
+    mesh = meshlib.data_parallel_mesh()
+    batch_size = per_chip_batch * n_chips
+    model = get_model(
+        "transformer_lm",
+        num_layers=8,
+        num_heads=8,
+        d_model=512,
+        d_ff=2048,
+        max_len=T,
+        dropout_rate=0.0,
+        remat=True,
+        attn_impl=os.environ.get("DTM_BENCH_ATTN_IMPL", "auto"),
     )
     tx = optax.chain(optim.clip_by_global_norm(1.0), optim.adam(3e-4))
     state = TrainState.create(
@@ -430,8 +490,13 @@ def run_flash_check(args):
         raise RuntimeError("flash_check requires the TPU backend")
     B, T, H, D = 4, 2048, 8, 64
     rng = np.random.RandomState(0)
+    # bf16 inputs: what the models' activation path actually feeds the
+    # kernel (bf16 compute, f32 accumulate); an f32 microbench would time
+    # the MXU's f32 rate instead and under-sell both impls.
     q, k, v = (
-        jnp.asarray(rng.randn(B, T, H, D).astype(np.float32) * 0.1)
+        jnp.asarray(rng.randn(B, T, H, D).astype(np.float32) * 0.1).astype(
+            jnp.bfloat16
+        )
         for _ in range(3)
     )
 
@@ -470,18 +535,28 @@ def run_flash_check(args):
         lambda q, k, v: attnlib.blockwise_attention(q, k, v, causal=True)
     )
     jax.block_until_ready((f_out, b_out))
-    ref = attnlib.reference_attention(q, k, v, causal=True)
+    # Numerics gate in f32: the bf16 impls must land within bf16 round-off
+    # of the exact O(T^2) answer.
+    ref = attnlib.reference_attention(
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+        v.astype(jnp.float32),
+        causal=True,
+    )
+    flash_flops = 2 * 2 * B * H * T * T * D / 2  # causal: half the blocks
     return {
         "metric": "flash_attention_forward",
         "value": round(b_dt / f_dt, 3),
         "unit": "speedup_vs_blockwise",
+        "dtype": "bfloat16",
         "flash_ms": round(f_dt * 1e3, 3),
         "blockwise_ms": round(b_dt * 1e3, 3),
+        "flash_tflops": round(flash_flops / f_dt / 1e12, 2),
         "max_err_flash_vs_reference": float(
-            jnp.max(jnp.abs(f_out - ref))
+            jnp.max(jnp.abs(f_out.astype(jnp.float32) - ref))
         ),
         "max_err_blockwise_vs_reference": float(
-            jnp.max(jnp.abs(b_out - ref))
+            jnp.max(jnp.abs(b_out.astype(jnp.float32) - ref))
         ),
         "shape": [B, T, H, D],
     }
@@ -492,6 +567,7 @@ BUILDERS = {
     "inception_v3": build_inception_v3,
     "ptb_lstm": build_ptb_lstm,
     "transformer_lm": build_transformer_lm,
+    "transformer_lm_long": build_transformer_lm_long,
 }
 HEADLINE = "resnet50"
 # Execution order: cheap matmul-dominated configs first so at least one
@@ -501,6 +577,7 @@ HEADLINE = "resnet50"
 ORDER = [
     "ptb_lstm",
     "transformer_lm",
+    "transformer_lm_long",
     "resnet50",
     "inception_v3",
     "flash_check",
@@ -610,11 +687,16 @@ def _orchestrate(args):
     attempts = run_info["attempts"]
 
     names = list(ORDER) if args.config == "all" else [args.config]
-    if force_cpu and "flash_check" in names and args.config == "all":
+    if force_cpu and args.config == "all":
         # No point paying a subprocess JAX startup just to learn the
-        # Mosaic kernel needs the TPU we already know is unusable.
-        names.remove("flash_check")
-        log("skipping flash_check: TPU backend unusable")
+        # Mosaic kernel needs the TPU we already know is unusable; and the
+        # T=4096 long-context config is CPU-hopeless at any batch (one
+        # remat'd step is ~40x the shrunk transformer_lm step — it would
+        # burn its whole config timeout on this 2-core host).
+        for name in ("flash_check", "transformer_lm_long"):
+            if name in names:
+                names.remove(name)
+                log(f"skipping {name}: TPU backend unusable")
     if force_cpu:
         # CPU numbers are evidence-of-life, not performance: shrink the
         # workload so every config finishes inside its timeout on a

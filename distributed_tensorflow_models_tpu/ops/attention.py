@@ -14,10 +14,13 @@ all sharing one API so models and the sequence-parallel layer
   reverse-AD-able), runs on any backend; the training default.
 - :func:`flash_attention` — the same recurrence as a Pallas TPU kernel:
   one grid step per (batch·head, q-block), KV loop innermost with the
-  softmax state in VMEM scratch, causal blocks skipped.  MXU-shaped
-  matmuls (q·kᵀ and p·v), fp32 accumulation.  Gradients via
-  ``jax.custom_vjp`` with a recomputing backward (blockwise), so training
-  through it is correct while the forward stays O(T·block) memory.
+  softmax state in VMEM scratch, causal blocks skipped.  Matmuls in the
+  input dtype (bf16 on the models' activation path) with fp32
+  accumulation.  Gradients via ``jax.custom_vjp`` running the
+  FlashAttention-2 backward as a Pallas kernel pair (dK/dV with the Q
+  sweep innermost, dQ with the KV sweep innermost), rebuilding the
+  probabilities from the forward's saved log-sum-exp — O(T·block) memory
+  in both passes.
 
 Layout convention everywhere: ``[batch, seq, heads, head_dim]`` (BTHD).
 """
@@ -158,12 +161,36 @@ def blockwise_attention(
 # ------------------------------------------------------------ pallas flash
 
 
+def _masked_scores(qb, kb, i, j, *, scale, causal, block_q, block_kv):
+    """Shared score block for all three Pallas kernels: S = (Q_i K_j^T) *
+    scale in the INPUT dtype with f32 accumulation (upcasting q/k to f32
+    first would push the MXU to its f32 rate — measured ~4x slower on
+    v5e), causal-masked positionally.  Forward and backward MUST mask
+    identically or gradients silently diverge from the forward's math."""
+    s = jax.lax.dot_general(
+        qb, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [bq, bkv] f32
+    if causal:
+        qi = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0
+        )
+        kj = j * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1
+        )
+        s = jnp.where(qi >= kj, s, NEG_INF)
+    return s
+
+
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, scale: float, causal: bool, block_q: int, block_kv: int,
 ):
     """Grid = (B*H, Tq/block_q, Tkv/block_kv); KV innermost, softmax state
-    carried across KV steps in VMEM scratch, output written on the last."""
+    carried across KV steps in VMEM scratch, output written on the last.
+    Also emits the per-row log-sum-exp (the FlashAttention-2 backward
+    residual — :func:`_flash_bwd` rebuilds P from it without a second
+    softmax pass)."""
     import jax.experimental.pallas as pl  # deferred: TPU-path only
 
     i = pl.program_id(1)
@@ -184,27 +211,20 @@ def _flash_kernel(
 
     @pl.when(should_run)
     def _compute():
-        qb = q_ref[0].astype(jnp.float32) * scale  # [bq, D]
-        kb = k_ref[0].astype(jnp.float32)  # [bkv, D]
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bkv]
-        if causal:
-            qi = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            kj = j * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1
-            )
-            s = jnp.where(qi >= kj, s, NEG_INF)
+        s = _masked_scores(
+            q_ref[0], k_ref[0], i, j,
+            scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv,
+        )
         m_prev, l_prev, acc_prev = m_scr[:], l_scr[:], acc_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        # p·V in the value dtype (p ∈ [0,1], bf16 round-off here is the
+        # standard flash-kernel tradeoff), f32 accumulate.
         acc = alpha * acc_prev + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[:], l_scr[:], acc_scr[:] = m_new, l_new, acc
@@ -214,16 +234,12 @@ def _flash_kernel(
         o_ref[0] = (
             acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
         ).astype(o_ref.dtype)
+        lse_ref[0, :] = (
+            m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+        )
 
 
-def _flash_forward(
-    q, k, v, *, causal, scale, block_q, block_kv, interpret
-):
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    B, Tq, H, D = q.shape
-    Tkv = k.shape[1]
+def _check_blocks(Tq, Tkv, block_q, block_kv):
     block_q = min(block_q, Tq)
     block_kv = min(block_kv, Tkv)
     if Tq % block_q or Tkv % block_kv:
@@ -231,17 +247,33 @@ def _flash_forward(
             f"seq lens ({Tq},{Tkv}) not divisible by blocks "
             f"({block_q},{block_kv})"
         )
+    return block_q, block_kv
+
+
+def _heads_first(x):
+    """BTHD -> (B*H, T, D): contiguous per-head rows for clean 2D tiles."""
+    B, T, H, D = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
+
+
+def _flash_forward(
+    q, k, v, *, causal, scale, block_q, block_kv, interpret,
+    return_lse=False,
+):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Tq, H, D = q.shape
+    Tkv = k.shape[1]
+    block_q, block_kv = _check_blocks(Tq, Tkv, block_q, block_kv)
     s = _scale(q, scale)
-    # BTHD -> (B*H, T, D): contiguous per-head rows for clean 2D tiles.
-    qh = jnp.swapaxes(q, 1, 2).reshape(B * H, Tq, D)
-    kh = jnp.swapaxes(k, 1, 2).reshape(B * H, Tkv, D)
-    vh = jnp.swapaxes(v, 1, 2).reshape(B * H, Tkv, D)
+    qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
 
     kernel = functools.partial(
         _flash_kernel,
         scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // block_q, Tkv // block_kv),
         in_specs=[
@@ -258,19 +290,238 @@ def _flash_forward(
                 memory_space=pltpu.VMEM,
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, D), lambda b, i, j: (b, i, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_q, D), lambda b, i, j: (b, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q), lambda b, i, j: (b, i),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
+        # batch·head and q-block revisits are independent; only the KV dim
+        # carries the scratch state.  Declaring that lets Mosaic pipeline
+        # the next (b, i)'s DMAs across the carried-dim boundary.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(qh, kh, vh)
-    return jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2)
+    out = jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2)
+    if return_lse:
+        return out, lse
+    return out
+
+
+def _p_and_ds(
+    qb, kb, vb, dob, lse_row, delta_row, i, j,
+    *, scale, causal, block_q, block_kv,
+):
+    """Shared backward recurrence for both gradient kernels:
+    P_ij = exp(S_ij - LSE_i), dS_ij = P_ij ∘ (dO_i V_j^T - delta_i)."""
+    s = _masked_scores(
+        qb, kb, i, j,
+        scale=scale, causal=causal, block_q=block_q, block_kv=block_kv,
+    )
+    p = jnp.exp(s - lse_row[:, None])  # [bq, bkv] f32
+    dp = jax.lax.dot_general(
+        dob, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, bkv]
+    ds = p * (dp - delta_row[:, None])  # f32
+    return p, ds
+
+
+def _flash_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, scale: float, causal: bool, block_q: int, block_kv: int,
+):
+    """dK/dV kernel: grid = (B*H, Tkv/block_kv, Tq/block_q), Q innermost;
+    dK_j / dV_j accumulate in VMEM scratch across the Q sweep.
+
+    FlashAttention-2 backward recurrence, P rebuilt from the forward LSE:
+      P_ij  = exp(Q_i K_j^T * scale - LSE_i)
+      dV_j += P_ij^T dO_i
+      dS_ij = P_ij ∘ (dO_i V_j^T - delta_i)
+      dK_j += scale * dS_ij^T Q_i
+    """
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+    n_i = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    should_run = True
+    if causal:
+        # Q block i ends before KV block j starts -> gradient block is 0.
+        should_run = i * block_q + block_q - 1 >= j * block_kv
+
+    @pl.when(should_run)
+    def _compute():
+        qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        p, ds = _p_and_ds(
+            qb, kb, vb, dob, lse_ref[0, :], delta_ref[0, :], i, j,
+            scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv,
+        )
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bkv, D]
+        dk_scr[:] += scale * jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bkv, D]
+
+    @pl.when(i == n_i - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale: float, causal: bool, block_q: int, block_kv: int,
+):
+    """dQ kernel: grid = (B*H, Tq/block_q, Tkv/block_kv), KV innermost;
+    dQ_i accumulates in VMEM scratch across the KV sweep:
+      dQ_i += scale * dS_ij K_j   (dS as in :func:`_flash_dkv_kernel`)."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    should_run = True
+    if causal:
+        should_run = j * block_kv <= i * block_q + block_q - 1
+
+    @pl.when(should_run)
+    def _compute():
+        qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        _, ds = _p_and_ds(
+            qb, kb, vb, dob, lse_ref[0, :], delta_ref[0, :], i, j,
+            scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv,
+        )
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_j - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, out, lse, g, *, causal, scale, block_q, block_kv, interpret
+):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Tq, H, D = q.shape
+    Tkv = k.shape[1]
+    block_q, block_kv = _check_blocks(Tq, Tkv, block_q, block_kv)
+    s = _scale(q, scale)
+    qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
+    doh = _heads_first(g)
+    # delta_i = rowsum(dO ∘ O): elementwise, XLA fuses it fine outside.
+    delta = jnp.sum(
+        doh.astype(jnp.float32)
+        * _heads_first(out).astype(jnp.float32),
+        axis=-1,
+    )  # [B*H, Tq] f32
+
+    qspec = lambda im: pl.BlockSpec(
+        (1, block_q, D), im, memory_space=pltpu.VMEM
+    )
+    kvspec = lambda im: pl.BlockSpec(
+        (1, block_kv, D), im, memory_space=pltpu.VMEM
+    )
+    rowspec = lambda im: pl.BlockSpec(
+        (1, block_q), im, memory_space=pltpu.VMEM
+    )
+
+    dkv_kernel = functools.partial(
+        _flash_dkv_kernel,
+        scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, Tkv // block_kv, Tq // block_q),
+        in_specs=[
+            qspec(lambda b, j, i: (b, i, 0)),
+            kvspec(lambda b, j, i: (b, j, 0)),
+            kvspec(lambda b, j, i: (b, j, 0)),
+            qspec(lambda b, j, i: (b, i, 0)),
+            rowspec(lambda b, j, i: (b, i)),
+            rowspec(lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            kvspec(lambda b, j, i: (b, j, 0)),
+            kvspec(lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tkv, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tkv, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, D), jnp.float32),
+            pltpu.VMEM((block_kv, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, delta)
+
+    dq_kernel = functools.partial(
+        _flash_dq_kernel,
+        scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, Tq // block_q, Tkv // block_kv),
+        in_specs=[
+            qspec(lambda b, i, j: (b, i, 0)),
+            kvspec(lambda b, i, j: (b, j, 0)),
+            kvspec(lambda b, i, j: (b, j, 0)),
+            qspec(lambda b, i, j: (b, i, 0)),
+            rowspec(lambda b, i, j: (b, i)),
+            rowspec(lambda b, i, j: (b, i)),
+        ],
+        out_specs=qspec(lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, delta)
+
+    unflat = lambda x, T: jnp.swapaxes(x.reshape(B, H, T, D), 1, 2)
+    return unflat(dq, Tq), unflat(dk, Tkv), unflat(dv, Tkv)
 
 
 @functools.partial(
@@ -288,10 +539,11 @@ def flash_attention(
 ) -> jax.Array:
     """Pallas TPU flash attention, BTHD in/out.
 
-    Forward is the fused kernel; backward recomputes through
-    :func:`blockwise_attention` (flash-style recompute-in-backward — the
-    O(T²) score matrix is never materialized in either pass).
-    ``interpret=True`` runs the same kernel on CPU for tests.
+    Forward is the fused kernel (which also emits per-row LSE); backward
+    is the FlashAttention-2 kernel pair (:func:`_flash_dkv_kernel` /
+    :func:`_flash_dq_kernel`) rebuilding P from the saved LSE — the O(T²)
+    score matrix is never materialized in either pass.  ``interpret=True``
+    runs the same kernels on CPU for tests.
     """
     return _flash_forward(
         q, k, v, causal=causal, scale=scale,
@@ -300,22 +552,20 @@ def flash_attention(
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
-    out = _flash_forward(
+    out, lse = _flash_forward(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
+        return_lse=True,
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(
-            q, k, v, causal=causal, scale=scale, block_kv=block_kv
-        ),
-        q, k, v,
+    q, k, v, out, lse = res
+    return _flash_backward(
+        q, k, v, out, lse, g, causal=causal, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
     )
-    return vjp(g)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Graded conv-compile probe for the axon TPU relay.
+
+Matmul-dominated programs (PTB LSTM, transformer, Pallas attention) compile
+and run through the relay; the ResNet-50 train step's remote compile hangs
+it (round-1 and round-2 evidence, experiments/TPU_BENCH_r2.md).  No conv
+program has ever been observed to compile through this relay — this script
+bisects where it breaks, one rung per subprocess with a hard timeout so a
+wedge is contained and *recorded* instead of killing the run.
+
+Run rungs in order, cheapest first; stop at the first timeout (the wedge
+poisons the backend for every later rung anyway).
+
+Usage: python experiments/conv_ladder.py [--timeout 420] [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+RUNGS = {
+    # name -> python source run in a fresh process; prints OK on success
+    "conv_op": """
+import jax, jax.numpy as jnp
+x = jnp.ones((8, 32, 32, 16))
+w = jnp.ones((3, 3, 16, 32))
+y = jax.jit(lambda x, w: jax.lax.conv_general_dilated(
+    x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")))(x, w)
+print("OK", y.shape, jax.devices()[0].device_kind)
+""",
+    "lenet_train": """
+import jax, jax.numpy as jnp, numpy as np
+from distributed_tensorflow_models_tpu.core import mesh as meshlib, sharding as shardlib, train_loop
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.ops import optim
+mesh = meshlib.data_parallel_mesh()
+model = get_model("lenet")
+state = TrainState.create(model, optim.sgd(0.01), jax.random.key(0),
+                          jnp.zeros((8, 28, 28, 1), jnp.float32))
+state = train_loop.place_state(state, mesh)
+step = train_loop.make_train_step_fn(train_loop.classification_loss_fn(model.apply))
+rng = np.random.RandomState(0)
+batch = shardlib.shard_batch(mesh, {"image": rng.rand(32, 28, 28, 1).astype(np.float32),
+                                    "label": rng.randint(0, 10, (32,))})
+state, m = jax.jit(step)(state, batch, jax.random.key(1))
+print("OK loss", float(m["loss"]))
+""",
+    "resnet32_train": """
+import jax, jax.numpy as jnp, numpy as np
+from distributed_tensorflow_models_tpu.core import mesh as meshlib, sharding as shardlib, train_loop
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.ops import optim
+mesh = meshlib.data_parallel_mesh()
+model = get_model("resnet32")
+state = TrainState.create(model, optim.sgd(0.01), jax.random.key(0),
+                          jnp.zeros((8, 32, 32, 3), jnp.float32))
+state = train_loop.place_state(state, mesh)
+step = train_loop.make_train_step_fn(train_loop.classification_loss_fn(model.apply))
+rng = np.random.RandomState(0)
+batch = shardlib.shard_batch(mesh, {"image": rng.rand(64, 32, 32, 3).astype(np.float32),
+                                    "label": rng.randint(0, 10, (64,))})
+state, m = jax.jit(step)(state, batch, jax.random.key(1))
+print("OK loss", float(m["loss"]))
+""",
+    "resnet50_fwd_b8": """
+import jax, jax.numpy as jnp
+from distributed_tensorflow_models_tpu.models import get_model
+model = get_model("resnet50")
+params = model.init(jax.random.key(0), jnp.zeros((8, 224, 224, 3)))
+logits = jax.jit(lambda p, x: model.apply(p, x))(params, jnp.ones((8, 224, 224, 3)))
+print("OK", logits[0].shape if isinstance(logits, tuple) else logits.shape)
+""",
+    "resnet50_train_b32": """
+import jax, jax.numpy as jnp, numpy as np
+from distributed_tensorflow_models_tpu.core import mesh as meshlib, sharding as shardlib, train_loop
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.ops import optim
+mesh = meshlib.data_parallel_mesh()
+model = get_model("resnet50")
+state = TrainState.create(model, optim.tf_momentum(0.1, 0.9), jax.random.key(0),
+                          jnp.zeros((8, 224, 224, 3), jnp.float32))
+state = train_loop.place_state(state, mesh)
+step = train_loop.make_train_step_fn(
+    train_loop.classification_loss_fn(model.apply, weight_decay=1e-4))
+rng = np.random.RandomState(0)
+batch = shardlib.shard_batch(mesh, {"image": rng.rand(32, 224, 224, 3).astype(np.float32),
+                                    "label": rng.randint(0, 1000, (32,))})
+state, m = jax.jit(step)(state, batch, jax.random.key(1))
+print("OK loss", float(m["loss"]))
+""",
+    "resnet50_train_b256": """
+import jax, jax.numpy as jnp, numpy as np
+from distributed_tensorflow_models_tpu.core import mesh as meshlib, sharding as shardlib, train_loop
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.ops import optim
+mesh = meshlib.data_parallel_mesh()
+model = get_model("resnet50")
+state = TrainState.create(model, optim.tf_momentum(0.1, 0.9), jax.random.key(0),
+                          jnp.zeros((8, 224, 224, 3), jnp.float32))
+state = train_loop.place_state(state, mesh)
+step = train_loop.make_train_step_fn(
+    train_loop.classification_loss_fn(model.apply, weight_decay=1e-4))
+rng = np.random.RandomState(0)
+batch = shardlib.shard_batch(mesh, {"image": rng.rand(256, 224, 224, 3).astype(np.float32),
+                                    "label": rng.randint(0, 1000, (256,))})
+state, m = jax.jit(step)(state, batch, jax.random.key(1))
+print("OK loss", float(m["loss"]))
+""",
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--timeout", type=float, default=420.0)
+    p.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(__file__), "conv_ladder.json"),
+    )
+    p.add_argument("--rungs", nargs="*", default=list(RUNGS))
+    args = p.parse_args()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = {}
+    for name in args.rungs:
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", RUNGS[name]],
+                timeout=args.timeout,
+                capture_output=True,
+                text=True,
+                cwd=repo,
+            )
+            ok = proc.returncode == 0 and "OK" in proc.stdout
+            results[name] = {
+                "ok": ok,
+                "seconds": round(time.time() - t0, 1),
+                "detail": (proc.stdout + proc.stderr).strip()[-300:],
+            }
+        except subprocess.TimeoutExpired:
+            results[name] = {
+                "ok": False,
+                "seconds": round(time.time() - t0, 1),
+                "detail": f"TIMEOUT {args.timeout}s (relay wedge)",
+            }
+        print(f"{name}: {results[name]}", file=sys.stderr, flush=True)
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+        if not results[name]["ok"]:
+            print(f"stopping at first failure: {name}", file=sys.stderr)
+            break
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,11 +6,21 @@ their `flops_per_step_per_chip` came from XLA cost analysis of the fused
 30-step `lax.scan` program divided by 30 — and XLA cost analysis visits a
 while-loop body ONCE regardless of trip count (verified on this machine:
 identical flops for scan length 1 and 10), so those FLOPs and MFU are
-understated by exactly 30x.  bench.py now lowers a single un-scanned step
-for cost analysis; this script applies the same accounting to the already-
-measured TPU timings (HLO lowering is platform-independent for these
-programs, so the CPU-lowered single-step FLOPs match what the TPU run
-executed per step).
+understated by ~the scan length.  bench.py now lowers a single un-scanned
+step for cost analysis; this script applies the same accounting to the
+already-measured TPU timings.
+
+FLOPs-accounting convention for the transformer (MFU = *required* model
+FLOPs / time, the standard definition): the lowering runs with
+``DTM_BENCH_ATTN_IMPL=reference`` — O(T²) single-pass attention, no remat
+recompute.  Lowering with the CPU default (blockwise) would instead count
+the per-block ``jax.checkpoint`` score *recomputation* in backward, which
+MFU excludes; counting nothing (the TPU program's Pallas flash custom call
+is opaque to cost analysis) would miss attention entirely.  Residual bias:
+reference counts causal attention at full T² where required work is ~T²/2,
+overstating MFU by ≲half the attention share of the program (~2% relative
+at T=512).  The dense 94%+ of the program lowers identically on every
+platform.
 
 Usage:  python experiments/recompute_mfu.py   (writes TPU_BENCH_r2.json)
 """
@@ -22,6 +32,7 @@ import sys
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("DTM_BENCH_FORCE_CPU", "1")
+os.environ["DTM_BENCH_ATTN_IMPL"] = "reference"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
