@@ -366,9 +366,15 @@ def build_ptb_lstm(n_chips, batch_override):
 
 
 def build_transformer_lm(n_chips, batch_override):
-    """Long-context flagship: 8-layer d512 causal LM at T=512, attention
-    via ops/attention.py 'auto' (Pallas flash on TPU — tile-aligned seq —
+    """Flagship causal LM at T=512: 8-layer d512, attention via
+    ops/attention.py 'auto' (Pallas flash on TPU — tile-aligned seq —
     blockwise elsewhere).  Unit: tokens/sec/chip."""
+    return _build_transformer(
+        n_chips, batch_override, T=512, default_batch=16, remat=False
+    )
+
+
+def _build_transformer(n_chips, batch_override, *, T, default_batch, remat):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -381,8 +387,7 @@ def build_transformer_lm(n_chips, batch_override):
     from distributed_tensorflow_models_tpu.models import get_model
     from distributed_tensorflow_models_tpu.ops import optim
 
-    T = 512
-    per_chip_batch = batch_override or 16
+    per_chip_batch = batch_override or default_batch
     mesh = meshlib.data_parallel_mesh()
     batch_size = per_chip_batch * n_chips
     model = get_model(
@@ -393,6 +398,7 @@ def build_transformer_lm(n_chips, batch_override):
         d_ff=2048,
         max_len=T,
         dropout_rate=0.0,
+        remat=remat,
         # DTM_BENCH_ATTN_IMPL pins the attention impl — used by
         # experiments/recompute_mfu.py to lower a FLOPs-accounting program
         # consistent with MFU convention (see that script's docstring).
@@ -419,56 +425,14 @@ def build_transformer_lm(n_chips, batch_override):
 
 
 def build_transformer_lm_long(n_chips, batch_override):
-    """Long-context showcase: T=4096 through the Pallas flash kernel (auto
-    on TPU), remat'd blocks — the regime the blockwise/flash stack exists
-    for.  At this length an O(T^2)-materializing attention would need
-    ~16M-element score buffers per head; flash keeps it at O(T·block).
-    Unit: tokens/sec/chip."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from distributed_tensorflow_models_tpu.core import mesh as meshlib
-    from distributed_tensorflow_models_tpu.core import sharding as shardlib
-    from distributed_tensorflow_models_tpu.core import train_loop
-    from distributed_tensorflow_models_tpu.core.train_state import TrainState
-    from distributed_tensorflow_models_tpu.models import get_model
-    from distributed_tensorflow_models_tpu.ops import optim
-
-    T = 4096
-    per_chip_batch = batch_override or 4
-    mesh = meshlib.data_parallel_mesh()
-    batch_size = per_chip_batch * n_chips
-    model = get_model(
-        "transformer_lm",
-        num_layers=8,
-        num_heads=8,
-        d_model=512,
-        d_ff=2048,
-        max_len=T,
-        dropout_rate=0.0,
-        remat=True,
-        attn_impl=os.environ.get("DTM_BENCH_ATTN_IMPL", "auto"),
+    """Long-context showcase: the same model at T=4096 through the Pallas
+    flash kernel (auto on TPU), remat'd blocks — the regime the
+    blockwise/flash stack exists for.  At this length an
+    O(T^2)-materializing attention would need ~16M-element score buffers
+    per head; flash keeps it at O(T·block).  Unit: tokens/sec/chip."""
+    return _build_transformer(
+        n_chips, batch_override, T=4096, default_batch=4, remat=True
     )
-    tx = optax.chain(optim.clip_by_global_norm(1.0), optim.adam(3e-4))
-    state = TrainState.create(
-        model, tx, jax.random.key(0), jnp.zeros((2, T), jnp.int32)
-    )
-    state = train_loop.place_state(state, mesh)
-    step_fn = train_loop.make_train_step_fn(
-        train_loop.lm_loss_fn(model.apply)
-    )
-    rng = np.random.RandomState(0)
-    tokens = rng.randint(0, 10000, (batch_size, T + 1))
-    batch = shardlib.shard_batch(
-        mesh,
-        {
-            "inputs": tokens[:, :-1].astype(np.int32),
-            "targets": tokens[:, 1:].astype(np.int32),
-        },
-    )
-    return state, batch, step_fn, per_chip_batch * T, "tokens/sec/chip"
 
 
 def run_flash_check(args):

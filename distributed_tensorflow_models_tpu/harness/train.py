@@ -139,15 +139,18 @@ def _mesh_model_kwargs(cfg: ExperimentConfig, mesh) -> dict:
         from distributed_tensorflow_models_tpu.parallel import ring as ringlib
 
         if cfg.seq_impl == "ring":
-            if cfg.attn_impl != "auto":
-                log.warning(
-                    "attn_impl=%r is ignored under seq_impl='ring': ring "
-                    "attention folds KV chunks through its own fused "
-                    "streaming-softmax recurrence (parallel/ring.py)",
-                    cfg.attn_impl,
-                )
+            # attn_impl maps onto the ring inner step: auto/flash pick the
+            # Pallas chunk kernel + LSE merge on TPU; reference/blockwise
+            # use the XLA streaming fold (parallel/ring.py).
+            ring_impl = (
+                cfg.attn_impl
+                if cfg.attn_impl in ("auto", "flash")
+                else "fold"
+            )
             kwargs["attention_fn"] = lambda q, k, v, causal=True: (
-                ringlib.ring_attention(q, k, v, mesh, causal=causal)
+                ringlib.ring_attention(
+                    q, k, v, mesh, causal=causal, impl=ring_impl
+                )
             )
         elif cfg.seq_impl == "ulysses":
             kwargs["attention_fn"] = lambda q, k, v, causal=True: (

@@ -161,21 +161,25 @@ def blockwise_attention(
 # ------------------------------------------------------------ pallas flash
 
 
-def _masked_scores(qb, kb, i, j, *, scale, causal, block_q, block_kv):
+def _masked_scores(
+    qb, kb, i, j, q_base, kv_base, *, scale, causal, block_q, block_kv
+):
     """Shared score block for all three Pallas kernels: S = (Q_i K_j^T) *
     scale in the INPUT dtype with f32 accumulation (upcasting q/k to f32
     first would push the MXU to its f32 rate — measured ~4x slower on
-    v5e), causal-masked positionally.  Forward and backward MUST mask
+    v5e), causal-masked in GLOBAL positions: ``q_base``/``kv_base`` are
+    the global offsets of the first local row (0 for self-attention;
+    chunk origins on the ring path).  Forward and backward MUST mask
     identically or gradients silently diverge from the forward's math."""
     s = jax.lax.dot_general(
         qb, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale  # [bq, bkv] f32
     if causal:
-        qi = i * block_q + jax.lax.broadcasted_iota(
+        qi = q_base + i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 0
         )
-        kj = j * block_kv + jax.lax.broadcasted_iota(
+        kj = kv_base + j * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1
         )
         s = jnp.where(qi >= kj, s, NEG_INF)
@@ -183,19 +187,22 @@ def _masked_scores(qb, kb, i, j, *, scale, causal, block_q, block_kv):
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
     *, scale: float, causal: bool, block_q: int, block_kv: int,
 ):
     """Grid = (B*H, Tq/block_q, Tkv/block_kv); KV innermost, softmax state
     carried across KV steps in VMEM scratch, output written on the last.
     Also emits the per-row log-sum-exp (the FlashAttention-2 backward
     residual — :func:`_flash_bwd` rebuilds P from it without a second
-    softmax pass)."""
+    softmax pass).  ``qoff_ref``/``kvoff_ref`` are SMEM scalars: global
+    offsets of the local chunk (the ring-attention case)."""
     import jax.experimental.pallas as pl  # deferred: TPU-path only
 
     i = pl.program_id(1)
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
+    q_base, kv_base = qoff_ref[0], kvoff_ref[0]
 
     @pl.when(j == 0)
     def _init():
@@ -204,15 +211,19 @@ def _flash_kernel(
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # Causal block skip: the whole KV block is in the future of the whole
-    # Q block iff j*block_kv > i*block_q + (block_q - 1).
+    # Q block iff its first global key position exceeds the block's last
+    # global query position.
     should_run = True
     if causal:
-        should_run = j * block_kv <= i * block_q + block_q - 1
+        should_run = (
+            kv_base + j * block_kv
+            <= q_base + i * block_q + block_q - 1
+        )
 
     @pl.when(should_run)
     def _compute():
         s = _masked_scores(
-            q_ref[0], k_ref[0], i, j,
+            q_ref[0], k_ref[0], i, j, q_base, kv_base,
             scale=scale, causal=causal,
             block_q=block_q, block_kv=block_kv,
         )
@@ -256,9 +267,20 @@ def _heads_first(x):
     return jnp.swapaxes(x, 1, 2).reshape(B * H, T, D)
 
 
+def _offset_scalars(q_offset, kv_offset):
+    """Offsets as (1,)-shaped int32 SMEM operands (dynamic — traced ring
+    axis indices flow through here)."""
+    as1 = lambda x: jnp.asarray(x, jnp.int32).reshape(1)
+    return as1(q_offset), as1(kv_offset)
+
+
+def _smem_scalar_spec(pl, pltpu):
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
 def _flash_forward(
     q, k, v, *, causal, scale, block_q, block_kv, interpret,
-    return_lse=False,
+    return_lse=False, q_offset=0, kv_offset=0,
 ):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -268,6 +290,7 @@ def _flash_forward(
     block_q, block_kv = _check_blocks(Tq, Tkv, block_q, block_kv)
     s = _scale(q, scale)
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
+    qoff, kvoff = _offset_scalars(q_offset, kv_offset)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -277,6 +300,8 @@ def _flash_forward(
         kernel,
         grid=(B * H, Tq // block_q, Tkv // block_kv),
         in_specs=[
+            _smem_scalar_spec(pl, pltpu),
+            _smem_scalar_spec(pl, pltpu),
             pl.BlockSpec(
                 (1, block_q, D), lambda b, i, j: (b, i, 0),
                 memory_space=pltpu.VMEM,
@@ -316,21 +341,26 @@ def _flash_forward(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qh, kh, vh)
+    )(qoff, kvoff, qh, kh, vh)
     out = jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2)
     if return_lse:
-        return out, lse
+        # Public LSE layout [B, T, H]: broadcasts against BTHD outputs
+        # with one trailing-axis expand (the ring-merge shape).
+        return out, jnp.swapaxes(lse.reshape(B, H, Tq), 1, 2)
     return out
 
 
 def _p_and_ds(
-    qb, kb, vb, dob, lse_row, delta_row, i, j,
+    qb, kb, vb, dob, lse_row, delta_row, i, j, q_base, kv_base,
     *, scale, causal, block_q, block_kv,
 ):
     """Shared backward recurrence for both gradient kernels:
-    P_ij = exp(S_ij - LSE_i), dS_ij = P_ij ∘ (dO_i V_j^T - delta_i)."""
+    P_ij = exp(S_ij - LSE_i), dS_ij = P_ij ∘ (dO_i V_j^T - delta_i).
+    ``delta_row`` is the *effective* delta — rowsum(dO ∘ O) minus the LSE
+    cotangent when the caller differentiates through the (out, lse) pair
+    (d lse_i / d S_ij = P_ij folds in as an additive term)."""
     s = _masked_scores(
-        qb, kb, i, j,
+        qb, kb, i, j, q_base, kv_base,
         scale=scale, causal=causal, block_q=block_q, block_kv=block_kv,
     )
     p = jnp.exp(s - lse_row[:, None])  # [bq, bkv] f32
@@ -343,8 +373,8 @@ def _p_and_ds(
 
 
 def _flash_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr,
+    qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_scr, dv_scr,
     *, scale: float, causal: bool, block_q: int, block_kv: int,
 ):
     """dK/dV kernel: grid = (B*H, Tkv/block_kv, Tq/block_q), Q innermost;
@@ -361,6 +391,7 @@ def _flash_dkv_kernel(
     j = pl.program_id(1)
     i = pl.program_id(2)
     n_i = pl.num_programs(2)
+    q_base, kv_base = qoff_ref[0], kvoff_ref[0]
 
     @pl.when(i == 0)
     def _init():
@@ -370,13 +401,16 @@ def _flash_dkv_kernel(
     should_run = True
     if causal:
         # Q block i ends before KV block j starts -> gradient block is 0.
-        should_run = i * block_q + block_q - 1 >= j * block_kv
+        should_run = (
+            q_base + i * block_q + block_q - 1 >= kv_base + j * block_kv
+        )
 
     @pl.when(should_run)
     def _compute():
         qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         p, ds = _p_and_ds(
             qb, kb, vb, dob, lse_ref[0, :], delta_ref[0, :], i, j,
+            q_base, kv_base,
             scale=scale, causal=causal,
             block_q=block_q, block_kv=block_kv,
         )
@@ -396,7 +430,8 @@ def _flash_dkv_kernel(
 
 
 def _flash_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_scr,
     *, scale: float, causal: bool, block_q: int, block_kv: int,
 ):
     """dQ kernel: grid = (B*H, Tq/block_q, Tkv/block_kv), KV innermost;
@@ -407,6 +442,7 @@ def _flash_dq_kernel(
     i = pl.program_id(1)
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
+    q_base, kv_base = qoff_ref[0], kvoff_ref[0]
 
     @pl.when(j == 0)
     def _init():
@@ -414,13 +450,17 @@ def _flash_dq_kernel(
 
     should_run = True
     if causal:
-        should_run = j * block_kv <= i * block_q + block_q - 1
+        should_run = (
+            kv_base + j * block_kv
+            <= q_base + i * block_q + block_q - 1
+        )
 
     @pl.when(should_run)
     def _compute():
         qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         _, ds = _p_and_ds(
             qb, kb, vb, dob, lse_ref[0, :], delta_ref[0, :], i, j,
+            q_base, kv_base,
             scale=scale, causal=causal,
             block_q=block_q, block_kv=block_kv,
         )
@@ -435,8 +475,13 @@ def _flash_dq_kernel(
 
 
 def _flash_backward(
-    q, k, v, out, lse, g, *, causal, scale, block_q, block_kv, interpret
+    q, k, v, out, lse, g, *, causal, scale, block_q, block_kv, interpret,
+    q_offset=0, kv_offset=0, g_lse=None,
 ):
+    """``lse`` here is the kernel-internal [B*H, Tq] layout.  ``g_lse``
+    (same layout, optional) is the LSE cotangent from callers that
+    consumed the (out, lse) pair — it folds into delta (see
+    :func:`_p_and_ds`)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -446,12 +491,15 @@ def _flash_backward(
     s = _scale(q, scale)
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
     doh = _heads_first(g)
+    qoff, kvoff = _offset_scalars(q_offset, kv_offset)
     # delta_i = rowsum(dO ∘ O): elementwise, XLA fuses it fine outside.
     delta = jnp.sum(
         doh.astype(jnp.float32)
         * _heads_first(out).astype(jnp.float32),
         axis=-1,
     )  # [B*H, Tq] f32
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
 
     qspec = lambda im: pl.BlockSpec(
         (1, block_q, D), im, memory_space=pltpu.VMEM
@@ -471,6 +519,8 @@ def _flash_backward(
         dkv_kernel,
         grid=(B * H, Tkv // block_kv, Tq // block_q),
         in_specs=[
+            _smem_scalar_spec(pl, pltpu),
+            _smem_scalar_spec(pl, pltpu),
             qspec(lambda b, j, i: (b, i, 0)),
             kvspec(lambda b, j, i: (b, j, 0)),
             kvspec(lambda b, j, i: (b, j, 0)),
@@ -494,7 +544,7 @@ def _flash_backward(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qh, kh, vh, doh, lse, delta)
+    )(qoff, kvoff, qh, kh, vh, doh, lse, delta)
 
     dq_kernel = functools.partial(
         _flash_dq_kernel,
@@ -504,6 +554,8 @@ def _flash_backward(
         dq_kernel,
         grid=(B * H, Tq // block_q, Tkv // block_kv),
         in_specs=[
+            _smem_scalar_spec(pl, pltpu),
+            _smem_scalar_spec(pl, pltpu),
             qspec(lambda b, i, j: (b, i, 0)),
             kvspec(lambda b, i, j: (b, j, 0)),
             kvspec(lambda b, i, j: (b, j, 0)),
@@ -518,7 +570,7 @@ def _flash_backward(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qh, kh, vh, doh, lse, delta)
+    )(qoff, kvoff, qh, kh, vh, doh, lse, delta)
 
     unflat = lambda x, T: jnp.swapaxes(x.reshape(B, H, T, D), 1, 2)
     return unflat(dq, Tq), unflat(dk, Tkv), unflat(dv, Tkv)
@@ -551,6 +603,12 @@ def flash_attention(
     )
 
 
+def _lse_rows(lse):
+    """[B, T, H] public LSE layout -> the kernels' [B*H, T]."""
+    B, T, H = lse.shape
+    return jnp.swapaxes(lse, 1, 2).reshape(B * H, T)
+
+
 def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     out, lse = _flash_forward(
         q, k, v, causal=causal, scale=scale,
@@ -563,12 +621,73 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
 def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
     q, k, v, out, lse = res
     return _flash_backward(
-        q, k, v, out, lse, g, causal=causal, scale=scale,
+        q, k, v, out, _lse_rows(lse), g, causal=causal, scale=scale,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
     )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention_chunk(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset: jax.Array = 0,
+    kv_offset: jax.Array = 0,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Chunk-of-a-longer-sequence flash attention: returns ``(out, lse)``
+    with lse ``[B, T, H]`` so a caller can exactly merge partial results
+    from several KV chunks (the ring-attention inner step —
+    :func:`...parallel.ring.ring_attention` with ``impl='flash'``).
+
+    ``q_offset``/``kv_offset`` are the *global* positions of the first
+    local row — dynamic (traced) values; causal masking happens in global
+    coordinates inside the kernel.  Differentiable in q/k/v including
+    through the lse output (the LSE cotangent folds into the backward's
+    delta term).
+    """
+    return _flash_forward(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+        return_lse=True, q_offset=q_offset, kv_offset=kv_offset,
+    )
+
+
+def _flash_chunk_fwd(
+    q, k, v, q_offset, kv_offset, causal, scale, block_q, block_kv,
+    interpret,
+):
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+        return_lse=True, q_offset=q_offset, kv_offset=kv_offset,
+    )
+    return (out, lse), (q, k, v, out, lse, q_offset, kv_offset)
+
+
+def _flash_chunk_bwd(
+    causal, scale, block_q, block_kv, interpret, res, cotangents
+):
+    q, k, v, out, lse, q_offset, kv_offset = res
+    g_out, g_lse = cotangents
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, _lse_rows(lse), g_out, causal=causal, scale=scale,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+        q_offset=q_offset, kv_offset=kv_offset,
+        g_lse=_lse_rows(g_lse),
+    )
+    # Offsets are integer positions: no gradient.
+    return dq, dk, dv, None, None
+
+
+flash_attention_chunk.defvjp(_flash_chunk_fwd, _flash_chunk_bwd)
 
 
 def attention(

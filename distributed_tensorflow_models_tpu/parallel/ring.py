@@ -38,6 +38,62 @@ from distributed_tensorflow_models_tpu.core.mesh import AxisNames
 from distributed_tensorflow_models_tpu.ops import attention as attnlib
 
 
+def _ring_attention_local_flash(
+    q, k, v, *, axis_name: str, causal: bool, scale: Optional[float],
+    interpret: bool = False,
+):
+    """Per-device ring body with the Pallas flash kernel as the inner
+    step: each visiting KV chunk runs through
+    :func:`...ops.attention.flash_attention_chunk` (global-coordinate
+    causal masking via dynamic offsets), and the per-chunk ``(out, lse)``
+    pairs merge through the exact streaming log-sum-exp recurrence.  The
+    MXU-heavy work happens inside the fused kernel; XLA only sees the
+    O(T_local) merge arithmetic and the ``ppermute`` rotations."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    q_off = my * Tl
+
+    # Carries derive from q so they inherit its device-varying axis type
+    # (shard_map requires scan carries varying like the body output).
+    m0 = jnp.zeros_like(q[..., 0], jnp.float32) + attnlib.NEG_INF
+    l0 = jnp.zeros_like(q[..., 0], jnp.float32)
+    a0 = jnp.zeros_like(q, jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @jax.checkpoint
+    def body(carry, r):
+        # remat: backward re-runs each rotation's chunk kernel instead of
+        # stacking its custom_vjp residuals (q/k/v/out/lse per rotation)
+        # across all n rotations — same O(T/n) backward memory as the
+        # fold path.
+        m, l, acc, k_cur, v_cur = carry
+        src = (my - r) % n
+        kv_off = src * Tl
+        # Fully-masked chunks come back with lse ~ NEG_INF, which exp()s
+        # to zero weight in the merge — the kernel's causal block-skip
+        # already avoided their FLOPs, so no outer lax.cond is needed.
+        o_r, lse_r = attnlib.flash_attention_chunk(
+            q, k_cur, v_cur, q_off, kv_off,
+            causal=causal, scale=scale, interpret=interpret,
+        )
+        m_new = jnp.maximum(m, lse_r)
+        alpha = jnp.exp(m - m_new)
+        w_r = jnp.exp(lse_r - m_new)
+        l = alpha * l + w_r
+        acc = acc * alpha[..., None] + o_r.astype(jnp.float32) * w_r[..., None]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l, acc, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        body, (m0, l0, a0, k, v), jnp.arange(n)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
 def _ring_attention_local(
     q, k, v, *, axis_name: str, causal: bool, scale: Optional[float]
 ):
@@ -113,27 +169,57 @@ def ring_attention(
     scale: Optional[float] = None,
     seq_axis: str = AxisNames.SEQ,
     data_axis: str = AxisNames.DATA,
+    impl: str = "auto",
+    interpret: bool = False,
 ) -> jax.Array:
     """Full-sequence attention with Q/K/V sharded over ``seq_axis``.
 
     Global BTHD arrays in, global BTHD out; batch sharded over
     ``data_axis``, sequence over ``seq_axis``, causal masking computed in
     global positions.  ``T`` must divide by the seq-axis size.
+
+    ``impl``: ``"fold"`` = XLA streaming-softmax fold (any backend);
+    ``"flash"`` = Pallas flash kernel per visiting chunk with exact LSE
+    merge (TPU; ``interpret=True`` for CPU tests); ``"auto"`` = flash on
+    TPU when the local chunk is tile-aligned, fold elsewhere.
     """
     n = mesh.shape[seq_axis]
     if q.shape[1] % n:
         raise ValueError(
             f"seq len {q.shape[1]} not divisible by seq axis {n}"
         )
-    spec = P(data_axis, seq_axis, None, None)
-    fn = jax.shard_map(
-        functools.partial(
+    if impl == "auto":
+        impl = (
+            "flash"
+            if jax.default_backend() == "tpu" and (q.shape[1] // n) % 128 == 0
+            else "fold"
+        )
+    check_vma = True
+    if impl == "flash":
+        local = functools.partial(
+            _ring_attention_local_flash,
+            axis_name=seq_axis, causal=causal, scale=scale,
+            interpret=interpret,
+        )
+        # pallas_call outputs carry no varying-mesh-axes type, which the
+        # shard_map vma checker rejects; the surrounding merge arithmetic
+        # derives everything from q/k/v, so the physical sharding is the
+        # same as the checked fold path's.
+        check_vma = False
+    elif impl == "fold":
+        local = functools.partial(
             _ring_attention_local,
             axis_name=seq_axis, causal=causal, scale=scale,
-        ),
+        )
+    else:
+        raise ValueError(f"unknown ring attention impl {impl!r}")
+    spec = P(data_axis, seq_axis, None, None)
+    fn = jax.shard_map(
+        local,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=check_vma,
     )
     return fn(q, k, v)
 
