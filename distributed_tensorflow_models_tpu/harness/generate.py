@@ -1,0 +1,107 @@
+"""Autoregressive generation for the transformer LM.
+
+The reference framework is training/eval-only (SURVEY.md §2.1 R10 — its
+eval drivers compute top-k counts; nothing generates).  This module is
+part of the framework's beyond-parity LM surface: KV-cached decoding in
+the TPU-idiomatic shape — ONE compiled program for the whole generation
+(`lax.scan` over steps, static shapes, cache updated in place with
+`dynamic_update_slice`), instead of a Python loop of per-token dispatches.
+
+Flow: the prompt runs through the model once in decode mode (filling every
+block's KV cache and the position counter), then a scan generates
+``max_new_tokens`` tokens, threading the cache collection as carry.
+Greedy when ``temperature == 0``; categorical sampling otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def generate(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    eos_id: Optional[int] = None,
+):
+    """Generate continuations for ``prompt`` ``[B, T_prompt]`` (int32).
+
+    ``model`` is a ``TransformerLM`` (training configuration — this
+    function re-clones it with ``decode=True``); ``params`` its trained
+    parameters.  Returns ``[B, T_prompt + max_new_tokens]`` tokens.  The
+    prompt must be dense (no padding); ``model.max_len`` bounds
+    ``T_prompt + max_new_tokens``.
+
+    When ``eos_id`` is set, rows that have emitted it keep emitting
+    ``eos_id`` (the scan length stays static — TPU-friendly — so "stop"
+    means "freeze", not "exit early").
+    """
+    B, T_prompt = prompt.shape
+    total = T_prompt + max_new_tokens
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt {T_prompt} + new {max_new_tokens} exceeds "
+            f"max_len {model.max_len}"
+        )
+    decode_model = model.clone(decode=True, dropout_rate=0.0)
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    rng = rng if rng is not None else jax.random.key(0)
+
+    # Prompt pass: fills the caches; logits of the LAST prompt token seed
+    # the first generated token.
+    (logits, _), cache_vars = decode_model.apply(
+        {"params": params},
+        prompt,
+        train=False,
+        mutable=["cache"],
+    )
+    cache = cache_vars["cache"]
+
+    def sample(logits_last, key):
+        if temperature > 0:
+            return jax.random.categorical(
+                key, logits_last / temperature, axis=-1
+            ).astype(prompt.dtype)
+        return jnp.argmax(logits_last, axis=-1).astype(prompt.dtype)
+
+    keys = jax.random.split(rng, max_new_tokens)  # one per new token
+    first = sample(logits[:, -1], keys[0])
+
+    def step(carry, key):
+        cache, tok, done = carry
+        (logits, _), mutated = decode_model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            train=False,
+            mutable=["cache"],
+        )
+        nxt = sample(logits[:, -1], key)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (mutated["cache"], nxt, done), tok
+
+    done0 = (
+        (first == eos_id)
+        if eos_id is not None
+        else jnp.zeros((B,), bool)
+    )
+    # first is token #1; each scan step consumes the previous token and
+    # emits the next — max_new_tokens - 1 steps complete the count.
+    (_, last, _), toks = jax.lax.scan(
+        step, (cache, first, done0), keys[1:]
+    )
+    # toks stacks the PREVIOUS token per step: [first, ..., second-last];
+    # append the final one and restore batch-major order.
+    generated = jnp.concatenate(
+        [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
+    )
+    return jnp.concatenate([prompt, generated], axis=1)

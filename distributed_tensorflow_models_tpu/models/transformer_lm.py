@@ -32,7 +32,14 @@ from distributed_tensorflow_models_tpu.ops import attention as attnlib
 
 
 class SelfAttention(nn.Module):
-    """Causal multi-head self-attention with pluggable attention impl."""
+    """Causal multi-head self-attention with pluggable attention impl.
+
+    ``decode=True`` switches to autoregressive KV-cache mode: each call
+    appends the new tokens' K/V into ``cache`` collection variables sized
+    ``[B, max_len, H, Dh]`` (written with ``lax.dynamic_update_slice`` so
+    the program stays static-shaped under ``lax.scan``) and attends over
+    the cache with global-position causal masking — the TPU-idiomatic
+    decode loop (one compiled step, no growing shapes)."""
 
     num_heads: int
     d_model: int
@@ -41,6 +48,8 @@ class SelfAttention(nn.Module):
     attn_impl: str = "auto"
     # Sequence-parallel override: (q, k, v, causal=...) -> out, BTHD.
     attention_fn: Optional[Callable] = None
+    decode: bool = False
+    max_len: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -53,7 +62,33 @@ class SelfAttention(nn.Module):
         q = dense("query")(x).reshape(B, T, H, Dh)
         k = dense("key")(x).reshape(B, T, H, Dh)
         v = dense("value")(x).reshape(B, T, H, Dh)
-        if self.attention_fn is not None:
+        if self.decode:
+            ck = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros((B, self.max_len, H, Dh), k.dtype),
+            )
+            cv = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros((B, self.max_len, H, Dh), v.dtype),
+            )
+            ci = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            idx = ci.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, idx, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, idx, 0, 0)
+            )
+            ci.value = idx + T
+            # Causal mask in global positions (q rows sit at idx..idx+T-1)
+            # also hides the cache's not-yet-written tail: unwritten slots
+            # are all at positions > the last query row.
+            out = attnlib.reference_attention(
+                q, ck.value, cv.value, causal=True, q_offset=idx
+            )
+        elif self.attention_fn is not None:
             out = self.attention_fn(q, k, v, causal=True)
         else:
             out = attnlib.attention(q, k, v, causal=True, impl=self.attn_impl)
@@ -159,6 +194,8 @@ class Block(nn.Module):
     num_experts: int = 0
     moe_mesh: Any = None
     moe_capacity_factor: float = 1.25
+    decode: bool = False
+    max_len: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -170,6 +207,8 @@ class Block(nn.Module):
             self.dtype,
             self.attn_impl,
             self.attention_fn,
+            decode=self.decode,
+            max_len=self.max_len,
             name="attn",
         )(h, train=train)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -399,6 +438,9 @@ class TransformerLM(nn.Module):
     # more FLOPs for O(num_layers) less activation HBM — the standard TPU
     # long-context memory lever (SURVEY.md TPU notes).
     remat: bool = False
+    # Autoregressive decode mode: KV caches in the ``cache`` variable
+    # collection (see SelfAttention); drive with harness/generate.py.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, carry=None, train: bool = False):
@@ -414,9 +456,29 @@ class TransformerLM(nn.Module):
             nn.initializers.normal(0.02),
             (self.max_len, self.d_model),
         )
-        x = x + pos[:T].astype(self.dtype)
+        if self.decode:
+            # Tokens sit at global positions pos_index..pos_index+T-1.
+            pi = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            x = x + jax.lax.dynamic_slice_in_dim(
+                pos, pi.value, T, 0
+            ).astype(self.dtype)
+            pi.value = pi.value + T
+        else:
+            x = x + pos[:T].astype(self.dtype)
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        if self.decode and (
+            self.pipelined
+            or self.pipe_mesh is not None
+            or self.num_experts
+            or self.attention_fn is not None
+        ):
+            raise ValueError(
+                "decode mode supports the dense non-pipelined stack "
+                "without a sequence-parallel attention_fn"
+            )
         if self.pipelined or self.pipe_mesh is not None:
             if self.num_experts or self.remat:
                 raise ValueError(
@@ -454,6 +516,8 @@ class TransformerLM(nn.Module):
                     num_experts=self.num_experts,
                     moe_mesh=self.moe_mesh,
                     moe_capacity_factor=self.moe_capacity_factor,
+                    decode=self.decode,
+                    max_len=self.max_len,
                     name=f"blocks_{i}",
                 )(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)

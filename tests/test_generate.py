@@ -1,0 +1,136 @@
+"""KV-cache decode correctness: cached per-token logits must equal the
+full-sequence forward, and `generate` must reproduce a naive
+recompute-everything greedy loop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu.harness.generate import generate
+from distributed_tensorflow_models_tpu.models import get_model
+
+
+@pytest.fixture(scope="module")
+def small_lm():
+    model = get_model(
+        "transformer_lm",
+        vocab_size=50,
+        num_layers=2,
+        num_heads=2,
+        d_model=32,
+        d_ff=64,
+        max_len=32,
+        dropout_rate=0.0,
+        dtype=jnp.float32,
+        attn_impl="reference",
+    )
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def test_decode_logits_match_full_forward(small_lm):
+    """Token-by-token decode through the KV cache reproduces the full
+    forward's logits at every position — the exact invariant the cache
+    exists to preserve."""
+    model, params = small_lm
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 50, (2, 10)), jnp.int32)
+
+    full_logits, _ = model.apply({"params": params}, tokens, train=False)
+
+    decode_model = model.clone(decode=True)
+    cache = {}
+    step_logits = []
+    for t in range(tokens.shape[1]):
+        variables = {"params": params}
+        if cache:
+            variables["cache"] = cache
+        (lg, _), mut = decode_model.apply(
+            variables, tokens[:, t : t + 1], train=False, mutable=["cache"]
+        )
+        cache = mut["cache"]
+        step_logits.append(lg[:, 0])
+    np.testing.assert_allclose(
+        jnp.stack(step_logits, axis=1), full_logits, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_decode_prompt_chunk_then_steps(small_lm):
+    """A multi-token prompt pass followed by single-token steps lands on
+    the same logits as all-single-token decoding (positions and cache
+    indices advance consistently for T>1 writes)."""
+    model, params = small_lm
+    rng = np.random.RandomState(1)
+    tokens = jnp.asarray(rng.randint(0, 50, (1, 8)), jnp.int32)
+    decode_model = model.clone(decode=True)
+
+    (lg_prompt, _), mut = decode_model.apply(
+        {"params": params}, tokens[:, :5], train=False, mutable=["cache"]
+    )
+    (lg6, _), _ = decode_model.apply(
+        {"params": params, "cache": mut["cache"]},
+        tokens[:, 5:6],
+        train=False,
+        mutable=["cache"],
+    )
+    full_logits, _ = model.apply(
+        {"params": params}, tokens[:, :6], train=False
+    )
+    np.testing.assert_allclose(
+        lg_prompt, full_logits[:, :5], rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        lg6[:, 0], full_logits[:, 5], rtol=1e-4, atol=1e-4
+    )
+
+
+def test_generate_matches_naive_greedy(small_lm):
+    """generate() (scan + cache) == recompute-the-whole-prefix greedy."""
+    model, params = small_lm
+    rng = np.random.RandomState(2)
+    prompt = jnp.asarray(rng.randint(0, 50, (2, 4)), jnp.int32)
+    max_new = 6
+
+    out = generate(model, params, prompt, max_new)
+    assert out.shape == (2, 4 + max_new)
+
+    toks = prompt
+    for _ in range(max_new):
+        logits, _ = model.apply({"params": params}, toks, train=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+def test_generate_eos_freeze(small_lm):
+    """Rows that hit eos keep emitting eos for the rest of the (static
+    length) generation — eos_id is chosen as the model's actual first
+    greedy token so the freeze path deterministically triggers."""
+    model, params = small_lm
+    prompt = jnp.zeros((1, 2), jnp.int32)
+    logits, _ = model.apply({"params": params}, prompt, train=False)
+    eos = int(jnp.argmax(logits[0, -1]))
+    out = generate(model, params, prompt, 8, eos_id=eos)
+    gen = np.asarray(out)[0, 2:]
+    assert gen[0] == eos
+    assert (gen == eos).all(), gen
+
+
+def test_generate_rejects_overflow(small_lm):
+    model, params = small_lm
+    with pytest.raises(ValueError):
+        generate(model, params, jnp.zeros((1, 30), jnp.int32), 8)
+
+
+def test_generate_temperature_sampling_runs(small_lm):
+    model, params = small_lm
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    out = generate(
+        model, params, prompt, 5,
+        temperature=1.0, rng=jax.random.key(3),
+    )
+    assert out.shape == (2, 8)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 50).all()
