@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """Benchmark harness: training throughput + MFU for the headline configs.
 
-Covers BASELINE.md's benchmarked configs 3-5: ImageNet ResNet-50 (the
+Covers all five BASELINE.md benchmarked configs — MNIST LeNet (1), CIFAR-10
+ResNet-32 (2), ImageNet Inception-v3 (3), ImageNet ResNet-50 (4, the
 reference's async-vs-sync comparison model, SURVEY.md §2.1 R6 — the headline
-metric), ImageNet Inception-v3 (R5), and the PTB LSTM (R8, tokens/sec).
-Synthetic on-device data isolates compute throughput from host input, the
-standard convention for this comparison (the reference's own benchmarking
-used the same trick via slim's fake dataset).
+metric), PTB LSTM (5, tokens/sec) — plus the beyond-parity transformer LM at
+T=512 and T=4096 and a Pallas flash-attention microbench.  Synthetic
+on-device data isolates compute throughput from host input, the standard
+convention for this comparison (the reference's own benchmarking used the
+same trick via slim's fake dataset).
 
 Prints exactly ONE JSON line on stdout (the driver's contract):
 
@@ -63,6 +65,10 @@ PEAK_BF16_FLOPS = (
 ANALYTIC_TRAIN_FLOPS_PER_ITEM = {
     "resnet50": 3 * 4.1e9,  # ResNet-50 v1 @224
     "inception_v3": 3 * 5.7e9,  # Inception-v3 @299
+    # conv1 5x5x32 @28 (0.63M MACs) + conv2 5x5x64 @14 (10.0M) + fc
+    # 3136x1024 (3.2M), x2 FLOPs/MAC ~= 27.8M fwd
+    "lenet": 3 * 2.78e7,
+    "resnet32": 3 * 1.4e8,  # CIFAR ResNet-32 (6n+2, n=5) @32
     "ptb_lstm": 3 * 2.65e7,  # medium: 2 LSTM layers 4*650*1300 MACs + head
     # 8L x d512 transformer @T512: ~6*12*L*d^2 + attention terms per token
     "transformer_lm": 3 * 6.0e7,
@@ -248,6 +254,24 @@ def build_resnet50(n_chips, batch_override):
     )
 
 
+def build_lenet(n_chips, batch_override):
+    # BASELINE config 1: the reference's single-worker CPU MNIST job — on
+    # TPU it mostly measures dispatch overhead, recorded for completeness.
+    return _build_classifier(
+        "lenet", 28, batch_override or 512, n_chips,
+        channels=1, num_classes=10,
+    )
+
+
+def build_resnet32(n_chips, batch_override):
+    # BASELINE config 2: CIFAR-10 ResNet-32 sync-DP.  Also the smallest
+    # real conv workload — the relay's conv-compile canary.
+    return _build_classifier(
+        "resnet32_cifar", 32, batch_override or 256, n_chips,
+        weight_decay=2e-4, num_classes=10,
+    )
+
+
 def build_inception_v3(n_chips, batch_override):
     # The full R5 training step: aux head + label smoothing + L2, RMSProp.
     return _build_classifier(
@@ -271,6 +295,8 @@ def _build_classifier(
     label_smoothing=0.0,
     aux_loss_weight=0.0,
     rmsprop=False,
+    channels=3,
+    num_classes=1000,
 ):
     import jax
     import jax.numpy as jnp
@@ -296,7 +322,7 @@ def _build_classifier(
         model,
         tx,
         jax.random.key(0),
-        jnp.zeros((8, image_size, image_size, 3), jnp.float32),
+        jnp.zeros((8, image_size, image_size, channels), jnp.float32),
     )
     state = train_loop.place_state(state, mesh)
     step_fn = train_loop.make_train_step_fn(
@@ -311,10 +337,10 @@ def _build_classifier(
     batch = shardlib.shard_batch(
         mesh,
         {
-            "image": rng.rand(batch_size, image_size, image_size, 3).astype(
-                np.float32
-            ),
-            "label": rng.randint(0, 1000, (batch_size,)),
+            "image": rng.rand(
+                batch_size, image_size, image_size, channels
+            ).astype(np.float32),
+            "label": rng.randint(0, num_classes, (batch_size,)),
         },
     )
     return state, batch, step_fn, per_chip_batch, "images/sec/chip"
@@ -529,22 +555,28 @@ def run_flash_check(args):
 BUILDERS = {
     "resnet50": build_resnet50,
     "inception_v3": build_inception_v3,
+    "lenet": build_lenet,
+    "resnet32": build_resnet32,
     "ptb_lstm": build_ptb_lstm,
     "transformer_lm": build_transformer_lm,
     "transformer_lm_long": build_transformer_lm_long,
 }
 HEADLINE = "resnet50"
-# Execution order: cheap matmul-dominated configs first so at least one
-# number lands even if a conv compile wedges the backend (the observed
-# failure mode); then the headline resnet50 ahead of inception_v3; the
-# TPU-only Pallas microbench last.
+# Execution order: matmul-dominated configs and the Pallas microbench
+# first — a conv remote-compile can wedge the relay for every process
+# after it (the observed failure mode), so everything conv-free must
+# already have its number banked.  Then convs smallest-first (lenet →
+# resnet32 → resnet50 → inception_v3): if the wedge hits, the boundary
+# it hit at is itself recorded.
 ORDER = [
     "ptb_lstm",
     "transformer_lm",
     "transformer_lm_long",
+    "flash_check",
+    "lenet",
+    "resnet32",
     "resnet50",
     "inception_v3",
-    "flash_check",
 ]
 CHILD_MODES = sorted(BUILDERS) + ["flash_check"]
 

@@ -44,6 +44,10 @@ def generate(
     means "freeze", not "exit early").
     """
     B, T_prompt = prompt.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt
     total = T_prompt + max_new_tokens
     if total > model.max_len:
         raise ValueError(

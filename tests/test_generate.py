@@ -125,6 +125,16 @@ def test_generate_rejects_overflow(small_lm):
         generate(model, params, jnp.zeros((1, 30), jnp.int32), 8)
 
 
+def test_generate_zero_and_negative_new_tokens(small_lm):
+    model, params = small_lm
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(generate(model, params, prompt, 0)), np.asarray(prompt)
+    )
+    with pytest.raises(ValueError):
+        generate(model, params, prompt, -1)
+
+
 def test_generate_temperature_sampling_runs(small_lm):
     model, params = small_lm
     prompt = jnp.zeros((2, 3), jnp.int32)
