@@ -461,6 +461,66 @@ def build_transformer_lm_long(n_chips, batch_override):
     )
 
 
+def run_decode(args):
+    """KV-cache generation throughput for the flagship transformer: one
+    jitted `generate` (prompt pass + lax.scan over single-token steps).
+    Decode is latency-shaped work (matmul panels of batch rows against
+    the weights, cache gathers), so tokens/sec here is NOT comparable to
+    training tokens/sec — it is the serving-side metric.  Matmul-only:
+    safe for this relay (no conv compiles)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_models_tpu.harness.generate import generate
+    from distributed_tensorflow_models_tpu.models import get_model
+
+    B = args.batch or 8
+    T_prompt, T_new = 64, 192
+    model = get_model(
+        "transformer_lm",
+        num_layers=8,
+        num_heads=8,
+        d_model=512,
+        d_ff=2048,
+        max_len=T_prompt + T_new,
+        dropout_rate=0.0,
+    )
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, 10000, (B, T_prompt)), jnp.int32)
+    params = model.init(jax.random.key(0), prompt[:, :8])["params"]
+
+    fn = jax.jit(lambda p, t: generate(model, p, t, T_new))
+    # Prefill-only run (1 new token ~= the prompt pass + one sample):
+    # subtracted out so the reported numbers are decode-step latency, not
+    # prefill amortization.
+    fn_prefill = jax.jit(lambda p, t: generate(model, p, t, 1))
+
+    def timed(f):
+        t0 = time.time()
+        np.asarray(f(params, prompt))  # readback = the only real sync
+        log(f"decode: compiled+first run in {time.time()-t0:.1f}s")
+        t0 = time.perf_counter()
+        np.asarray(f(params, prompt))
+        return time.perf_counter() - t0
+
+    dt_prefill = timed(fn_prefill)
+    dt_full = timed(fn)
+    dt_decode = max(dt_full - dt_prefill, 1e-9)
+    steps = T_new - 1  # tokens produced by the scan, prefill excluded
+    return {
+        "metric": "transformer_lm_decode_throughput",
+        "value": round(B * steps / dt_decode, 1),
+        "unit": "tokens/sec/chip",
+        "batch": B,
+        "prompt_len": T_prompt,
+        "new_tokens": T_new,
+        "seconds_total": round(dt_full, 3),
+        "seconds_prefill": round(dt_prefill, 3),
+        "ms_per_token_step": round(dt_decode / steps * 1e3, 3),
+    }
+
+
 def run_flash_check(args):
     """Flash-vs-blockwise attention on real hardware: numerics + timing.
 
@@ -573,12 +633,13 @@ ORDER = [
     "transformer_lm",
     "transformer_lm_long",
     "flash_check",
+    "decode",
     "lenet",
     "resnet32",
     "resnet50",
     "inception_v3",
 ]
-CHILD_MODES = sorted(BUILDERS) + ["flash_check"]
+CHILD_MODES = sorted(BUILDERS) + ["flash_check", "decode"]
 
 
 def run_mode(name, args):
@@ -587,6 +648,8 @@ def run_mode(name, args):
     microbenches run directly."""
     if name == "flash_check":
         return run_flash_check(args)
+    if name == "decode":
+        return run_decode(args)
     return run_one(name, BUILDERS[name], args.steps, args.batch or None)
 
 
