@@ -1,0 +1,64 @@
+#!/bin/bash
+# Auto-runner for the moment the axon relay recovers from the conv wedge.
+# Order is wedge-aware (see experiments/TPU_BENCH_r2.md): matmul-only
+# workloads first — each result saved before the next starts — then the
+# conv ladder smallest-first, then (only if the ladder cleared resnet50)
+# the full headline bench.  Run it in the background; it polls until the
+# backend answers, does everything once, and exits.
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+echo "$(date) recovery runner started" >> "$LOG"
+
+# 1. Poll for backend recovery (90s probe, 10 min between attempts).
+while ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; do
+    sleep 600
+done
+date > /tmp/tpu_alive
+echo "$(date) backend ANSWERED" >> "$LOG"
+
+# 2. Matmul-safe benches, one subprocess each, artifact saved per config.
+for cfg in ptb_lstm transformer_lm transformer_lm_long flash_check decode; do
+    echo "$(date) bench $cfg" >> "$LOG"
+    timeout 1200 python bench.py --config "$cfg" --no-probe \
+        > "experiments/tpu_bench_${cfg}_r2b.json" 2>> "$LOG"
+    echo "$(date) bench $cfg rc=$?" >> "$LOG"
+done
+
+# 3. Convergence on real hardware (matmul-only configs).  The generator
+#    writes convergence_<config>.{json,md}; move them to *_tpu so the
+#    CPU-run artifacts stay alongside.
+for cconf in ptb_small transformer_lm; do
+    echo "$(date) $cconf convergence" >> "$LOG"
+    timeout 2400 python experiments/run_convergence.py --config "$cconf" \
+        --steps 2000 >> "$LOG" 2>&1
+    echo "$(date) $cconf convergence rc=$?" >> "$LOG"
+    for ext in json md; do
+        for f in experiments/convergence_${cconf}.$ext \
+                 experiments/CONVERGENCE_${cconf}.$ext; do
+            [ -f "$f" ] && mv "$f" "${f%.$ext}_tpu.$ext"
+        done
+    done
+    # The generator overwrote the committed CPU artifacts in place; the
+    # mv renamed the TPU versions — restore the CPU originals from git.
+    git checkout -- "experiments/convergence_${cconf}.json" \
+        "experiments/CONVERGENCE_${cconf}.md" 2>/dev/null
+done
+
+# 4. Conv ladder, smallest first; stops at first wedge and records it.
+echo "$(date) conv ladder" >> "$LOG"
+python experiments/conv_ladder.py --timeout 420 \
+    --out experiments/conv_ladder.json >> "$LOG" 2>&1
+echo "$(date) conv ladder rc=$?" >> "$LOG"
+
+# 5. Full bench only if the ladder's top rung (resnet50 b256) passed —
+#    otherwise the conv configs would just re-wedge the relay.
+if python -c "import json,sys; r=json.load(open('experiments/conv_ladder.json')); sys.exit(0 if r.get('resnet50_train_b256',{}).get('ok') else 1)" 2>/dev/null; then
+    echo "$(date) ladder clean -> full bench" >> "$LOG"
+    timeout 3600 python bench.py > experiments/tpu_bench_full_r2b.json 2>> "$LOG"
+    echo "$(date) full bench rc=$?" >> "$LOG"
+else
+    echo "$(date) ladder did not clear resnet50; skipping full bench" >> "$LOG"
+fi
+echo "$(date) recovery runner DONE" >> "$LOG"
+touch /tmp/tpu_recovery_done
