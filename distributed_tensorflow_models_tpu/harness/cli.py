@@ -115,6 +115,27 @@ def main(argv: list[str] | None = None) -> int:
     p_ab.add_argument("--multihost", action="store_true")
     # Shared override plumbing (_overrides) expects these attributes.
     p_ab.set_defaults(train_steps=None, workdir=None)
+    p_gen = sub.add_parser(
+        "generate",
+        help="sample from a trained transformer LM checkpoint (KV-cache "
+        "decode)",
+    )
+    p_gen.add_argument("--config", required=True)
+    p_gen.add_argument("--workdir", required=True)
+    p_gen.add_argument(
+        "--prompt",
+        default="",
+        help="comma-separated token ids (empty = BOS-style token 0)",
+    )
+    p_gen.add_argument("--max-new-tokens", type=int, default=64)
+    p_gen.add_argument("--temperature", type=float, default=0.0)
+    # Default None so _overrides doesn't clobber cfg.seed; the sampling
+    # key falls back to 0 below.
+    p_gen.add_argument("--seed", type=int, default=None)
+    p_gen.add_argument("--eos-id", type=int, default=None)
+    p_gen.set_defaults(
+        train_steps=None, batch_size=None, multihost=False
+    )
     sub.add_parser("list", help="list available configs")
     args = parser.parse_args(argv)
 
@@ -158,6 +179,73 @@ def main(argv: list[str] | None = None) -> int:
 
         result = trainlib.recoverable_fit(cfg, args.workdir)
         print(json.dumps({"final_metrics": result.final_metrics}))
+        return 0
+
+    if args.cmd == "generate":
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_tensorflow_models_tpu.harness import (
+            checkpoint as ckptlib,
+        )
+        from distributed_tensorflow_models_tpu.harness import (
+            generate as genlib,
+        )
+        from distributed_tensorflow_models_tpu.harness import train as trainlib
+
+        if cfg.task != "lm" or cfg.model != "transformer_lm":
+            raise SystemExit(
+                "generate requires a transformer_lm config "
+                f"(got model={cfg.model!r})"
+            )
+        if cfg.mesh_pipe > 1:
+            raise SystemExit(
+                "generate does not support pipelined checkpoints "
+                "(stacked parameter layout)"
+            )
+        from distributed_tensorflow_models_tpu.models import get_model
+
+        mesh = trainlib.mesh_from_config(cfg)
+        template = trainlib.build_state(cfg, mesh)
+        manager = ckptlib.CheckpointManager(
+            args.workdir, keep=cfg.keep_checkpoints
+        )
+        try:
+            state, _ = manager.restore(template)
+        except FileNotFoundError as e:
+            raise SystemExit(
+                f"no checkpoint in {args.workdir!r}: {e}"
+            ) from e
+        model = get_model(cfg.model, **cfg.model_kwargs)
+        try:
+            tokens = [
+                int(t) for t in args.prompt.split(",") if t.strip()
+            ]
+        except ValueError as e:
+            raise SystemExit(
+                f"--prompt must be comma-separated ints: {e}"
+            ) from e
+        if not tokens:
+            tokens = [0]
+        prompt = jnp.asarray([tokens], jnp.int32)
+        out = genlib.generate(
+            model,
+            state.params,
+            prompt,
+            args.max_new_tokens,
+            temperature=args.temperature,
+            rng=jax.random.key(args.seed or 0),
+            eos_id=args.eos_id,
+        )
+        print(
+            json.dumps(
+                {
+                    "step": int(state.step),
+                    "prompt": tokens,
+                    "tokens": [int(t) for t in out[0]],
+                }
+            )
+        )
         return 0
 
     from distributed_tensorflow_models_tpu.harness import evaluate as evallib

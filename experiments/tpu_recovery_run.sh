@@ -11,7 +11,13 @@ LOG=experiments/tpu_recovery.log
 echo "$(date) recovery runner started" >> "$LOG"
 
 # 1. Poll for backend recovery (90s probe, 10 min between attempts).
-while ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; do
+#    The platform assert matters: a fast-FAILING relay would let jax fall
+#    back to CPU and jax.devices() would still return — which must not
+#    count as recovery or the benches below would record CPU numbers as
+#    TPU artifacts.
+while ! timeout 90 python -c \
+    "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    >/dev/null 2>&1; do
     sleep 600
 done
 date > /tmp/tpu_alive
@@ -32,17 +38,23 @@ for cconf in ptb_small transformer_lm; do
     echo "$(date) $cconf convergence" >> "$LOG"
     timeout 2400 python experiments/run_convergence.py --config "$cconf" \
         --steps 2000 >> "$LOG" 2>&1
-    echo "$(date) $cconf convergence rc=$?" >> "$LOG"
-    for ext in json md; do
-        for f in experiments/convergence_${cconf}.$ext \
-                 experiments/CONVERGENCE_${cconf}.$ext; do
-            [ -f "$f" ] && mv "$f" "${f%.$ext}_tpu.$ext"
+    rc=$?
+    echo "$(date) $cconf convergence rc=$rc" >> "$LOG"
+    # Rename ONLY on generator success — on failure the files on disk are
+    # the committed CPU artifacts (or absent) and renaming them would
+    # mislabel CPU data as this TPU run.
+    if [ "$rc" -eq 0 ]; then
+        for ext in json md; do
+            for f in experiments/convergence_${cconf}.$ext \
+                     experiments/CONVERGENCE_${cconf}.$ext; do
+                [ -f "$f" ] && mv "$f" "${f%.$ext}_tpu.$ext"
+            done
         done
-    done
-    # The generator overwrote the committed CPU artifacts in place; the
-    # mv renamed the TPU versions — restore the CPU originals from git.
-    git checkout -- "experiments/convergence_${cconf}.json" \
-        "experiments/CONVERGENCE_${cconf}.md" 2>/dev/null
+        # The generator overwrote the committed CPU artifacts in place;
+        # the mv renamed the TPU versions — restore the CPU originals.
+        git checkout -- "experiments/convergence_${cconf}.json" \
+            "experiments/CONVERGENCE_${cconf}.md" 2>/dev/null
+    fi
 done
 
 # 4. Conv ladder, smallest first; stops at first wedge and records it.

@@ -144,3 +144,33 @@ def test_generate_temperature_sampling_runs(small_lm):
     )
     assert out.shape == (2, 8)
     assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 50).all()
+
+
+def test_cli_train_then_generate(tmp_path):
+    """The user surface: train a transformer_lm checkpoint via the CLI,
+    then sample from it with the generate subcommand."""
+    import json
+
+    from distributed_tensorflow_models_tpu.harness import cli
+
+    wd = str(tmp_path / "wd")
+    rc = cli.main(
+        ["train", "--config", "transformer_lm", "--workdir", wd,
+         "--train-steps", "2", "--batch-size", "8"]
+    )
+    assert rc == 0
+    rc = cli.main(
+        ["generate", "--config", "transformer_lm", "--workdir", wd,
+         "--prompt", "5,6,7", "--max-new-tokens", "4"],
+    )
+    assert rc == 0
+
+
+def test_cli_generate_rejects_non_lm(tmp_path):
+    from distributed_tensorflow_models_tpu.harness import cli
+
+    with pytest.raises(SystemExit):
+        cli.main(
+            ["generate", "--config", "lenet_mnist",
+             "--workdir", str(tmp_path)]
+        )
