@@ -50,26 +50,31 @@ class SelfAttention(nn.Module):
     attention_fn: Optional[Callable] = None
     decode: bool = False
     max_len: int = 0
+    # Grouped-query attention: KV projections (and the decode cache)
+    # carry num_kv_heads < num_heads heads; 0 = standard MHA.  The
+    # attention impls infer the grouping from the shapes (ops/attention).
+    num_kv_heads: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         B, T, _ = x.shape
         H = self.num_heads
+        Hkv = self.num_kv_heads or H
         Dh = self.d_model // H
-        dense = lambda name: nn.Dense(
-            self.d_model, dtype=self.dtype, name=name
+        dense = lambda name, feats: nn.Dense(
+            feats, dtype=self.dtype, name=name
         )
-        q = dense("query")(x).reshape(B, T, H, Dh)
-        k = dense("key")(x).reshape(B, T, H, Dh)
-        v = dense("value")(x).reshape(B, T, H, Dh)
+        q = dense("query", self.d_model)(x).reshape(B, T, H, Dh)
+        k = dense("key", Hkv * Dh)(x).reshape(B, T, Hkv, Dh)
+        v = dense("value", Hkv * Dh)(x).reshape(B, T, Hkv, Dh)
         if self.decode:
             ck = self.variable(
                 "cache", "cached_key",
-                lambda: jnp.zeros((B, self.max_len, H, Dh), k.dtype),
+                lambda: jnp.zeros((B, self.max_len, Hkv, Dh), k.dtype),
             )
             cv = self.variable(
                 "cache", "cached_value",
-                lambda: jnp.zeros((B, self.max_len, H, Dh), v.dtype),
+                lambda: jnp.zeros((B, self.max_len, Hkv, Dh), v.dtype),
             )
             ci = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -196,6 +201,7 @@ class Block(nn.Module):
     moe_capacity_factor: float = 1.25
     decode: bool = False
     max_len: int = 0
+    num_kv_heads: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -209,6 +215,7 @@ class Block(nn.Module):
             self.attention_fn,
             decode=self.decode,
             max_len=self.max_len,
+            num_kv_heads=self.num_kv_heads,
             name="attn",
         )(h, train=train)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -441,6 +448,9 @@ class TransformerLM(nn.Module):
     # Autoregressive decode mode: KV caches in the ``cache`` variable
     # collection (see SelfAttention); drive with harness/generate.py.
     decode: bool = False
+    # Grouped-query attention (0 = MHA); shrinks KV projections and the
+    # decode cache by num_heads/num_kv_heads.
+    num_kv_heads: int = 0
 
     @nn.compact
     def __call__(self, tokens, carry=None, train: bool = False):
@@ -480,10 +490,11 @@ class TransformerLM(nn.Module):
                 "without a sequence-parallel attention_fn"
             )
         if self.pipelined or self.pipe_mesh is not None:
-            if self.num_experts or self.remat:
+            if self.num_experts or self.remat or self.num_kv_heads:
                 raise ValueError(
-                    "pipelined path supports dense FFN with remat=False "
-                    "(remat the stage_fn instead)"
+                    "pipelined path supports dense MHA FFN blocks with "
+                    "remat=False (remat the stage_fn instead); "
+                    "num_kv_heads is not plumbed into the stacked layout"
                 )
             x = PipelinedBlocks(
                 self.num_layers,
@@ -518,6 +529,7 @@ class TransformerLM(nn.Module):
                     moe_capacity_factor=self.moe_capacity_factor,
                     decode=self.decode,
                     max_len=self.max_len,
+                    num_kv_heads=self.num_kv_heads,
                     name=f"blocks_{i}",
                 )(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)

@@ -40,6 +40,40 @@ def _scale(q, scale: Optional[float]) -> float:
     return scale if scale is not None else q.shape[-1] ** -0.5
 
 
+def _group_size(q, k) -> int:
+    """Grouped-query attention is shape-inferred: q ``[B,T,H,D]`` against
+    k/v ``[B,T,H_kv,D]`` with ``H % H_kv == 0`` means each group of
+    ``H/H_kv`` query heads shares one KV head (H_kv == 1 is MQA).
+    Returns the group size g (1 = standard MHA)."""
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % Hkv:
+        raise ValueError(
+            f"query heads {H} not divisible by kv heads {Hkv}"
+        )
+    return H // Hkv
+
+
+def _kv_row(H: int, Hkv: int, g: int):
+    """Grid-dim-0 (b·H + h) -> the KV head row (b·H_kv + h//g) for the
+    Pallas index maps.  ONE definition shared by forward and both
+    backward kernels: they must agree on the query-head-to-KV-row
+    mapping or gradients silently diverge from the forward's math."""
+    return lambda b: (b // H) * Hkv + (b % H) // g
+
+
+def _expand_kv(q, k, v):
+    """Repeat KV heads to match q's head count (the simple-oracle GQA
+    path for the XLA impls; the Pallas kernels map groups in their
+    index_maps instead and never materialize this)."""
+    g = _group_size(q, k)
+    if g == 1:
+        return k, v
+    return (
+        jnp.repeat(k, g, axis=2),
+        jnp.repeat(v, g, axis=2),
+    )
+
+
 def reference_attention(
     q: jax.Array,
     k: jax.Array,
@@ -57,6 +91,7 @@ def reference_attention(
     a longer sequence (the ring-attention case).
     """
     s = _scale(q, scale)
+    k, v = _expand_kv(q, k, v)
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * s
@@ -110,6 +145,7 @@ def blockwise_attention(
     that don't divide ``block_kv`` are padded and masked.
     """
     B, Tq, H, D = q.shape
+    k, v = _expand_kv(q, k, v)
     Tkv = k.shape[1]
     block_kv = min(block_kv, Tkv)
     # Arbitrary lengths: pad KV up to a block multiple and mask the tail.
@@ -287,10 +323,15 @@ def _flash_forward(
 
     B, Tq, H, D = q.shape
     Tkv = k.shape[1]
+    g = _group_size(q, k)
+    Hkv = H // g
     block_q, block_kv = _check_blocks(Tq, Tkv, block_q, block_kv)
     s = _scale(q, scale)
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
     qoff, kvoff = _offset_scalars(q_offset, kv_offset)
+    # GQA: grid dim 0 runs over B*H query heads; each maps to its group's
+    # KV head row — the kernel never materializes repeated KV.
+    kv_row = _kv_row(H, Hkv, g)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -307,11 +348,11 @@ def _flash_forward(
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, block_kv, D), lambda b, i, j: (b, j, 0),
+                (1, block_kv, D), lambda b, i, j: (kv_row(b), j, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, block_kv, D), lambda b, i, j: (b, j, 0),
+                (1, block_kv, D), lambda b, i, j: (kv_row(b), j, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
@@ -487,11 +528,14 @@ def _flash_backward(
 
     B, Tq, H, D = q.shape
     Tkv = k.shape[1]
+    grp = _group_size(q, k)
+    Hkv = H // grp
     block_q, block_kv = _check_blocks(Tq, Tkv, block_q, block_kv)
     s = _scale(q, scale)
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
     doh = _heads_first(g)
     qoff, kvoff = _offset_scalars(q_offset, kv_offset)
+    kv_row = _kv_row(H, Hkv, grp)
     # delta_i = rowsum(dO ∘ O): elementwise, XLA fuses it fine outside.
     delta = jnp.sum(
         doh.astype(jnp.float32)
@@ -515,6 +559,10 @@ def _flash_backward(
         _flash_dkv_kernel,
         scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
     )
+    # GQA note: the kernel computes PER-QUERY-HEAD dK/dV ([B*H, Tkv, D])
+    # — each query head reads its group's KV row but writes its own
+    # gradient row, keeping grid dim 0 parallel (no cross-head output
+    # revisiting); the group-sum down to H_kv heads happens outside.
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, Tkv // block_kv, Tq // block_q),
@@ -522,8 +570,8 @@ def _flash_backward(
             _smem_scalar_spec(pl, pltpu),
             _smem_scalar_spec(pl, pltpu),
             qspec(lambda b, j, i: (b, i, 0)),
-            kvspec(lambda b, j, i: (b, j, 0)),
-            kvspec(lambda b, j, i: (b, j, 0)),
+            kvspec(lambda b, j, i: (kv_row(b), j, 0)),
+            kvspec(lambda b, j, i: (kv_row(b), j, 0)),
             qspec(lambda b, j, i: (b, i, 0)),
             rowspec(lambda b, j, i: (b, i)),
             rowspec(lambda b, j, i: (b, i)),
@@ -557,8 +605,8 @@ def _flash_backward(
             _smem_scalar_spec(pl, pltpu),
             _smem_scalar_spec(pl, pltpu),
             qspec(lambda b, i, j: (b, i, 0)),
-            kvspec(lambda b, i, j: (b, j, 0)),
-            kvspec(lambda b, i, j: (b, j, 0)),
+            kvspec(lambda b, i, j: (kv_row(b), j, 0)),
+            kvspec(lambda b, i, j: (kv_row(b), j, 0)),
             qspec(lambda b, i, j: (b, i, 0)),
             rowspec(lambda b, i, j: (b, i)),
             rowspec(lambda b, i, j: (b, i)),
@@ -572,8 +620,23 @@ def _flash_backward(
         interpret=interpret,
     )(qoff, kvoff, qh, kh, vh, doh, lse, delta)
 
-    unflat = lambda x, T: jnp.swapaxes(x.reshape(B, H, T, D), 1, 2)
-    return unflat(dq, Tq), unflat(dk, Tkv), unflat(dv, Tkv)
+    unflat = lambda x, nh, T: jnp.swapaxes(
+        x.reshape(B, nh, T, D), 1, 2
+    )
+    if grp > 1:
+        # Group-sum per-query-head KV grads down to the H_kv heads (in
+        # f32: g bf16 addends lose bits exactly where GQA makes KV grads
+        # g-way hotter).
+        gsum = lambda x: x.astype(jnp.float32).reshape(
+            B, Hkv, grp, Tkv, D
+        ).sum(2).reshape(B * Hkv, Tkv, D)
+        dk = gsum(dk).astype(k.dtype)
+        dv = gsum(dv).astype(v.dtype)
+    return (
+        unflat(dq, H, Tq),
+        unflat(dk, Hkv, Tkv),
+        unflat(dv, Hkv, Tkv),
+    )
 
 
 @functools.partial(

@@ -188,6 +188,11 @@ def ring_attention(
         raise ValueError(
             f"seq len {q.shape[1]} not divisible by seq axis {n}"
         )
+    if q.shape[2] != k.shape[2]:
+        raise ValueError(
+            "ring attention requires matching q/kv head counts; expand "
+            "GQA KV heads before sharding the sequence"
+        )
     if impl == "auto":
         impl = (
             "flash"
@@ -268,6 +273,11 @@ def ulysses_attention(
     if q.shape[2] % n:
         raise ValueError(
             f"num heads {q.shape[2]} not divisible by seq axis {n}"
+        )
+    if q.shape[2] != k.shape[2]:
+        raise ValueError(
+            "ulysses attention requires matching q/kv head counts (the "
+            "all_to_all scatters the head axis); expand GQA KV first"
         )
     spec = P(data_axis, seq_axis, None, None)
     fn = jax.shard_map(

@@ -50,11 +50,12 @@ for cconf in ptb_small transformer_lm; do
                 [ -f "$f" ] && mv "$f" "${f%.$ext}_tpu.$ext"
             done
         done
-        # The generator overwrote the committed CPU artifacts in place;
-        # the mv renamed the TPU versions — restore the CPU originals.
-        git checkout -- "experiments/convergence_${cconf}.json" \
-            "experiments/CONVERGENCE_${cconf}.md" 2>/dev/null
     fi
+    # Restore the committed CPU artifacts unconditionally: a mid-write
+    # failure (rc != 0 after the generator already overwrote the .json)
+    # must not leave TPU numbers under the CPU artifact's filename.
+    git checkout -- "experiments/convergence_${cconf}.json" \
+        "experiments/CONVERGENCE_${cconf}.md" 2>/dev/null
 done
 
 # 4. Conv ladder, smallest first; stops at first wedge and records it.

@@ -146,6 +146,38 @@ def test_generate_temperature_sampling_runs(small_lm):
     assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 50).all()
 
 
+def test_gqa_decode_matches_full_forward():
+    """GQA model (2 KV heads under 4 query heads): cached decode logits
+    == full forward, and the cache is actually the smaller shape."""
+    model = get_model(
+        "transformer_lm",
+        vocab_size=50,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        d_model=32,
+        d_ff=64,
+        max_len=16,
+        dropout_rate=0.0,
+        dtype=jnp.float32,
+        attn_impl="reference",
+    )
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    rng = np.random.RandomState(7)
+    tokens = jnp.asarray(rng.randint(0, 50, (2, 8)), jnp.int32)
+    full_logits, _ = model.apply({"params": params}, tokens, train=False)
+
+    decode_model = model.clone(decode=True)
+    (lg, _), mut = decode_model.apply(
+        {"params": params}, tokens, train=False, mutable=["cache"]
+    )
+    np.testing.assert_allclose(lg, full_logits, rtol=1e-4, atol=1e-4)
+    ck = mut["cache"]["blocks_0"]["attn"]["cached_key"]
+    assert ck.shape == (2, 16, 2, 8), ck.shape  # Hkv=2, Dh=32/4
+
+
 def test_cli_train_then_generate(tmp_path):
     """The user surface: train a transformer_lm checkpoint via the CLI,
     then sample from it with the generate subcommand."""
