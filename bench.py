@@ -571,7 +571,10 @@ def run_flash_check(args):
 
         def many(q, k, v):
             def body(c, _):
-                out = attn_fn(q + c * 1e-30, k, v)
+                # Cast back: bf16 q + f32 carry promotes to f32, which
+                # would silently time the f32 MXU path.
+                qc = (q + c * 1e-30).astype(q.dtype)
+                out = attn_fn(qc, k, v)
                 return jnp.sum(out).astype(jnp.float32), None
 
             c, _ = jax.lax.scan(
@@ -593,6 +596,43 @@ def run_flash_check(args):
     b_out, b_dt = timed(
         lambda q, k, v: attnlib.blockwise_attention(q, k, v, causal=True)
     )
+
+    # Backward pass: FlashAttention-2 Pallas kernel pair vs XLA blockwise
+    # recompute-autodiff, timed as grad-of-scalar-loss (fwd+bwd total).
+    def grad_timed(attn_fn):
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(
+                attn_fn(q, k, v).astype(jnp.float32) ** 2
+            ),
+            argnums=(0, 1, 2),
+        )
+
+        def many(q, k, v):
+            def body(c, _):
+                qc = (q + c * 1e-30).astype(q.dtype)
+                dq, dk, dv = g(qc, k, v)
+                # Consume ALL grads or XLA dead-code-eliminates the
+                # dK/dV kernels and the timing is fwd+dQ only.
+                total = (
+                    jnp.sum(dq) + jnp.sum(dk) + jnp.sum(dv)
+                )
+                return total.astype(jnp.float32), None
+
+            c, _ = jax.lax.scan(body, jnp.float32(0), None, length=ITERS)
+            return c
+
+        fn = jax.jit(many)
+        float(fn(q, k, v))  # compile + warm
+        t0 = time.perf_counter()
+        float(fn(q, k, v))
+        return (time.perf_counter() - t0) / ITERS
+
+    f_grad_dt = grad_timed(
+        lambda q, k, v: attnlib.flash_attention(q, k, v, True)
+    )
+    b_grad_dt = grad_timed(
+        lambda q, k, v: attnlib.blockwise_attention(q, k, v, causal=True)
+    )
     jax.block_until_ready((f_out, b_out))
     # Numerics gate in f32: the bf16 impls must land within bf16 round-off
     # of the exact O(T^2) answer.
@@ -610,6 +650,9 @@ def run_flash_check(args):
         "dtype": "bfloat16",
         "flash_ms": round(f_dt * 1e3, 3),
         "blockwise_ms": round(b_dt * 1e3, 3),
+        "flash_grad_ms": round(f_grad_dt * 1e3, 3),
+        "blockwise_grad_ms": round(b_grad_dt * 1e3, 3),
+        "grad_speedup_vs_blockwise": round(b_grad_dt / f_grad_dt, 3),
         "flash_tflops": round(flash_flops / f_dt / 1e12, 2),
         "max_err_flash_vs_reference": float(
             jnp.max(jnp.abs(f_out.astype(jnp.float32) - ref))

@@ -129,6 +129,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     p_gen.add_argument("--max-new-tokens", type=int, default=64)
     p_gen.add_argument("--temperature", type=float, default=0.0)
+    p_gen.add_argument("--top-k", type=int, default=0)
+    p_gen.add_argument("--top-p", type=float, default=1.0)
     # Default None so _overrides doesn't clobber cfg.seed; the sampling
     # key falls back to 0 below.
     p_gen.add_argument("--seed", type=int, default=None)
@@ -234,6 +236,8 @@ def main(argv: list[str] | None = None) -> int:
             prompt,
             args.max_new_tokens,
             temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
             rng=jax.random.key(args.seed or 0),
             eos_id=args.eos_id,
         )

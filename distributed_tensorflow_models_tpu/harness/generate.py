@@ -10,7 +10,9 @@ the TPU-idiomatic shape — ONE compiled program for the whole generation
 Flow: the prompt runs through the model once in decode mode (filling every
 block's KV cache and the position counter), then a scan generates
 ``max_new_tokens`` tokens, threading the cache collection as carry.
-Greedy when ``temperature == 0``; categorical sampling otherwise.
+Greedy when ``temperature == 0``; categorical sampling otherwise, with
+optional top-k and nucleus (top-p) filtering — both static-shaped
+(sort + mask) so the scan stays one compiled program.
 """
 
 from __future__ import annotations
@@ -21,6 +23,31 @@ import jax
 import jax.numpy as jnp
 
 
+def _filter_logits(logits, top_k: int, top_p: float):
+    """Standard nucleus/top-k filtering, static-shaped (sort + mask, no
+    dynamic slicing — TPU-friendly inside the scan body)."""
+    if top_k > 0:
+        # top_k >= vocab is a no-op (clamp, the standard convention).
+        top_k = min(top_k, logits.shape[-1])
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative mass >= top_p; the
+        # top token is kept unconditionally so top_p <= 0 degrades to
+        # greedy rather than masking the whole row to -inf (categorical
+        # over all--inf silently returns index 0).
+        keep = cum - probs < top_p
+        keep = keep.at[..., 0].set(True)
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def generate(
     model,
     params,
@@ -28,6 +55,8 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
 ):
@@ -71,9 +100,12 @@ def generate(
 
     def sample(logits_last, key):
         if temperature > 0:
-            return jax.random.categorical(
-                key, logits_last / temperature, axis=-1
-            ).astype(prompt.dtype)
+            filtered = _filter_logits(
+                logits_last / temperature, top_k, top_p
+            )
+            return jax.random.categorical(key, filtered, axis=-1).astype(
+                prompt.dtype
+            )
         return jnp.argmax(logits_last, axis=-1).astype(prompt.dtype)
 
     keys = jax.random.split(rng, max_new_tokens)  # one per new token

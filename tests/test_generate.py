@@ -146,6 +146,60 @@ def test_generate_temperature_sampling_runs(small_lm):
     assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 50).all()
 
 
+def test_filter_logits_top_k():
+    from distributed_tensorflow_models_tpu.harness.generate import (
+        _filter_logits,
+    )
+
+    logits = jnp.asarray([[1.0, 3.0, 2.0, 0.5]])
+    out = np.asarray(_filter_logits(logits, top_k=2, top_p=1.0))
+    assert np.isfinite(out[0, [1, 2]]).all()
+    assert np.isinf(out[0, [0, 3]]).all() and (out[0, [0, 3]] < 0).all()
+
+
+def test_filter_logits_top_p():
+    from distributed_tensorflow_models_tpu.harness.generate import (
+        _filter_logits,
+    )
+
+    # probs ~ [0.643, 0.236, 0.087, 0.032]: top_p=0.6 keeps only the top
+    # token (first-prefix >= p rule); top_p=0.7 keeps the top two.
+    logits = jnp.log(jnp.asarray([[0.643, 0.236, 0.087, 0.032]]))
+    out6 = np.asarray(_filter_logits(logits, 0, 0.6))
+    assert np.isfinite(out6[0, 0]) and np.isinf(out6[0, 1:]).all()
+    out7 = np.asarray(_filter_logits(logits, 0, 0.7))
+    assert np.isfinite(out7[0, :2]).all() and np.isinf(out7[0, 2:]).all()
+
+
+def test_filter_logits_degenerate_knobs():
+    from distributed_tensorflow_models_tpu.harness.generate import (
+        _filter_logits,
+    )
+
+    logits = jnp.asarray([[1.0, 3.0, 2.0, 0.5]])
+    # top_k beyond vocab: no-op.
+    np.testing.assert_array_equal(
+        np.asarray(_filter_logits(logits, top_k=100, top_p=1.0)),
+        np.asarray(logits),
+    )
+    # top_p=0: keeps exactly the argmax (greedy), not an all--inf row.
+    out = np.asarray(_filter_logits(logits, 0, 0.0))
+    assert np.isfinite(out[0, 1])
+    assert np.isinf(out[0, [0, 2, 3]]).all()
+
+
+def test_generate_top_k_one_equals_greedy(small_lm):
+    """temperature>0 with top_k=1 must reduce to greedy argmax."""
+    model, params = small_lm
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    greedy = generate(model, params, prompt, 5)
+    sampled = generate(
+        model, params, prompt, 5,
+        temperature=1.0, top_k=1, rng=jax.random.key(9),
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+
 def test_gqa_decode_matches_full_forward():
     """GQA model (2 KV heads under 4 query heads): cached decode logits
     == full forward, and the cache is actually the smaller shape."""
