@@ -24,15 +24,26 @@ import jax.numpy as jnp
 
 
 def _filter_logits(logits, top_k: int, top_p: float):
-    """Standard nucleus/top-k filtering, static-shaped (sort + mask, no
-    dynamic slicing — TPU-friendly inside the scan body)."""
+    """Standard nucleus/top-k filtering, static-shaped (ONE descending
+    sort serves both filters — a vocab-size sort per generated token is
+    the dominant cost of this function inside the scan body)."""
+    if top_k <= 0 and top_p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     if top_k > 0:
         # top_k >= vocab is a no-op (clamp, the standard convention).
-        top_k = min(top_k, logits.shape[-1])
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        kth = sorted_logits[
+            ..., min(top_k, logits.shape[-1]) - 1
+        ][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+        # Mirror the mask into the sorted view so the nucleus pass below
+        # computes its cumulative mass over the top-k-filtered
+        # distribution (matching the sequential semantics of applying
+        # top-k then top-p).
+        sorted_logits = jnp.where(
+            sorted_logits < kth, -jnp.inf, sorted_logits
+        )
     if top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # Keep the smallest prefix with cumulative mass >= top_p; the

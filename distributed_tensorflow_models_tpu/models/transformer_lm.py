@@ -54,6 +54,8 @@ class SelfAttention(nn.Module):
     # carry num_kv_heads < num_heads heads; 0 = standard MHA.  The
     # attention impls infer the grouping from the shapes (ops/attention).
     num_kv_heads: int = 0
+    # Sliding-window (local) attention span; None = full causal.
+    attn_window: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -91,12 +93,16 @@ class SelfAttention(nn.Module):
             # also hides the cache's not-yet-written tail: unwritten slots
             # are all at positions > the last query row.
             out = attnlib.reference_attention(
-                q, ck.value, cv.value, causal=True, q_offset=idx
+                q, ck.value, cv.value, causal=True, q_offset=idx,
+                window=self.attn_window,
             )
         elif self.attention_fn is not None:
             out = self.attention_fn(q, k, v, causal=True)
         else:
-            out = attnlib.attention(q, k, v, causal=True, impl=self.attn_impl)
+            out = attnlib.attention(
+                q, k, v, causal=True, impl=self.attn_impl,
+                window=self.attn_window,
+            )
         out = out.reshape(B, T, self.d_model)
         out = nn.Dense(self.d_model, dtype=self.dtype, name="out")(out)
         if self.dropout_rate:
@@ -202,6 +208,7 @@ class Block(nn.Module):
     decode: bool = False
     max_len: int = 0
     num_kv_heads: int = 0
+    attn_window: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -216,6 +223,7 @@ class Block(nn.Module):
             decode=self.decode,
             max_len=self.max_len,
             num_kv_heads=self.num_kv_heads,
+            attn_window=self.attn_window,
             name="attn",
         )(h, train=train)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -451,6 +459,9 @@ class TransformerLM(nn.Module):
     # Grouped-query attention (0 = MHA); shrinks KV projections and the
     # decode cache by num_heads/num_kv_heads.
     num_kv_heads: int = 0
+    # Sliding-window (local) attention span; None = full causal.  Applies
+    # to the dense non-pipelined stack (and decode).
+    attn_window: Any = None
 
     @nn.compact
     def __call__(self, tokens, carry=None, train: bool = False):
@@ -488,6 +499,12 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 "decode mode supports the dense non-pipelined stack "
                 "without a sequence-parallel attention_fn"
+            )
+        if self.attn_window is not None and self.attention_fn is not None:
+            raise ValueError(
+                "attn_window is not threaded through the sequence-"
+                "parallel attention_fn path — training would use full "
+                "causal attention while decode applies the window"
             )
         if self.pipelined or self.pipe_mesh is not None:
             if self.num_experts or self.remat or self.num_kv_heads:
@@ -530,6 +547,7 @@ class TransformerLM(nn.Module):
                     decode=self.decode,
                     max_len=self.max_len,
                     num_kv_heads=self.num_kv_heads,
+                    attn_window=self.attn_window,
                     name=f"blocks_{i}",
                 )(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)

@@ -40,6 +40,16 @@ def _scale(q, scale: Optional[float]) -> float:
     return scale if scale is not None else q.shape[-1] ** -0.5
 
 
+def _check_window(window: Optional[int]) -> Optional[int]:
+    """A window must cover at least the query itself.  window <= 0 would
+    mask every position — and because NEG_INF is finite, softmax over an
+    all-masked row silently returns UNIFORM attention (garbage that looks
+    plausible), so reject instead of letting impls disagree."""
+    if window is not None and window < 1:
+        raise ValueError(f"attention window must be >= 1, got {window}")
+    return window
+
+
 def _group_size(q, k) -> int:
     """Grouped-query attention is shape-inferred: q ``[B,T,H,D]`` against
     k/v ``[B,T,H_kv,D]`` with ``H % H_kv == 0`` means each group of
@@ -83,6 +93,7 @@ def reference_attention(
     scale: Optional[float] = None,
     q_offset: int | jax.Array = 0,
     kv_offset: int | jax.Array = 0,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Materialized-scores attention. BTHD in, BTHD out.
 
@@ -91,14 +102,20 @@ def reference_attention(
     a longer sequence (the ring-attention case).
     """
     s = _scale(q, scale)
+    window = _check_window(window)
     k, v = _expand_kv(q, k, v)
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * s
-    if causal:
+    if causal or window is not None:
         qi = q_offset + jnp.arange(q.shape[1])[:, None]
         kj = kv_offset + jnp.arange(k.shape[1])[None, :]
-        logits = jnp.where(qi >= kj, logits, NEG_INF)
+        valid = qi >= kj if causal else qi == qi
+        if window is not None:
+            # Sliding window: each query sees the last `window` positions
+            # (inclusive of itself) — Mistral-style local attention.
+            valid = valid & (qi - kj < window)
+        logits = jnp.where(valid, logits, NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum(
         "bhqk,bkhd->bqhd", p.astype(v.dtype), v
@@ -135,6 +152,7 @@ def blockwise_attention(
     block_kv: int = 512,
     q_offset: int | jax.Array = 0,
     kv_offset: int | jax.Array = 0,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Memory-efficient attention: scan over KV blocks, BTHD in/out.
 
@@ -145,6 +163,7 @@ def blockwise_attention(
     that don't divide ``block_kv`` are padded and masked.
     """
     B, Tq, H, D = q.shape
+    window = _check_window(window)
     k, v = _expand_kv(q, k, v)
     Tkv = k.shape[1]
     block_kv = min(block_kv, Tkv)
@@ -178,7 +197,9 @@ def blockwise_attention(
         valid = lk < Tkv
         if causal:
             valid = valid & (qi >= kv_offset + lk)
-        if causal or pad:
+        if window is not None:
+            valid = valid & (qi - (kv_offset + lk) < window)
+        if causal or pad or window is not None:
             s_block = jnp.where(valid, s_block, NEG_INF)
         return _block_update(carry, s_block, v_j), None
 
@@ -198,7 +219,8 @@ def blockwise_attention(
 
 
 def _masked_scores(
-    qb, kb, i, j, q_base, kv_base, *, scale, causal, block_q, block_kv
+    qb, kb, i, j, q_base, kv_base, *, scale, causal, block_q, block_kv,
+    window=None,
 ):
     """Shared score block for all three Pallas kernels: S = (Q_i K_j^T) *
     scale in the INPUT dtype with f32 accumulation (upcasting q/k to f32
@@ -211,14 +233,17 @@ def _masked_scores(
         qb, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale  # [bq, bkv] f32
-    if causal:
+    if causal or window is not None:
         qi = q_base + i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 0
         )
         kj = kv_base + j * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1
         )
-        s = jnp.where(qi >= kj, s, NEG_INF)
+        valid = qi >= kj if causal else qi == qi
+        if window is not None:
+            valid = valid & (qi - kj < window)
+        s = jnp.where(valid, s, NEG_INF)
     return s
 
 
@@ -226,6 +251,7 @@ def _flash_kernel(
     qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
     *, scale: float, causal: bool, block_q: int, block_kv: int,
+    window=None,
 ):
     """Grid = (B*H, Tq/block_q, Tkv/block_kv); KV innermost, softmax state
     carried across KV steps in VMEM scratch, output written on the last.
@@ -255,13 +281,20 @@ def _flash_kernel(
             kv_base + j * block_kv
             <= q_base + i * block_q + block_q - 1
         )
+    if window is not None:
+        # Whole KV block older than every query's window -> skip.
+        should_run = should_run & (
+            q_base + i * block_q
+            - (kv_base + (j + 1) * block_kv - 1)
+            < window
+        )
 
     @pl.when(should_run)
     def _compute():
         s = _masked_scores(
             q_ref[0], k_ref[0], i, j, q_base, kv_base,
             scale=scale, causal=causal,
-            block_q=block_q, block_kv=block_kv,
+            block_q=block_q, block_kv=block_kv, window=window,
         )
         m_prev, l_prev, acc_prev = m_scr[:], l_scr[:], acc_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -316,8 +349,9 @@ def _smem_scalar_spec(pl, pltpu):
 
 def _flash_forward(
     q, k, v, *, causal, scale, block_q, block_kv, interpret,
-    return_lse=False, q_offset=0, kv_offset=0,
+    return_lse=False, q_offset=0, kv_offset=0, window=None,
 ):
+    window = _check_window(window)
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -336,6 +370,7 @@ def _flash_forward(
     kernel = functools.partial(
         _flash_kernel,
         scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
+        window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -393,7 +428,7 @@ def _flash_forward(
 
 def _p_and_ds(
     qb, kb, vb, dob, lse_row, delta_row, i, j, q_base, kv_base,
-    *, scale, causal, block_q, block_kv,
+    *, scale, causal, block_q, block_kv, window=None,
 ):
     """Shared backward recurrence for both gradient kernels:
     P_ij = exp(S_ij - LSE_i), dS_ij = P_ij ∘ (dO_i V_j^T - delta_i).
@@ -403,6 +438,7 @@ def _p_and_ds(
     s = _masked_scores(
         qb, kb, i, j, q_base, kv_base,
         scale=scale, causal=causal, block_q=block_q, block_kv=block_kv,
+        window=window,
     )
     p = jnp.exp(s - lse_row[:, None])  # [bq, bkv] f32
     dp = jax.lax.dot_general(
@@ -417,6 +453,7 @@ def _flash_dkv_kernel(
     qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_scr, dv_scr,
     *, scale: float, causal: bool, block_q: int, block_kv: int,
+    window=None,
 ):
     """dK/dV kernel: grid = (B*H, Tkv/block_kv, Tq/block_q), Q innermost;
     dK_j / dV_j accumulate in VMEM scratch across the Q sweep.
@@ -445,6 +482,12 @@ def _flash_dkv_kernel(
         should_run = (
             q_base + i * block_q + block_q - 1 >= kv_base + j * block_kv
         )
+    if window is not None:
+        should_run = should_run & (
+            q_base + i * block_q
+            - (kv_base + (j + 1) * block_kv - 1)
+            < window
+        )
 
     @pl.when(should_run)
     def _compute():
@@ -453,7 +496,7 @@ def _flash_dkv_kernel(
             qb, kb, vb, dob, lse_ref[0, :], delta_ref[0, :], i, j,
             q_base, kv_base,
             scale=scale, causal=causal,
-            block_q=block_q, block_kv=block_kv,
+            block_q=block_q, block_kv=block_kv, window=window,
         )
         dv_scr[:] += jax.lax.dot_general(
             p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
@@ -474,6 +517,7 @@ def _flash_dq_kernel(
     qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref, dq_scr,
     *, scale: float, causal: bool, block_q: int, block_kv: int,
+    window=None,
 ):
     """dQ kernel: grid = (B*H, Tq/block_q, Tkv/block_kv), KV innermost;
     dQ_i accumulates in VMEM scratch across the KV sweep:
@@ -495,6 +539,12 @@ def _flash_dq_kernel(
             kv_base + j * block_kv
             <= q_base + i * block_q + block_q - 1
         )
+    if window is not None:
+        should_run = should_run & (
+            q_base + i * block_q
+            - (kv_base + (j + 1) * block_kv - 1)
+            < window
+        )
 
     @pl.when(should_run)
     def _compute():
@@ -503,7 +553,7 @@ def _flash_dq_kernel(
             qb, kb, vb, dob, lse_ref[0, :], delta_ref[0, :], i, j,
             q_base, kv_base,
             scale=scale, causal=causal,
-            block_q=block_q, block_kv=block_kv,
+            block_q=block_q, block_kv=block_kv, window=window,
         )
         dq_scr[:] += scale * jax.lax.dot_general(
             ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
@@ -517,7 +567,7 @@ def _flash_dq_kernel(
 
 def _flash_backward(
     q, k, v, out, lse, g, *, causal, scale, block_q, block_kv, interpret,
-    q_offset=0, kv_offset=0, g_lse=None,
+    q_offset=0, kv_offset=0, g_lse=None, window=None,
 ):
     """``lse`` here is the kernel-internal [B*H, Tq] layout.  ``g_lse``
     (same layout, optional) is the LSE cotangent from callers that
@@ -558,6 +608,7 @@ def _flash_backward(
     dkv_kernel = functools.partial(
         _flash_dkv_kernel,
         scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
+        window=window,
     )
     # GQA note: the kernel computes PER-QUERY-HEAD dK/dV ([B*H, Tkv, D])
     # — each query head reads its group's KV row but writes its own
@@ -597,6 +648,7 @@ def _flash_backward(
     dq_kernel = functools.partial(
         _flash_dq_kernel,
         scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
+        window=window,
     )
     dq = pl.pallas_call(
         dq_kernel,
@@ -640,7 +692,7 @@ def _flash_backward(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
 )
 def flash_attention(
     q: jax.Array,
@@ -651,6 +703,7 @@ def flash_attention(
     block_q: int = 128,
     block_kv: int = 128,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Pallas TPU flash attention, BTHD in/out.
 
@@ -663,6 +716,7 @@ def flash_attention(
     return _flash_forward(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
+        window=window,
     )
 
 
@@ -672,20 +726,25 @@ def _lse_rows(lse):
     return jnp.swapaxes(lse, 1, 2).reshape(B * H, T)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+def _flash_fwd(
+    q, k, v, causal, scale, block_q, block_kv, interpret, window
+):
     out, lse = _flash_forward(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
-        return_lse=True,
+        return_lse=True, window=window,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
+def _flash_bwd(
+    causal, scale, block_q, block_kv, interpret, window, res, g
+):
     q, k, v, out, lse = res
     return _flash_backward(
         q, k, v, out, _lse_rows(lse), g, causal=causal, scale=scale,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
+        window=window,
     )
 
 
@@ -761,6 +820,7 @@ def attention(
     causal: bool = False,
     scale: Optional[float] = None,
     impl: str = "auto",
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Dispatching entry point: ``impl`` in {auto, reference, blockwise,
     flash}.  ``auto`` = flash kernel on TPU (when seq lens are
@@ -773,9 +833,14 @@ def attention(
             else "blockwise"
         )
     if impl == "reference":
-        return reference_attention(q, k, v, causal=causal, scale=scale)
+        return reference_attention(
+            q, k, v, causal=causal, scale=scale, window=window
+        )
     if impl == "blockwise":
-        return blockwise_attention(q, k, v, causal=causal, scale=scale)
+        return blockwise_attention(
+            q, k, v, causal=causal, scale=scale, window=window
+        )
     if impl == "flash":
-        return flash_attention(q, k, v, causal, scale)
+        # Positional: custom_vjp + nondiff_argnums is positional-indexed.
+        return flash_attention(q, k, v, causal, scale, 128, 128, False, window)
     raise ValueError(f"unknown attention impl {impl!r}")

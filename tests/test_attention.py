@@ -198,6 +198,73 @@ def test_flash_chunk_lse_grads():
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
 
 
+# ------------------------------------------------------------- sliding window
+
+
+@pytest.mark.parametrize("window", [1, 32, 100])
+def test_window_reference_oracle(window):
+    """Sliding-window masking against a hand-built mask."""
+    q, k, v = _qkv(T=64, D=16)
+    out = attnlib.reference_attention(q, k, v, causal=True, window=window)
+    qi = np.arange(64)[:, None]
+    kj = np.arange(64)[None, :]
+    mask = (qi >= kj) & (qi - kj < window)
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) * (16**-0.5)
+    logits = np.where(mask, logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [16, 96])
+def test_window_blockwise_and_flash_match_reference(window):
+    """Window through the streaming impls (incl. the flash block-skip:
+    window=16 < block 64 skips whole blocks; 96 crosses blocks)."""
+    q, k, v = _qkv(T=256, D=32)
+    ref = attnlib.reference_attention(q, k, v, causal=True, window=window)
+    bw = attnlib.blockwise_attention(
+        q, k, v, causal=True, block_kv=64, window=window
+    )
+    fl = attnlib.flash_attention(
+        q, k, v, True, None, 64, 64, True, window
+    )
+    np.testing.assert_allclose(bw, ref, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(fl, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_window_rejects_nonpositive():
+    q, k, v = _qkv(T=64, D=16)
+    for w in (0, -3):
+        with pytest.raises(ValueError):
+            attnlib.reference_attention(q, k, v, causal=True, window=w)
+        with pytest.raises(ValueError):
+            attnlib.blockwise_attention(q, k, v, causal=True, window=w)
+
+
+def test_window_flash_grads_match_reference():
+    q, k, v = _qkv(B=1, T=256, H=2, D=32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            attnlib.reference_attention(
+                q, k, v, causal=True, window=80
+            )
+            ** 2
+        )
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            attnlib.flash_attention(q, k, v, True, None, 64, 64, True, 80)
+            ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
 # ----------------------------------------------------------------- GQA
 
 
