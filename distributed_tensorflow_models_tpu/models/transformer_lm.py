@@ -56,9 +56,15 @@ class SelfAttention(nn.Module):
     num_kv_heads: int = 0
     # Sliding-window (local) attention span; None = full causal.
     attn_window: Any = None
+    # Rotary position embeddings: q/k rotate by global position before
+    # attention (ops/rotary.py); keys are cached post-rotation in decode.
+    use_rope: bool = False
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        from distributed_tensorflow_models_tpu.ops import rotary
+
         B, T, _ = x.shape
         H = self.num_heads
         Hkv = self.num_kv_heads or H
@@ -69,6 +75,10 @@ class SelfAttention(nn.Module):
         q = dense("query", self.d_model)(x).reshape(B, T, H, Dh)
         k = dense("key", Hkv * Dh)(x).reshape(B, T, Hkv, Dh)
         v = dense("value", Hkv * Dh)(x).reshape(B, T, Hkv, Dh)
+        if self.use_rope and not self.decode:
+            pos = jnp.arange(T)
+            q = rotary.apply_rope(q, pos, self.rope_theta)
+            k = rotary.apply_rope(k, pos, self.rope_theta)
         if self.decode:
             ck = self.variable(
                 "cache", "cached_key",
@@ -82,6 +92,10 @@ class SelfAttention(nn.Module):
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
             idx = ci.value
+            if self.use_rope:
+                pos = idx + jnp.arange(T)
+                q = rotary.apply_rope(q, pos, self.rope_theta)
+                k = rotary.apply_rope(k, pos, self.rope_theta)
             ck.value = jax.lax.dynamic_update_slice(
                 ck.value, k, (0, idx, 0, 0)
             )
@@ -209,6 +223,8 @@ class Block(nn.Module):
     max_len: int = 0
     num_kv_heads: int = 0
     attn_window: Any = None
+    use_rope: bool = False
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -224,6 +240,8 @@ class Block(nn.Module):
             max_len=self.max_len,
             num_kv_heads=self.num_kv_heads,
             attn_window=self.attn_window,
+            use_rope=self.use_rope,
+            rope_theta=self.rope_theta,
             name="attn",
         )(h, train=train)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
@@ -462,6 +480,10 @@ class TransformerLM(nn.Module):
     # Sliding-window (local) attention span; None = full causal.  Applies
     # to the dense non-pipelined stack (and decode).
     attn_window: Any = None
+    # Position encoding: "learned" absolute table (the default) or
+    # "rope" rotary relative positions applied inside attention.
+    pos_encoding: str = "learned"
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, tokens, carry=None, train: bool = False):
@@ -472,22 +494,39 @@ class TransformerLM(nn.Module):
             dtype=self.dtype,
             name="embedding",
         )(tokens)
-        pos = self.param(
-            "pos_embedding",
-            nn.initializers.normal(0.02),
-            (self.max_len, self.d_model),
-        )
-        if self.decode:
-            # Tokens sit at global positions pos_index..pos_index+T-1.
-            pi = self.variable(
-                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+        if self.pos_encoding == "rope":
+            # Relative positions enter inside attention (q/k rotation);
+            # no absolute table.  Decode still tracks pos_index: the
+            # attention blocks' cache_index carries the offset, but
+            # keeping this counter preserves one cache layout invariant
+            # across both encodings.
+            if self.decode:
+                pi = self.variable(
+                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                )
+                pi.value = pi.value + T
+        elif self.pos_encoding == "learned":
+            pos = self.param(
+                "pos_embedding",
+                nn.initializers.normal(0.02),
+                (self.max_len, self.d_model),
             )
-            x = x + jax.lax.dynamic_slice_in_dim(
-                pos, pi.value, T, 0
-            ).astype(self.dtype)
-            pi.value = pi.value + T
+            if self.decode:
+                # Tokens sit at global positions pos_index..pos_index+T-1.
+                pi = self.variable(
+                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                )
+                x = x + jax.lax.dynamic_slice_in_dim(
+                    pos, pi.value, T, 0
+                ).astype(self.dtype)
+                pi.value = pi.value + T
+            else:
+                x = x + pos[:T].astype(self.dtype)
         else:
-            x = x + pos[:T].astype(self.dtype)
+            raise ValueError(
+                f"unknown pos_encoding {self.pos_encoding!r} "
+                "(want 'learned' or 'rope')"
+            )
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         if self.decode and (
@@ -507,11 +546,19 @@ class TransformerLM(nn.Module):
                 "causal attention while decode applies the window"
             )
         if self.pipelined or self.pipe_mesh is not None:
-            if self.num_experts or self.remat or self.num_kv_heads:
+            if (
+                self.num_experts
+                or self.remat
+                or self.num_kv_heads
+                or self.attn_window is not None
+                or self.pos_encoding != "learned"
+            ):
                 raise ValueError(
-                    "pipelined path supports dense MHA FFN blocks with "
-                    "remat=False (remat the stage_fn instead); "
-                    "num_kv_heads is not plumbed into the stacked layout"
+                    "pipelined path supports dense MHA blocks with "
+                    "remat=False, full causal attention, and learned "
+                    "positions; num_kv_heads/attn_window/rope are not "
+                    "plumbed into the stacked layout — training would "
+                    "silently diverge from the non-pipelined model"
                 )
             x = PipelinedBlocks(
                 self.num_layers,
@@ -548,6 +595,8 @@ class TransformerLM(nn.Module):
                     max_len=self.max_len,
                     num_kv_heads=self.num_kv_heads,
                     attn_window=self.attn_window,
+                    use_rope=self.pos_encoding == "rope",
+                    rope_theta=self.rope_theta,
                     name=f"blocks_{i}",
                 )(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
