@@ -8,11 +8,16 @@ the final LSTM state of each segment into the next (SURVEY.md §7.4.5) — here
 the carry is an explicit input/output of ``__call__`` so the train loop can
 keep it in the (sharded) train state.
 
-TPU-first: the time unroll is ``nn.scan`` (compiled ``lax.scan``), not a
-Python loop — one compiled step regardless of ``num_steps``; each scan step
-is a batched matmul hitting the MXU.  The carry is batch-sharded along the
-``data`` mesh axis like any activation, which is exactly the "sharded scan
-state" design SURVEY.md §2.4 calls for.
+TPU-first, cuDNN-style decomposition: layers scan over time one at a time
+(mathematically identical to stepping the whole stack per timestep — layers
+only couple through the previous layer's full hidden sequence), which lets
+each layer's input-to-hidden projection for ALL timesteps run as ONE
+``[B·T, in] x [in, 4h]`` MXU matmul hoisted out of the scan.  The scan body
+is left with just the recurrent ``h @ W_hh [h, 4h]`` matmul + gate
+elementwise — half the sequential matmul count of the step-the-stack
+layout, and the hoisted half runs at full batch instead of batch-per-step.
+Gates are fused (i|f|g|o in one 4h projection); parameter count matches the
+per-gate layout exactly (8h² + 4h per layer, zero-init biases).
 """
 
 from __future__ import annotations
@@ -29,27 +34,43 @@ from distributed_tensorflow_models_tpu.models import register
 Carry = Sequence[tuple[jax.Array, jax.Array]]
 
 
-class _StackedCell(nn.Module):
-    """One time step through the layer stack, scanned over time."""
+def _blockwise_orthogonal(key, shape, dtype=jnp.float32):
+    """Orthogonal init per [h, h] gate block of a fused [h, 4h] recurrent
+    kernel — the distribution flax's per-gate cells give each recurrent
+    gate matrix."""
+    h, four_h = shape
+    n = four_h // h
+    orth = nn.initializers.orthogonal()
+    keys = jax.random.split(key, n)
+    return jnp.concatenate(
+        [orth(k, (h, h), dtype) for k in keys], axis=1
+    )
+
+
+class _RecurrentCore(nn.Module):
+    """The sequential part of one LSTM layer: consumes the precomputed
+    input-gate activations ``gx [B, 4h]`` for a single timestep."""
 
     hidden_size: int
-    num_layers: int
-    dropout_rate: float
-    train: bool
+    dtype: jnp.dtype
 
     @nn.compact
-    def __call__(self, carry, x):
-        new_carry = []
-        h = x
-        for i in range(self.num_layers):
-            cell = nn.OptimizedLSTMCell(self.hidden_size, name=f"lstm_{i}")
-            c_i, h = cell(tuple(carry[i]), h)
-            new_carry.append(c_i)
-            if self.dropout_rate:
-                h = nn.Dropout(
-                    self.dropout_rate, deterministic=not self.train
-                )(h)
-        return tuple(new_carry), h
+    def __call__(self, carry, gx):
+        c, h = carry
+        # No bias here: the hoisted ih projection already carries the one
+        # gate bias (total parameter count matches the per-gate layout).
+        # Per-gate ORTHOGONAL recurrent init, as flax's LSTM cells use —
+        # it is what keeps deep-in-time gradients stable; a plain fused
+        # lecun_normal would silently change training dynamics.
+        gates = gx + nn.Dense(
+            4 * self.hidden_size, dtype=self.dtype, use_bias=False,
+            kernel_init=_blockwise_orthogonal,
+            name="hh",
+        )(h)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (c, h), h
 
 
 class PTBLSTM(nn.Module):
@@ -82,24 +103,33 @@ class PTBLSTM(nn.Module):
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
 
-        scan = nn.scan(
-            _StackedCell,
-            variable_broadcast="params",
-            split_rngs={"params": False, "dropout": True},
-            in_axes=1,
-            out_axes=1,
-        )
-        carry, outputs = scan(
-            self.hidden_size,
-            self.num_layers,
-            self.dropout_rate,
-            train,
-            name="stack",
-        )(tuple(tuple(c) for c in carry), x)
+        new_carry = []
+        for layer in range(self.num_layers):
+            # Hoisted: input projections for every timestep in one
+            # matmul (bias lives here so the scan body adds none).
+            gx = nn.Dense(
+                4 * self.hidden_size, dtype=self.dtype,
+                name=f"lstm_{layer}_ih",
+            )(x)  # [B, T, 4h]
+            core = nn.scan(
+                _RecurrentCore,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                in_axes=1,
+                out_axes=1,
+            )(self.hidden_size, self.dtype, name=f"lstm_{layer}")
+            c_out, x = core(tuple(carry[layer]), gx)
+            new_carry.append(c_out)
+            # Inter-layer (and pre-head) dropout, as the reference
+            # applies it to each layer's output sequence.
+            if self.dropout_rate:
+                x = nn.Dropout(
+                    self.dropout_rate, deterministic=not train
+                )(x)
         logits = nn.Dense(
             self.vocab_size, dtype=jnp.float32, name="head"
-        )(outputs)
-        return logits, carry
+        )(x)
+        return logits, tuple(new_carry)
 
 
 # The three classic Zaremba configs the reference exposes (SURVEY.md §2.1 R8).

@@ -46,12 +46,16 @@ def transformer_tp_rules() -> list[ShardingRule]:
 
 
 def lstm_tp_rules() -> list[ShardingRule]:
-    """Rules for the PTB LSTM: gate matmuls are 4x-wide column splits
-    (the hidden dim concatenation of i/f/g/o gates), so output-dim sharding
-    over ``model`` splits every gate evenly."""
+    """Rules for the PTB LSTM (fused-gate layout, models/ptb_lstm.py):
+    the hoisted input projection ``lstm_<i>_ih`` and the recurrent
+    ``lstm_<i>/hh`` are ``[in, 4h]`` fused-gate matmuls — output-dim
+    sharding over ``model`` column-splits them (GSPMD reshards around the
+    gate split/elementwise as needed)."""
     M = AxisNames.MODEL
     return [
-        (r"lstm_\d+/(hi|hf|hg|ho|ii|if|ig|io)/kernel$", P(None, M)),
+        (r"lstm_\d+_ih/kernel$", P(None, M)),
+        (r"lstm_\d+_ih/bias$", P(M)),
+        (r"lstm_\d+/hh/kernel$", P(None, M)),
         (r"embedding/embedding$", P(None, M)),
         (r"head/kernel$", P(None, M)),
         (r"head/bias$", P(M)),
