@@ -311,6 +311,22 @@ _add(
     )
 )
 
+# Modern decoder recipe: rotary positions, grouped-query KV (2 of 8
+# heads), sliding-window local attention — the serving-lean variant
+# (4x smaller KV cache, O(window) attention); tensor-parallel rules
+# stay applicable (query/out/mlp shapes unchanged).
+_add(
+    _CONFIGS["transformer_lm"].replace(
+        name="transformer_lm_modern",
+        model_kwargs={
+            **_CONFIGS["transformer_lm"].model_kwargs,
+            "pos_encoding": "rope",
+            "num_kv_heads": 2,
+            "attn_window": 256,
+        },
+    )
+)
+
 
 def get_config(name: str, **overrides) -> ExperimentConfig:
     if name not in _CONFIGS:

@@ -168,6 +168,36 @@ class TestPTBLSTM:
         assert set(PTB_CONFIGS) == {"small", "medium", "large"}
         assert PTB_CONFIGS["medium"]["hidden_size"] == 650
 
+    def test_lstm_tp_rules_cover_params(self):
+        """Every lstm_tp rule must match at least one parameter path —
+        this file's fused-gate rename is exactly the kind of change that
+        silently voids a rule set (the old per-gate regex matched
+        nothing after it)."""
+        import re
+
+        from distributed_tensorflow_models_tpu.core.sharding import (
+            _path_str,
+        )
+        from distributed_tensorflow_models_tpu.parallel import (
+            tensor as tensorlib,
+        )
+
+        model = get_model("ptb_lstm", config="small")
+        variables = jax.eval_shape(
+            lambda rng: model.init(
+                rng, jnp.zeros((2, 4), jnp.int32), model.initial_carry(2)
+            ),
+            jax.random.key(0),
+        )
+        paths = [
+            _path_str(p)
+            for p, _ in jax.tree_util.tree_leaves_with_path(
+                variables["params"]
+            )
+        ]
+        for pattern, _ in tensorlib.lstm_tp_rules():
+            assert any(re.search(pattern, p) for p in paths), pattern
+
     def test_fused_cell_matches_flax_lstm(self):
         """The hoisted-input fused-gate layer == flax's per-gate
         OptimizedLSTMCell stepped over time, on mapped parameters —
