@@ -561,7 +561,7 @@ def run_flash_check(args):
 
     ITERS = 10
 
-    def timed(attn_fn):
+    def timed(attn_fn, eager_out=True):
         """Fuse ITERS serially-dependent invocations into ONE compiled
         program and time the scalar readback — same rationale as run_one:
         this machine's relay acks block_until_ready before completion, so
@@ -588,7 +588,7 @@ def run_flash_check(args):
         t0 = time.perf_counter()
         float(fn(q, k, v))
         dt = (time.perf_counter() - t0) / ITERS
-        return attn_fn(q, k, v), dt
+        return (attn_fn(q, k, v) if eager_out else None), dt
 
     f_out, f_dt = timed(
         lambda q, k, v: attnlib.flash_attention(q, k, v, True)
@@ -633,6 +633,25 @@ def run_flash_check(args):
     b_grad_dt = grad_timed(
         lambda q, k, v: attnlib.blockwise_attention(q, k, v, causal=True)
     )
+
+    # Forward block-size sweep: the (128,128) default was never tuned on
+    # hardware; this records the landscape so the right tile is a config
+    # change, not a guess.  (128,128) reuses the f_dt measurement above;
+    # timed()'s trailing eager call is skipped — only the fused timing
+    # program runs per tile.
+    sweep = {"128x128": round(f_dt * 1e3, 3)}
+    for bq, bkv in ((128, 256), (256, 128), (256, 256),
+                    (128, 512), (512, 128)):
+        try:
+            _, dt = timed(
+                lambda q, k, v, bq=bq, bkv=bkv: attnlib.flash_attention(
+                    q, k, v, True, None, bq, bkv
+                ),
+                eager_out=False,
+            )
+            sweep[f"{bq}x{bkv}"] = round(dt * 1e3, 3)
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            sweep[f"{bq}x{bkv}"] = f"error: {e}"[:120]
     jax.block_until_ready((f_out, b_out))
     # Numerics gate in f32: the bf16 impls must land within bf16 round-off
     # of the exact O(T^2) answer.
@@ -653,6 +672,7 @@ def run_flash_check(args):
         "flash_grad_ms": round(f_grad_dt * 1e3, 3),
         "blockwise_grad_ms": round(b_grad_dt * 1e3, 3),
         "grad_speedup_vs_blockwise": round(b_grad_dt / f_grad_dt, 3),
+        "forward_block_sweep_ms": sweep,
         "flash_tflops": round(flash_flops / f_dt / 1e12, 2),
         "max_err_flash_vs_reference": float(
             jnp.max(jnp.abs(f_out.astype(jnp.float32) - ref))
