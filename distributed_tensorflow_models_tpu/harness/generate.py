@@ -152,3 +152,113 @@ def generate(
         [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
     )
     return jnp.concatenate([prompt, generated], axis=1)
+
+
+def beam_search(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    beam_size: int = 4,
+):
+    """Beam-search decoding with the KV cache: flat ``[B·K]`` beam layout,
+    one compiled ``lax.scan`` whose carry reorders every cache leaf by the
+    surviving beams' parent indices each step (a batched ``take`` along
+    the flat beam axis — static shapes throughout).
+
+    Scoring: sum of token log-probs (all beams share the fixed length
+    ``max_new_tokens``, so a length penalty would rescale every score by
+    the same constant and is deliberately not offered).  Returns
+    ``(tokens [B, T_prompt + max_new_tokens], scores [B])``.
+    """
+    B, T_prompt = prompt.shape
+    K = beam_size
+    if max_new_tokens < 1:
+        raise ValueError("beam_search needs max_new_tokens >= 1")
+    if T_prompt + max_new_tokens > model.max_len:
+        raise ValueError(
+            f"prompt {T_prompt} + new {max_new_tokens} exceeds "
+            f"max_len {model.max_len}"
+        )
+    decode_model = model.clone(decode=True, dropout_rate=0.0)
+
+    # Prompt pass at batch B (once per row — not per beam); the caches
+    # and final logits then repeat K-fold into the flat [B·K] layout.
+    # Only beam 0 starts live — the others' scores are -inf, so the
+    # first expansion's top-k expands beam 0's distribution without
+    # duplicates, and dead beams revive exactly as the live-prefix count
+    # grows, which also makes K > V valid: K >= V^steps is exhaustive
+    # search.
+    (logits, _), cache_vars = decode_model.apply(
+        {"params": params}, prompt, train=False, mutable=["cache"]
+    )
+    cache = jax.tree.map(
+        lambda a: (
+            jnp.repeat(a, K, axis=0) if a.ndim and a.shape[0] == B else a
+        ),
+        cache_vars["cache"],
+    )
+    logits = jnp.repeat(logits, K, axis=0)
+    V = logits.shape[-1]
+    scores0 = jnp.full((B, K), -jnp.inf, jnp.float32).at[:, 0].set(0.0)
+    seqs0 = jnp.zeros((B * K, max_new_tokens), prompt.dtype)
+    # The prompt pass already consumed every prompt position; its last
+    # logits seed expansion step 0 directly (no re-apply of the last
+    # prompt token).
+    logp0 = jax.nn.log_softmax(
+        logits[:, -1].astype(jnp.float32), axis=-1
+    ).reshape(B, K, V)
+
+    def expand(cache, scores, seqs, logp, t):
+        total = scores[:, :, None] + logp  # [B, K, V]
+        new_scores, flat_idx = jax.lax.top_k(
+            total.reshape(B, K * V), K
+        )  # [B, K]
+        parent = flat_idx // V  # [B, K] beam index within the row
+        new_tok = (flat_idx % V).astype(prompt.dtype).reshape(B * K)
+        # Flat indices of the surviving beams' parents.
+        src = (jnp.arange(B)[:, None] * K + parent).reshape(B * K)
+        cache = jax.tree.map(
+            lambda a: (
+                jnp.take(a, src, axis=0) if a.ndim and a.shape[0] == B * K
+                else a  # scalar counters (cache_index/pos_index)
+            ),
+            cache,
+        )
+        seqs = jnp.take(seqs, src, axis=0).at[:, t].set(new_tok)
+        return cache, new_scores, seqs, new_tok
+
+    cache, scores, seqs, tok = expand(cache, scores0, seqs0, logp0, 0)
+
+    def step(carry, t):
+        cache, tok, scores, seqs = carry
+        (logits, _), mutated = decode_model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            train=False,
+            mutable=["cache"],
+        )
+        logp = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32), axis=-1
+        ).reshape(B, K, V)
+        cache, scores, seqs, tok = expand(
+            mutated["cache"], scores, seqs, logp, t
+        )
+        return (cache, tok, scores, seqs), None
+
+    (cache, tok, scores, seqs), _ = jax.lax.scan(
+        step, (cache, tok, scores, seqs),
+        jnp.arange(1, max_new_tokens),
+    )
+
+    best = jnp.argmax(scores, axis=-1)  # [B]
+    seqs = seqs.reshape(B, K, max_new_tokens)
+    best_seq = jnp.take_along_axis(
+        seqs, best[:, None, None], axis=1
+    )[:, 0]
+    best_score = jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0]
+    return (
+        jnp.concatenate([prompt, best_seq.astype(prompt.dtype)], axis=1),
+        best_score,
+    )
