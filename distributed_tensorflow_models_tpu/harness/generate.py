@@ -59,6 +59,15 @@ def _filter_logits(logits, top_k: int, top_p: float):
     return logits
 
 
+def _sample(logits_last, key, temperature, top_k, top_p, dtype):
+    """One sampling decision, shared by the transformer and RNN paths so
+    a sampling fix cannot silently apply to only one of them."""
+    if temperature > 0:
+        filtered = _filter_logits(logits_last / temperature, top_k, top_p)
+        return jax.random.categorical(key, filtered, axis=-1).astype(dtype)
+    return jnp.argmax(logits_last, axis=-1).astype(dtype)
+
+
 def generate(
     model,
     params,
@@ -109,16 +118,9 @@ def generate(
     )
     cache = cache_vars["cache"]
 
-    def sample(logits_last, key):
-        if temperature > 0:
-            filtered = _filter_logits(
-                logits_last / temperature, top_k, top_p
-            )
-            return jax.random.categorical(key, filtered, axis=-1).astype(
-                prompt.dtype
-            )
-        return jnp.argmax(logits_last, axis=-1).astype(prompt.dtype)
-
+    sample = lambda lg, key: _sample(
+        lg, key, temperature, top_k, top_p, prompt.dtype
+    )
     keys = jax.random.split(rng, max_new_tokens)  # one per new token
     first = sample(logits[:, -1], keys[0])
 
@@ -148,6 +150,59 @@ def generate(
     )
     # toks stacks the PREVIOUS token per step: [first, ..., second-last];
     # append the final one and restore batch-major order.
+    generated = jnp.concatenate(
+        [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
+    )
+    return jnp.concatenate([prompt, generated], axis=1)
+
+
+def generate_rnn(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    rng: Optional[jax.Array] = None,
+):
+    """Autoregressive sampling for carry-threaded RNN LMs (the PTB LSTM):
+    the recurrent state IS the cache, so decoding is just feeding one
+    token at a time and threading the carry through a ``lax.scan`` — the
+    same static-shape compiled-loop shape as the transformer path.
+
+    ``model.apply(vars, tokens, carry) -> (logits, carry)`` is the only
+    contract used (``initial_carry`` provides the start state).
+    """
+    B = prompt.shape[0]
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    rng = rng if rng is not None else jax.random.key(0)
+
+    carry = model.initial_carry(B)
+    logits, carry = model.apply(
+        {"params": params}, prompt, carry, train=False
+    )
+
+    sample = lambda lg, key: _sample(
+        lg, key, temperature, top_k, top_p, prompt.dtype
+    )
+    keys = jax.random.split(rng, max_new_tokens)
+    first = sample(logits[:, -1], keys[0])
+
+    def step(state, key):
+        carry, tok = state
+        logits, carry = model.apply(
+            {"params": params}, tok[:, None], carry, train=False
+        )
+        return (carry, sample(logits[:, -1], key)), tok
+
+    (_, last), toks = jax.lax.scan(step, (carry, first), keys[1:])
     generated = jnp.concatenate(
         [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
     )

@@ -232,6 +232,34 @@ def test_gqa_decode_matches_full_forward():
     assert ck.shape == (2, 16, 2, 8), ck.shape  # Hkv=2, Dh=32/4
 
 
+def test_generate_rnn_matches_naive_greedy():
+    """Carry-threaded LSTM decode == recompute-the-whole-prefix greedy."""
+    from distributed_tensorflow_models_tpu.harness.generate import (
+        generate_rnn,
+    )
+
+    model = get_model(
+        "ptb_lstm", config="small", vocab_size=40, dropout_rate=0.0
+    )
+    rng = np.random.RandomState(11)
+    prompt = jnp.asarray(rng.randint(0, 40, (2, 5)), jnp.int32)
+    params = model.init(
+        jax.random.key(0), prompt, model.initial_carry(2)
+    )["params"]
+
+    out = generate_rnn(model, params, prompt, 6)
+    assert out.shape == (2, 11)
+
+    toks = prompt
+    for _ in range(6):
+        logits, _ = model.apply(
+            {"params": params}, toks, model.initial_carry(2), train=False
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
 def test_cli_train_then_generate(tmp_path):
     """The user surface: train a transformer_lm checkpoint via the CLI,
     then sample from it with the generate subcommand."""
