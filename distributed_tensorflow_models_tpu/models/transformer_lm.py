@@ -541,9 +541,12 @@ class TransformerLM(nn.Module):
             )
         if self.attn_window is not None and self.attention_fn is not None:
             raise ValueError(
-                "attn_window is not threaded through the sequence-"
-                "parallel attention_fn path — training would use full "
-                "causal attention while decode applies the window"
+                "attn_window is not threaded through the harness's "
+                "sequence-parallel attention_fn closures — training "
+                "would use full causal attention while decode applies "
+                "the window.  (ring_attention/ulysses_attention DO "
+                "accept window= at the library level; pass a closure "
+                "that sets it and leave attn_window unset here.)"
             )
         if self.pipelined or self.pipe_mesh is not None:
             if (

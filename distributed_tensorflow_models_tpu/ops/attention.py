@@ -751,7 +751,9 @@ def _flash_bwd(
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
+)
 def flash_attention_chunk(
     q: jax.Array,
     k: jax.Array,
@@ -763,6 +765,7 @@ def flash_attention_chunk(
     block_q: int = 128,
     block_kv: int = 128,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Chunk-of-a-longer-sequence flash attention: returns ``(out, lse)``
     with lse ``[B, T, H]`` so a caller can exactly merge partial results
@@ -779,23 +782,25 @@ def flash_attention_chunk(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
         return_lse=True, q_offset=q_offset, kv_offset=kv_offset,
+        window=window,
     )
 
 
 def _flash_chunk_fwd(
     q, k, v, q_offset, kv_offset, causal, scale, block_q, block_kv,
-    interpret,
+    interpret, window,
 ):
     out, lse = _flash_forward(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
         return_lse=True, q_offset=q_offset, kv_offset=kv_offset,
+        window=window,
     )
     return (out, lse), (q, k, v, out, lse, q_offset, kv_offset)
 
 
 def _flash_chunk_bwd(
-    causal, scale, block_q, block_kv, interpret, res, cotangents
+    causal, scale, block_q, block_kv, interpret, window, res, cotangents
 ):
     q, k, v, out, lse, q_offset, kv_offset = res
     g_out, g_lse = cotangents
@@ -803,7 +808,7 @@ def _flash_chunk_bwd(
         q, k, v, out, _lse_rows(lse), g_out, causal=causal, scale=scale,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
         q_offset=q_offset, kv_offset=kv_offset,
-        g_lse=_lse_rows(g_lse),
+        g_lse=_lse_rows(g_lse), window=window,
     )
     # Offsets are integer positions: no gradient.
     return dq, dk, dv, None, None

@@ -40,7 +40,7 @@ from distributed_tensorflow_models_tpu.ops import attention as attnlib
 
 def _ring_attention_local_flash(
     q, k, v, *, axis_name: str, causal: bool, scale: Optional[float],
-    interpret: bool = False,
+    interpret: bool = False, window: Optional[int] = None,
 ):
     """Per-device ring body with the Pallas flash kernel as the inner
     step: each visiting KV chunk runs through
@@ -77,6 +77,7 @@ def _ring_attention_local_flash(
         o_r, lse_r = attnlib.flash_attention_chunk(
             q, k_cur, v_cur, q_off, kv_off,
             causal=causal, scale=scale, interpret=interpret,
+            window=window,
         )
         m_new = jnp.maximum(m, lse_r)
         alpha = jnp.exp(m - m_new)
@@ -95,7 +96,8 @@ def _ring_attention_local_flash(
 
 
 def _ring_attention_local(
-    q, k, v, *, axis_name: str, causal: bool, scale: Optional[float]
+    q, k, v, *, axis_name: str, causal: bool, scale: Optional[float],
+    window: Optional[int] = None,
 ):
     """Per-device body (inside shard_map): q/k/v are local chunks
     [B, T_local, H, D]; returns the local output chunk."""
@@ -131,18 +133,28 @@ def _ring_attention_local(
                 "bhqd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
                 preferred_element_type=jnp.float32,
             )
-            if causal:
+            if causal or window is not None:
                 qi = q_off + jnp.arange(Tl)[:, None]
                 kj = kv_off + jnp.arange(Tl)[None, :]
-                s_block = jnp.where(qi >= kj, s_block, attnlib.NEG_INF)
+                valid = qi >= kj if causal else qi == qi
+                if window is not None:
+                    valid = valid & (qi - kj < window)
+                s_block = jnp.where(valid, s_block, attnlib.NEG_INF)
             vb = jnp.swapaxes(v_cur, 1, 2)  # [B,H,Tl,D]
             return attnlib._block_update((m, l, acc), s_block, vb)
 
-        if causal:
+        if causal or window is not None:
             # Skip rotations whose KV chunk is entirely in this device's
-            # future — without this, causal rings waste ~half their FLOPs
-            # computing fully-masked blocks.
-            fully_masked = kv_off > q_off + Tl - 1
+            # future (causal) or entirely older than every query's window
+            # — without this, rings waste FLOPs computing fully-masked
+            # blocks (the flash path's kernel has the same skips).
+            fully_masked = jnp.bool_(False)
+            if causal:
+                fully_masked = kv_off > q_off + Tl - 1
+            if window is not None:
+                fully_masked = fully_masked | (
+                    q_off - (kv_off + Tl - 1) >= window
+                )
             m, l, acc = jax.lax.cond(
                 fully_masked, lambda mla: mla, fold, (m, l, acc)
             )
@@ -171,6 +183,7 @@ def ring_attention(
     data_axis: str = AxisNames.DATA,
     impl: str = "auto",
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Full-sequence attention with Q/K/V sharded over ``seq_axis``.
 
@@ -193,6 +206,10 @@ def ring_attention(
             "ring attention requires matching q/kv head counts; expand "
             "GQA KV heads before sharding the sequence"
         )
+    # Validate here so the fold path matches flash/blockwise/reference:
+    # an unchecked window <= 0 would silently return all-zero output
+    # (every score NEG_INF, normalizer clamped).
+    window = attnlib._check_window(window)
     if impl == "auto":
         impl = (
             "flash"
@@ -204,7 +221,7 @@ def ring_attention(
         local = functools.partial(
             _ring_attention_local_flash,
             axis_name=seq_axis, causal=causal, scale=scale,
-            interpret=interpret,
+            interpret=interpret, window=window,
         )
         # pallas_call outputs carry no varying-mesh-axes type, which the
         # shard_map vma checker rejects; the surrounding merge arithmetic
@@ -215,6 +232,7 @@ def ring_attention(
         local = functools.partial(
             _ring_attention_local,
             axis_name=seq_axis, causal=causal, scale=scale,
+            window=window,
         )
     else:
         raise ValueError(f"unknown ring attention impl {impl!r}")
@@ -231,7 +249,7 @@ def ring_attention(
 
 def _ulysses_local(
     q, k, v, *, axis_name: str, causal: bool, scale: Optional[float],
-    impl: str,
+    impl: str, window: Optional[int] = None,
 ):
     """Inside shard_map: [B, T/n, H, D] → all_to_all → [B, T, H/n, D] →
     local attention → inverse."""
@@ -249,7 +267,7 @@ def _ulysses_local(
 
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     out = attnlib.attention(
-        qh, kh, vh, causal=causal, scale=scale, impl=impl
+        qh, kh, vh, causal=causal, scale=scale, impl=impl, window=window
     )
     return gather_heads(out)
 
@@ -265,6 +283,7 @@ def ulysses_attention(
     seq_axis: str = AxisNames.SEQ,
     data_axis: str = AxisNames.DATA,
     impl: str = "blockwise",
+    window: Optional[int] = None,
 ) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style), BTHD
     global in/out, sequence sharded over ``seq_axis``.  Heads must divide
@@ -284,6 +303,7 @@ def ulysses_attention(
         functools.partial(
             _ulysses_local,
             axis_name=seq_axis, causal=causal, scale=scale, impl=impl,
+            window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
