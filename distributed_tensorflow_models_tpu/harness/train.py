@@ -138,6 +138,11 @@ def _mesh_model_kwargs(cfg: ExperimentConfig, mesh) -> dict:
     if cfg.seq_impl:
         from distributed_tensorflow_models_tpu.parallel import ring as ringlib
 
+        # A sliding window moves INTO the sequence-parallel closure (ring
+        # and ulysses mask in global coordinates); _init_model_kwargs
+        # drops it from the model so the attention_fn guard doesn't trip
+        # and the window isn't double-applied.
+        window = cfg.model_kwargs.get("attn_window")
         if cfg.seq_impl == "ring":
             # attn_impl maps onto the ring inner step: auto/flash pick the
             # Pallas chunk kernel + LSE merge on TPU; reference/blockwise
@@ -148,13 +153,15 @@ def _mesh_model_kwargs(cfg: ExperimentConfig, mesh) -> dict:
             ring_impl = "auto" if cfg.attn_impl in ("auto", "flash") else "fold"
             kwargs["attention_fn"] = lambda q, k, v, causal=True: (
                 ringlib.ring_attention(
-                    q, k, v, mesh, causal=causal, impl=ring_impl
+                    q, k, v, mesh, causal=causal, impl=ring_impl,
+                    window=window,
                 )
             )
         elif cfg.seq_impl == "ulysses":
             kwargs["attention_fn"] = lambda q, k, v, causal=True: (
                 ringlib.ulysses_attention(
-                    q, k, v, mesh, causal=causal, impl=cfg.attn_impl
+                    q, k, v, mesh, causal=causal, impl=cfg.attn_impl,
+                    window=window,
                 )
             )
         else:
@@ -174,6 +181,12 @@ def _init_model_kwargs(cfg: ExperimentConfig) -> dict:
     kwargs = dict(cfg.model_kwargs)
     if cfg.model == "transformer_lm" and cfg.mesh_pipe > 1:
         kwargs.setdefault("pipelined", True)
+    if cfg.seq_impl:
+        # Under sequence parallelism the window lives in the
+        # attention_fn closure (_mesh_model_kwargs); the model must not
+        # also apply it.  Params don't depend on attn_window, so the
+        # init/apply parameter structures stay identical.
+        kwargs.pop("attn_window", None)
     return kwargs
 
 

@@ -117,6 +117,28 @@ class TestParallelismEquivalence:
         res = trainlib.fit(tiny_cfg(mesh_model=2), tempfile.mkdtemp())
         assert abs(res.final_metrics["loss"] - dp_loss) < 1e-3
 
+    def test_windowed_ring_matches_windowed_dp(self):
+        """attn_window under seq_impl: the harness moves the window into
+        the sequence-parallel closure (and off the model) — trajectory
+        must equal the pure-DP model applying the same window itself."""
+        win_kwargs = {**TINY, "attn_window": 8}
+        res_dp = trainlib.fit(
+            tiny_cfg(model_kwargs=win_kwargs), tempfile.mkdtemp()
+        )
+        res_ring = trainlib.fit(
+            tiny_cfg(
+                model_kwargs=win_kwargs, mesh_seq=2, seq_impl="ring"
+            ),
+            tempfile.mkdtemp(),
+        )
+        assert (
+            abs(
+                res_ring.final_metrics["loss"]
+                - res_dp.final_metrics["loss"]
+            )
+            < 1e-3
+        )
+
 
 def test_fit_moe_expert_parallel():
     cfg = tiny_cfg(
