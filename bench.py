@@ -230,7 +230,9 @@ def run_one(name, builder, steps, batch_override):
     peak = _peak_flops(dev.device_kind)
     result = {
         "metric": f"{name}_synthetic_train_throughput",
-        "value": round(per_chip, 1),
+        # Sub-1 rates (CPU-fallback conv configs) keep 4 decimals — a
+        # 1-decimal round would report an honest 0.04 img/s as 0.0.
+        "value": round(per_chip, 1 if per_chip >= 1 else 4),
         "unit": unit,
         "items_per_step_per_chip": items_per_chip,
         "steps": steps,
