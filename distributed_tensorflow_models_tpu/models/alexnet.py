@@ -11,31 +11,33 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_tensorflow_models_tpu.models import register
+from distributed_tensorflow_models_tpu.ops.conv import Conv2D, max_pool
 
 
 class AlexNet(nn.Module):
     num_classes: int = 1000
     dropout_rate: float = 0.5
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(
+        x = Conv2D(
             64, (11, 11), strides=(4, 4), padding="VALID", dtype=self.dtype,
-            name="conv1",
+            impl=self.conv_impl, name="conv1",
         )(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = nn.Conv(192, (5, 5), padding="SAME", dtype=self.dtype,
-                    name="conv2")(x)
+        x = max_pool(x, (3, 3), strides=(2, 2), impl=self.conv_impl)
+        x = Conv2D(192, (5, 5), padding="SAME", dtype=self.dtype,
+                   impl=self.conv_impl, name="conv2")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = max_pool(x, (3, 3), strides=(2, 2), impl=self.conv_impl)
         for i, width in enumerate([384, 384, 256]):
-            x = nn.Conv(width, (3, 3), padding="SAME", dtype=self.dtype,
-                        name=f"conv{i + 3}")(x)
+            x = Conv2D(width, (3, 3), padding="SAME", dtype=self.dtype,
+                       impl=self.conv_impl, name=f"conv{i + 3}")(x)
             x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = max_pool(x, (3, 3), strides=(2, 2), impl=self.conv_impl)
         x = x.reshape((x.shape[0], -1))
         for i in range(2):
             x = nn.Dense(4096, dtype=self.dtype, name=f"fc{i + 6}")(x)

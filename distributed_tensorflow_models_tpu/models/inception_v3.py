@@ -26,6 +26,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_tensorflow_models_tpu.models import register
+from distributed_tensorflow_models_tpu.ops.conv import Conv2D, avg_pool, max_pool
 from distributed_tensorflow_models_tpu.ops.normalization import BatchNorm
 
 
@@ -38,16 +39,18 @@ class ConvBN(nn.Module):
     strides: tuple[int, int] = (1, 1)
     padding: str = "SAME"
     dtype: jnp.dtype = jnp.bfloat16
+    impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.Conv(
+        x = Conv2D(
             self.filters,
             self.kernel,
             strides=self.strides,
             padding=self.padding,
             use_bias=False,
             dtype=self.dtype,
+            impl=self.impl,
         )(x)
         x = BatchNorm(
             use_running_average=not train,
@@ -57,8 +60,8 @@ class ConvBN(nn.Module):
         return nn.relu(x)
 
 
-def _avg_pool_same(x):
-    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+def _avg_pool_same(x, impl: str = "auto"):
+    return avg_pool(x, (3, 3), strides=(1, 1), padding="SAME", impl=impl)
 
 
 class InceptionA(nn.Module):
@@ -66,17 +69,20 @@ class InceptionA(nn.Module):
 
     pool_filters: int
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, impl=self.conv_impl)
         b0 = c(64, (1, 1))(x, train=train)
         b1 = c(48, (1, 1))(x, train=train)
         b1 = c(64, (5, 5))(b1, train=train)
         b2 = c(64, (1, 1))(x, train=train)
         b2 = c(96, (3, 3))(b2, train=train)
         b2 = c(96, (3, 3))(b2, train=train)
-        b3 = c(self.pool_filters, (1, 1))(_avg_pool_same(x), train=train)
+        b3 = c(self.pool_filters, (1, 1))(
+            _avg_pool_same(x, self.conv_impl), train=train
+        )
         return jnp.concatenate([b0, b1, b2, b3], axis=-1)
 
 
@@ -84,15 +90,18 @@ class ReductionA(nn.Module):
     """Mixed_6a: stride-2 3x3 / stride-2 double-3x3 / max pool."""
 
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, impl=self.conv_impl)
         b0 = c(384, (3, 3), strides=(2, 2), padding="VALID")(x, train=train)
         b1 = c(64, (1, 1))(x, train=train)
         b1 = c(96, (3, 3))(b1, train=train)
         b1 = c(96, (3, 3), strides=(2, 2), padding="VALID")(b1, train=train)
-        b2 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        b2 = max_pool(
+            x, (3, 3), strides=(2, 2), padding="VALID", impl=self.conv_impl
+        )
         return jnp.concatenate([b0, b1, b2.astype(b0.dtype)], axis=-1)
 
 
@@ -102,10 +111,11 @@ class InceptionB(nn.Module):
 
     width: int
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, impl=self.conv_impl)
         w = self.width
         b0 = c(192, (1, 1))(x, train=train)
         b1 = c(w, (1, 1))(x, train=train)
@@ -116,7 +126,7 @@ class InceptionB(nn.Module):
         b2 = c(w, (1, 7))(b2, train=train)
         b2 = c(w, (7, 1))(b2, train=train)
         b2 = c(192, (1, 7))(b2, train=train)
-        b3 = c(192, (1, 1))(_avg_pool_same(x), train=train)
+        b3 = c(192, (1, 1))(_avg_pool_same(x, self.conv_impl), train=train)
         return jnp.concatenate([b0, b1, b2, b3], axis=-1)
 
 
@@ -124,17 +134,20 @@ class ReductionB(nn.Module):
     """Mixed_7a."""
 
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, impl=self.conv_impl)
         b0 = c(192, (1, 1))(x, train=train)
         b0 = c(320, (3, 3), strides=(2, 2), padding="VALID")(b0, train=train)
         b1 = c(192, (1, 1))(x, train=train)
         b1 = c(192, (1, 7))(b1, train=train)
         b1 = c(192, (7, 1))(b1, train=train)
         b1 = c(192, (3, 3), strides=(2, 2), padding="VALID")(b1, train=train)
-        b2 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        b2 = max_pool(
+            x, (3, 3), strides=(2, 2), padding="VALID", impl=self.conv_impl
+        )
         return jnp.concatenate([b0, b1, b2.astype(b0.dtype)], axis=-1)
 
 
@@ -142,10 +155,11 @@ class InceptionC(nn.Module):
     """8x8 block (Mixed_7b/7c): expanded-filter-bank branches."""
 
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, impl=self.conv_impl)
         b0 = c(320, (1, 1))(x, train=train)
         b1 = c(384, (1, 1))(x, train=train)
         b1 = jnp.concatenate(
@@ -164,7 +178,7 @@ class InceptionC(nn.Module):
             ],
             axis=-1,
         )
-        b3 = c(192, (1, 1))(_avg_pool_same(x), train=train)
+        b3 = c(192, (1, 1))(_avg_pool_same(x, self.conv_impl), train=train)
         return jnp.concatenate([b0, b1, b2, b3], axis=-1)
 
 
@@ -175,14 +189,20 @@ class AuxHead(nn.Module):
 
     num_classes: int
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
-        x = ConvBN(128, (1, 1), dtype=self.dtype)(x, train=train)
-        x = ConvBN(768, (5, 5), padding="VALID", dtype=self.dtype)(
+        x = avg_pool(
+            x, (5, 5), strides=(3, 3), padding="VALID", impl=self.conv_impl
+        )
+        x = ConvBN(128, (1, 1), dtype=self.dtype, impl=self.conv_impl)(
             x, train=train
         )
+        x = ConvBN(
+            768, (5, 5), padding="VALID", dtype=self.dtype,
+            impl=self.conv_impl,
+        )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         x = x.astype(jnp.float32)
         return nn.Dense(
@@ -201,29 +221,35 @@ class InceptionV3(nn.Module):
     dropout_rate: float = 0.2
     aux_head: bool = True
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        c = partial(ConvBN, dtype=self.dtype)
+        c = partial(ConvBN, dtype=self.dtype, impl=self.conv_impl)
+        pool = partial(
+            max_pool, window=(3, 3), strides=(2, 2), padding="VALID",
+            impl=self.conv_impl,
+        )
         x = x.astype(self.dtype)
         # Stem: 299x299x3 → 35x35x192.
         x = c(32, (3, 3), strides=(2, 2), padding="VALID")(x, train=train)
         x = c(32, (3, 3), padding="VALID")(x, train=train)
         x = c(64, (3, 3))(x, train=train)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = pool(x)
         x = c(80, (1, 1), padding="VALID")(x, train=train)
         x = c(192, (3, 3), padding="VALID")(x, train=train)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = pool(x)
         # 35x35.
-        x = InceptionA(32, self.dtype, name="Mixed_5b")(x, train=train)
-        x = InceptionA(64, self.dtype, name="Mixed_5c")(x, train=train)
-        x = InceptionA(64, self.dtype, name="Mixed_5d")(x, train=train)
-        x = ReductionA(self.dtype, name="Mixed_6a")(x, train=train)
+        ci = self.conv_impl
+        x = InceptionA(32, self.dtype, ci, name="Mixed_5b")(x, train=train)
+        x = InceptionA(64, self.dtype, ci, name="Mixed_5c")(x, train=train)
+        x = InceptionA(64, self.dtype, ci, name="Mixed_5d")(x, train=train)
+        x = ReductionA(self.dtype, ci, name="Mixed_6a")(x, train=train)
         # 17x17.
-        x = InceptionB(128, self.dtype, name="Mixed_6b")(x, train=train)
-        x = InceptionB(160, self.dtype, name="Mixed_6c")(x, train=train)
-        x = InceptionB(160, self.dtype, name="Mixed_6d")(x, train=train)
-        x = InceptionB(192, self.dtype, name="Mixed_6e")(x, train=train)
+        x = InceptionB(128, self.dtype, ci, name="Mixed_6b")(x, train=train)
+        x = InceptionB(160, self.dtype, ci, name="Mixed_6c")(x, train=train)
+        x = InceptionB(160, self.dtype, ci, name="Mixed_6d")(x, train=train)
+        x = InceptionB(192, self.dtype, ci, name="Mixed_6e")(x, train=train)
         aux = None
         if self.aux_head:
             # Run (not just declare) the aux head regardless of mode so a
@@ -233,13 +259,13 @@ class InceptionV3(nn.Module):
             # TrainState (found by the bench's CPU-fallback run).  At eval
             # the unused result is dead-code-eliminated by XLA; only the
             # train path returns it.
-            aux = AuxHead(self.num_classes, self.dtype, name="AuxHead")(
-                x, train=train
-            )
-        x = ReductionB(self.dtype, name="Mixed_7a")(x, train=train)
+            aux = AuxHead(
+                self.num_classes, self.dtype, self.conv_impl, name="AuxHead"
+            )(x, train=train)
+        x = ReductionB(self.dtype, ci, name="Mixed_7a")(x, train=train)
         # 8x8.
-        x = InceptionC(self.dtype, name="Mixed_7b")(x, train=train)
-        x = InceptionC(self.dtype, name="Mixed_7c")(x, train=train)
+        x = InceptionC(self.dtype, ci, name="Mixed_7b")(x, train=train)
+        x = InceptionC(self.dtype, ci, name="Mixed_7c")(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = x.astype(jnp.float32)

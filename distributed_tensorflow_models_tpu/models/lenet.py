@@ -13,6 +13,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_tensorflow_models_tpu.models import register
+from distributed_tensorflow_models_tpu.ops.conv import Conv2D, max_pool
 
 
 class LeNet(nn.Module):
@@ -21,16 +22,21 @@ class LeNet(nn.Module):
     num_classes: int = 10
     dropout_rate: float = 0.5
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = Conv2D(
+            32, (5, 5), padding="SAME", dtype=self.dtype, impl=self.conv_impl
+        )(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = max_pool(x, (2, 2), strides=(2, 2), impl=self.conv_impl)
+        x = Conv2D(
+            64, (5, 5), padding="SAME", dtype=self.dtype, impl=self.conv_impl
+        )(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = max_pool(x, (2, 2), strides=(2, 2), impl=self.conv_impl)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(1024, dtype=self.dtype)(x)
         x = nn.relu(x)

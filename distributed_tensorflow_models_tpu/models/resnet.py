@@ -26,6 +26,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_tensorflow_models_tpu.models import register
+from distributed_tensorflow_models_tpu.ops.conv import Conv2D, max_pool
 from distributed_tensorflow_models_tpu.ops.normalization import BatchNorm
 
 
@@ -37,6 +38,7 @@ class BottleneckBlock(nn.Module):
     filters: int  # bottleneck width; output is 4x this
     strides: int = 1
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -46,7 +48,9 @@ class BottleneckBlock(nn.Module):
             momentum=0.9,
             epsilon=1e-5,
         )
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        conv = partial(
+            Conv2D, use_bias=False, dtype=self.dtype, impl=self.conv_impl
+        )
         out_filters = 4 * self.filters
 
         residual = x
@@ -80,25 +84,30 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(
+        x = Conv2D(
             self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-            use_bias=False, dtype=self.dtype, name="conv_init",
+            use_bias=False, dtype=self.dtype, impl=self.conv_impl,
+            name="conv_init",
         )(x)
         x = BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5,
             name="bn_init",
         )(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = max_pool(
+            x, (3, 3), strides=(2, 2), padding="SAME", impl=self.conv_impl
+        )
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
                 x = BottleneckBlock(
                     self.width * (2**stage), strides, self.dtype,
+                    self.conv_impl,
                     name=f"stage{stage}_block{block}",
                 )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))

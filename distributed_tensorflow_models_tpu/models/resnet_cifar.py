@@ -21,6 +21,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_tensorflow_models_tpu.models import register
+from distributed_tensorflow_models_tpu.ops.conv import Conv2D
 from distributed_tensorflow_models_tpu.ops.normalization import BatchNorm
 
 
@@ -30,6 +31,7 @@ class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -40,8 +42,8 @@ class BasicBlock(nn.Module):
             epsilon=1e-5,
         )
         conv = partial(
-            nn.Conv, kernel_size=(3, 3), padding="SAME", use_bias=False,
-            dtype=self.dtype,
+            Conv2D, kernel_size=(3, 3), padding="SAME", use_bias=False,
+            dtype=self.dtype, impl=self.conv_impl,
         )
         residual = x
         y = conv(self.filters, strides=(self.strides, self.strides))(x)
@@ -50,12 +52,13 @@ class BasicBlock(nn.Module):
         y = conv(self.filters)(y)
         y = norm()(y)
         if residual.shape != y.shape:
-            residual = nn.Conv(
+            residual = Conv2D(
                 self.filters,
                 (1, 1),
                 strides=(self.strides, self.strides),
                 use_bias=False,
                 dtype=self.dtype,
+                impl=self.conv_impl,
                 name="proj",
             )(residual)
             residual = norm(name="proj_bn")(residual)
@@ -69,13 +72,14 @@ class CifarResNet(nn.Module):
     widths: Sequence[int] = (16, 32, 64)
     num_classes: int = 10
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(
+        x = Conv2D(
             self.widths[0], (3, 3), padding="SAME", use_bias=False,
-            dtype=self.dtype, name="conv_init",
+            dtype=self.dtype, impl=self.conv_impl, name="conv_init",
         )(x)
         x = BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5,
@@ -86,7 +90,7 @@ class CifarResNet(nn.Module):
             for block in range(self.blocks_per_stage):
                 strides = 2 if stage > 0 and block == 0 else 1
                 x = BasicBlock(
-                    width, strides, self.dtype,
+                    width, strides, self.dtype, self.conv_impl,
                     name=f"stage{stage}_block{block}",
                 )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool

@@ -13,12 +13,14 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_tensorflow_models_tpu.models import register
+from distributed_tensorflow_models_tpu.ops.conv import Conv2D, max_pool
 
 
 class VGG16(nn.Module):
     num_classes: int = 1000
     dropout_rate: float = 0.5
     dtype: jnp.dtype = jnp.bfloat16
+    conv_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -27,12 +29,12 @@ class VGG16(nn.Module):
             [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
         ):
             for i in range(n_convs):
-                x = nn.Conv(
+                x = Conv2D(
                     width, (3, 3), padding="SAME", dtype=self.dtype,
-                    name=f"conv{stage + 1}_{i + 1}",
+                    impl=self.conv_impl, name=f"conv{stage + 1}_{i + 1}",
                 )(x)
                 x = nn.relu(x)
-            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = max_pool(x, (2, 2), strides=(2, 2), impl=self.conv_impl)
         x = x.reshape((x.shape[0], -1))
         for i in range(2):
             x = nn.Dense(4096, dtype=self.dtype, name=f"fc{i + 6}")(x)

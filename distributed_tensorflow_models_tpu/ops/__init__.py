@@ -1,5 +1,6 @@
 """Numerical building blocks: losses, metrics, optimizers, EMA, schedules."""
 
+from distributed_tensorflow_models_tpu.ops import conv
 from distributed_tensorflow_models_tpu.ops import losses
 from distributed_tensorflow_models_tpu.ops import metrics
 from distributed_tensorflow_models_tpu.ops import optim
