@@ -10,11 +10,17 @@ on-device data isolates compute throughput from host input, the standard
 convention for this comparison (the reference's own benchmarking used the
 same trick via slim's fake dataset).
 
-Prints exactly ONE JSON line on stdout (the driver's contract):
+Prints exactly ONE JSON line on stdout (the driver's contract), kept
+COMPACT so a tail-window capture cannot truncate it (the round-2 driver
+record died exactly that way — BENCH_r02.json "parsed": null):
 
     {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
      "mfu": ..., "platform": ..., "device": ..., "attempts": N,
-     "all": {<per-config results, including the non-headline configs>}}
+     "configs": {<name>: {value, unit, platform, mfu}},
+     "detail_file": "experiments/bench_detail_latest.json"}
+
+Full per-config detail (FLOPs accounting, timings, loss, sweeps) goes to
+``detail_file``, not stdout.
 
 ``vs_baseline`` is the ratio against BASELINE.json's driver-set target of
 5,000 images/sec/chip (a TPU v4 number; this machine benches one v5e chip —
@@ -185,45 +191,70 @@ def run_one(name, builder, steps, batch_override):
     host<->device round-trip through this machine's TPU relay, whose
     block_until_ready acks before completion — per-step timing is
     meaningless there) and lets XLA overlap step boundaries, which is how a
-    real TPU training loop should be driven anyway."""
+    real TPU training loop should be driven anyway.
+
+    The scan cycles through NB=8 *distinct* synthetic batches (leading axis
+    on every batch leaf, one dynamic-index gather per step — zero extra
+    FLOPs) so `final_loss` is a live sanity signal: a single fixed batch
+    gets memorized within the measured window (the round-2 TPU transformer
+    run ended at loss 0.10), at which point the one number the artifact
+    carries can no longer catch a broken step."""
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     n_chips = len(jax.devices())
-    state, batch, step_fn, items_per_chip, unit = builder(
-        n_chips, batch_override
+    state, batches, step_fn, items_per_chip, unit, extras = builder(
+        n_chips, batch_override, steps
     )
     items_per_step = items_per_chip * n_chips
+    nb = jax.tree.leaves(batches)[0].shape[0]
 
-    def fn(state, batch, rng):
-        def body(s, _):
-            s, metrics = step_fn(s, batch, rng)
+    def fn(state, batches, rng):
+        def body(s, i):
+            b = jax.tree.map(
+                lambda x: jax.lax.dynamic_index_in_dim(
+                    x, i % nb, 0, keepdims=False
+                ),
+                batches,
+            )
+            s, metrics = step_fn(s, b, rng)
             return s, metrics["loss"]
 
-        return jax.lax.scan(body, state, None, length=steps)
+        return jax.lax.scan(body, state, jnp.arange(steps))
 
     rng = jax.random.key(42)
     t0 = time.time()
-    compiled = jax.jit(fn).lower(state, batch, rng).compile()
+    compiled = jax.jit(fn).lower(state, batches, rng).compile()
     log(f"{name}: compiled in {time.time()-t0:.1f}s")
     # FLOPs from a single-step lowering (trace-only; see helper docstring).
     # The lowering sees the global-batch program: divide by chip count.
+    one_batch = jax.tree.map(lambda x: x[0], batches)
     flops_global, flops_src = _flops_per_step_global(
-        jax.jit(step_fn).lower(state, batch, rng),
+        jax.jit(step_fn).lower(state, one_batch, rng),
         name,
         items_per_step,
     )
     flops_chip = flops_global / n_chips
 
     # Warmup == one untimed run of the exact timed program.
-    state, losses = compiled(state, batch, rng)
+    state, losses = compiled(state, batches, rng)
     float(losses[-1])  # drain: readback is the only real sync here
     t0 = time.perf_counter()
-    state, losses = compiled(state, batch, rng)
+    state, losses = compiled(state, batches, rng)
     final_loss = float(losses[-1])  # forces completion
     dt = time.perf_counter() - t0
     if not np.isfinite(final_loss):
         raise FloatingPointError(f"{name}: non-finite loss {final_loss}")
+    loss_range = extras.pop("loss_range", None)
+    if loss_range is not None:
+        lo, hi = loss_range
+        if not (lo <= final_loss <= hi):
+            raise FloatingPointError(
+                f"{name}: final_loss {final_loss:.3f} outside sanity "
+                f"corridor [{lo:.2f}, {hi:.2f}] — the step is broken "
+                f"(unseen random data admits no other explanation)"
+            )
 
     per_chip = items_per_step * steps / dt / n_chips
     dev = jax.devices()[0]
@@ -236,10 +267,12 @@ def run_one(name, builder, steps, batch_override):
         "unit": unit,
         "items_per_step_per_chip": items_per_chip,
         "steps": steps,
+        "distinct_batches": nb,
         "seconds": round(dt, 3),
         "flops_per_step_per_chip": flops_chip,
         "flops_source": flops_src,
         "final_loss": round(final_loss, 4),
+        **extras,
     }
     if peak:
         result["mfu"] = round(flops_chip * steps / dt / peak, 4)
@@ -250,13 +283,46 @@ def run_one(name, builder, steps, batch_override):
 # --- per-config builders -------------------------------------------------
 
 
-def build_resnet50(n_chips, batch_override):
+def _stack_batches(mesh, make_batch, nb=8):
+    """``nb`` distinct host batches stacked on a new leading axis, laid out
+    ``P(None, data)`` — replicated across the cycle axis, data-sharded per
+    batch.  run_one gathers one per step (dynamic index, zero FLOPs)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_models_tpu.core.mesh import AxisNames
+
+    host_batches = [make_batch(i) for i in range(nb)]
+    out = {}
+    for key in host_batches[0]:
+        v = np.stack([b[key] for b in host_batches])
+        sharding = NamedSharding(mesh, P(None, AxisNames.DATA))
+        out[key] = jax.make_array_from_process_local_data(sharding, v)
+    return out
+
+
+def _bench_conv_impl():
+    """Conv lowering for the bench: DTM_CONV_IMPL wins; otherwise 'patches'
+    on TPU — this machine's relay reproducibly wedges on convolution HLO
+    (experiments/TPU_BENCH_r2.md) while the patches lowering is the program
+    class proven to compile — and 'xla' elsewhere."""
+    import jax
+
+    return os.environ.get(
+        "DTM_CONV_IMPL",
+        "patches" if jax.default_backend() == "tpu" else "xla",
+    )
+
+
+def build_resnet50(n_chips, batch_override, steps):
     return _build_classifier(
         "resnet50", 224, batch_override or 256, n_chips, weight_decay=1e-4
     )
 
 
-def build_lenet(n_chips, batch_override):
+def build_lenet(n_chips, batch_override, steps):
     # BASELINE config 1: the reference's single-worker CPU MNIST job — on
     # TPU it mostly measures dispatch overhead, recorded for completeness.
     return _build_classifier(
@@ -265,7 +331,7 @@ def build_lenet(n_chips, batch_override):
     )
 
 
-def build_resnet32(n_chips, batch_override):
+def build_resnet32(n_chips, batch_override, steps):
     # BASELINE config 2: CIFAR-10 ResNet-32 sync-DP.  Also the smallest
     # real conv workload — the relay's conv-compile canary.
     return _build_classifier(
@@ -274,7 +340,7 @@ def build_resnet32(n_chips, batch_override):
     )
 
 
-def build_inception_v3(n_chips, batch_override):
+def build_inception_v3(n_chips, batch_override, steps):
     # The full R5 training step: aux head + label smoothing + L2, RMSProp.
     return _build_classifier(
         "inception_v3",
@@ -305,7 +371,6 @@ def _build_classifier(
     import numpy as np
 
     from distributed_tensorflow_models_tpu.core import mesh as meshlib
-    from distributed_tensorflow_models_tpu.core import sharding as shardlib
     from distributed_tensorflow_models_tpu.core import train_loop
     from distributed_tensorflow_models_tpu.core.train_state import TrainState
     from distributed_tensorflow_models_tpu.models import get_model
@@ -313,7 +378,8 @@ def _build_classifier(
 
     mesh = meshlib.data_parallel_mesh()
     batch_size = per_chip_batch * n_chips
-    model = get_model(model_name)
+    conv_impl = _bench_conv_impl()
+    model = get_model(model_name, conv_impl=conv_impl)
     if rmsprop:
         tx = optim.tf_rmsprop(0.045, decay=0.9, momentum=0.9, epsilon=1.0)
     else:
@@ -335,20 +401,24 @@ def _build_classifier(
             aux_loss_weight=aux_loss_weight,
         )
     )
-    rng = np.random.RandomState(0)
-    batch = shardlib.shard_batch(
-        mesh,
-        {
+
+    def make_batch(i):
+        rng = np.random.RandomState(i)
+        return {
             "image": rng.rand(
                 batch_size, image_size, image_size, channels
             ).astype(np.float32),
             "label": rng.randint(0, num_classes, (batch_size,)),
-        },
+        }
+
+    batches = _stack_batches(mesh, make_batch)
+    return (
+        state, batches, step_fn, per_chip_batch, "images/sec/chip",
+        {"conv_impl": conv_impl},
     )
-    return state, batch, step_fn, per_chip_batch, "images/sec/chip"
 
 
-def build_ptb_lstm(n_chips, batch_override):
+def build_ptb_lstm(n_chips, batch_override, steps):
     """PTB medium at a throughput-mode batch (the reference's batch-20
     config is host-bound by construction; tokens/sec needs the MXU fed).
     Unit is tokens/sec/chip; one item = one token (batch x unroll)."""
@@ -357,7 +427,6 @@ def build_ptb_lstm(n_chips, batch_override):
     import numpy as np
 
     from distributed_tensorflow_models_tpu.core import mesh as meshlib
-    from distributed_tensorflow_models_tpu.core import sharding as shardlib
     from distributed_tensorflow_models_tpu.core import train_loop
     from distributed_tensorflow_models_tpu.core.train_state import TrainState
     from distributed_tensorflow_models_tpu.models import get_model
@@ -381,35 +450,40 @@ def build_ptb_lstm(n_chips, batch_override):
     step_fn = train_loop.make_train_step_fn(
         train_loop.lm_loss_fn(model.apply)
     )
-    rng = np.random.RandomState(0)
-    tokens = rng.randint(0, 10000, (batch_size, num_steps + 1))
-    batch = shardlib.shard_batch(
-        mesh,
-        {
+    def make_batch(i):
+        rng = np.random.RandomState(i)
+        tokens = rng.randint(0, 10000, (batch_size, num_steps + 1))
+        return {
             "inputs": tokens[:, :-1].astype(np.int32),
             "targets": tokens[:, 1:].astype(np.int32),
-        },
+        }
+
+    batches = _stack_batches(mesh, make_batch, nb=max(8, steps))
+    # Uniform random tokens: cross entropy must hover at ln(10000)=9.21 —
+    # there is nothing to learn, so drift outside the corridor means a
+    # broken step, not progress.
+    return (
+        state, batches, step_fn, per_chip_batch * num_steps,
+        "tokens/sec/chip", {"loss_range": (8.0, 10.5)},
     )
-    return state, batch, step_fn, per_chip_batch * num_steps, "tokens/sec/chip"
 
 
-def build_transformer_lm(n_chips, batch_override):
+def build_transformer_lm(n_chips, batch_override, steps):
     """Flagship causal LM at T=512: 8-layer d512, attention via
     ops/attention.py 'auto' (Pallas flash on TPU — tile-aligned seq —
     blockwise elsewhere).  Unit: tokens/sec/chip."""
     return _build_transformer(
-        n_chips, batch_override, T=512, default_batch=16, remat=False
+        n_chips, batch_override, steps, T=512, default_batch=16, remat=False
     )
 
 
-def _build_transformer(n_chips, batch_override, *, T, default_batch, remat):
+def _build_transformer(n_chips, batch_override, steps, *, T, default_batch, remat):
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
 
     from distributed_tensorflow_models_tpu.core import mesh as meshlib
-    from distributed_tensorflow_models_tpu.core import sharding as shardlib
     from distributed_tensorflow_models_tpu.core import train_loop
     from distributed_tensorflow_models_tpu.core.train_state import TrainState
     from distributed_tensorflow_models_tpu.models import get_model
@@ -440,26 +514,30 @@ def _build_transformer(n_chips, batch_override, *, T, default_batch, remat):
     step_fn = train_loop.make_train_step_fn(
         train_loop.lm_loss_fn(model.apply)
     )
-    rng = np.random.RandomState(0)
-    tokens = rng.randint(0, 10000, (batch_size, T + 1))
-    batch = shardlib.shard_batch(
-        mesh,
-        {
+    def make_batch(i):
+        rng = np.random.RandomState(i)
+        tokens = rng.randint(0, 10000, (batch_size, T + 1))
+        return {
             "inputs": tokens[:, :-1].astype(np.int32),
             "targets": tokens[:, 1:].astype(np.int32),
-        },
+        }
+
+    batches = _stack_batches(mesh, make_batch, nb=max(8, steps))
+    # See build_ptb_lstm: random tokens pin the loss to ~ln(10000).
+    return (
+        state, batches, step_fn, per_chip_batch * T, "tokens/sec/chip",
+        {"loss_range": (8.0, 10.5)},
     )
-    return state, batch, step_fn, per_chip_batch * T, "tokens/sec/chip"
 
 
-def build_transformer_lm_long(n_chips, batch_override):
+def build_transformer_lm_long(n_chips, batch_override, steps):
     """Long-context showcase: the same model at T=4096 through the Pallas
     flash kernel (auto on TPU), remat'd blocks — the regime the
     blockwise/flash stack exists for.  At this length an
     O(T^2)-materializing attention would need ~16M-element score buffers
     per head; flash keeps it at O(T·block).  Unit: tokens/sec/chip."""
     return _build_transformer(
-        n_chips, batch_override, T=4096, default_batch=4, remat=True
+        n_chips, batch_override, steps, T=4096, default_batch=4, remat=True
     )
 
 
@@ -922,6 +1000,36 @@ def _orchestrate(args):
 
     head_name = HEADLINE if HEADLINE in results else next(iter(results))
     head = results[head_name]
+    # Full per-config detail goes to a FILE (the round-2 lesson:
+    # BENCH_r02.json ended with "parsed": null because the driver's tail
+    # capture truncated a many-KB stdout line mid-object).  The one stdout
+    # line carries only the headline plus a compact per-config summary —
+    # small enough to survive any tail window.
+    detail_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "experiments",
+        "bench_detail_latest.json",
+    )
+    try:
+        with open(detail_path, "w") as f:
+            json.dump(
+                {"results": results, "errors": errors, "attempts": attempts},
+                f,
+                indent=1,
+            )
+        log(f"full detail written to {detail_path}")
+    except OSError as e:
+        detail_path = None
+        log(f"could not write detail file: {e}")
+    compact = {
+        name: {
+            "value": r["value"],
+            "unit": r["unit"],
+            "platform": r.get("platform"),
+            **({"mfu": r["mfu"]} if r.get("mfu") is not None else {}),
+        }
+        for name, r in results.items()
+    }
     line = {
         "metric": head["metric"],
         "value": head["value"],
@@ -938,10 +1046,13 @@ def _orchestrate(args):
         "device": head.get("device"),
         "n_devices": head.get("n_devices"),
         "attempts": attempts,
-        "all": results,
+        "configs": compact,
+        "detail_file": detail_path,
     }
     if errors:
-        line["config_errors"] = errors
+        line["config_errors"] = {
+            k: str(v)[:120] for k, v in errors.items()
+        }
     emit(line)
 
 

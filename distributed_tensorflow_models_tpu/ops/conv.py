@@ -204,15 +204,16 @@ def _pool(x, window, strides, padding: Padding, impl: str, kind: str):
 
 def max_pool(x, window, strides=None, padding: Padding = "VALID",
              impl: str = "auto"):
-    """``flax.linen.max_pool`` semantics with a selectable lowering."""
-    return _pool(x, window, strides or window, padding, impl, "max")
+    """``flax.linen.max_pool`` semantics (omitted strides = (1, 1), as in
+    flax) with a selectable lowering."""
+    return _pool(x, window, strides or (1, 1), padding, impl, "max")
 
 
 def avg_pool(x, window, strides=None, padding: Padding = "VALID",
              impl: str = "auto"):
-    """``flax.linen.avg_pool`` semantics (count_include_pad) with a
-    selectable lowering."""
-    return _pool(x, window, strides or window, padding, impl, "avg")
+    """``flax.linen.avg_pool`` semantics (count_include_pad; omitted
+    strides = (1, 1), as in flax) with a selectable lowering."""
+    return _pool(x, window, strides or (1, 1), padding, impl, "avg")
 
 
 class Conv2D(nn.Module):
