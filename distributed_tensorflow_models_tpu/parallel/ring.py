@@ -15,8 +15,13 @@ sharding the *sequence* dimension over the ``seq`` mesh axis:
 - :func:`ulysses_attention` — the all-to-all alternative: resharding
   [seq-sharded, all heads] → [full seq, head-sharded] with
   ``lax.all_to_all``, local full-sequence attention, then the inverse
-  resharding.  Cheaper at moderate T (two all-to-alls total), requires
-  ``num_heads % seq_axis_size == 0``.
+  resharding.  Cheaper at moderate T (two all-to-alls total); both the
+  query AND KV head counts must divide the seq-axis size (GQA scatters
+  KV at its native ``H_kv``).
+
+Both handle GQA (``H_kv < H``) without materializing repeated KV: the
+ring folds query groups into rows and rotates KV at ``H_kv`` width; the
+flash ring path maps groups inside the Pallas kernels.
 
 Both are ``shard_map``-wrapped and nest inside an outer ``jax.jit``
 (composable with the data-parallel train step: batch stays sharded over
@@ -99,14 +104,27 @@ def _ring_attention_local(
     q, k, v, *, axis_name: str, causal: bool, scale: Optional[float],
     window: Optional[int] = None,
 ):
-    """Per-device body (inside shard_map): q/k/v are local chunks
-    [B, T_local, H, D]; returns the local output chunk."""
+    """Per-device body (inside shard_map): q local chunk [B, T_local, H, D],
+    k/v ``[B, T_local, H_kv, D]`` (GQA: ``H_kv <= H``); returns the local
+    output chunk.
+
+    GQA never materializes repeated KV: query heads fold into the row
+    dimension — ``[B, H, Tl, D] -> [B, H_kv, g*Tl, D]`` (kv-major head
+    layout, ``h // g`` = kv head, the same mapping as the Pallas kernels'
+    ``_kv_row``) — so scores are one einsum per KV head and the ring
+    rotates KV at its native ``H_kv`` width (g-fold less ICI traffic,
+    exactly GQA's bandwidth advantage)."""
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
+    Hkv = k.shape[2]
+    g = attnlib._group_size(q, k)
     s = attnlib._scale(q, scale)
 
     qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * s  # [B,H,Tl,D]
+    if g > 1:
+        qf = qf.reshape(B, Hkv, g * Tl, D)
+    rows = qf.shape[2]  # g*Tl folded rows; row r sits at position r % Tl
     q_off = my * Tl
 
     # Derive the carries from qf so they inherit its varying-axis type
@@ -134,13 +152,13 @@ def _ring_attention_local(
                 preferred_element_type=jnp.float32,
             )
             if causal or window is not None:
-                qi = q_off + jnp.arange(Tl)[:, None]
+                qi = q_off + (jnp.arange(rows) % Tl)[:, None]
                 kj = kv_off + jnp.arange(Tl)[None, :]
                 valid = qi >= kj if causal else qi == qi
                 if window is not None:
                     valid = valid & (qi - kj < window)
                 s_block = jnp.where(valid, s_block, attnlib.NEG_INF)
-            vb = jnp.swapaxes(v_cur, 1, 2)  # [B,H,Tl,D]
+            vb = jnp.swapaxes(v_cur, 1, 2)  # [B,Hkv,Tl,D]
             return attnlib._block_update((m, l, acc), s_block, vb)
 
         if causal or window is not None:
@@ -168,6 +186,8 @@ def _ring_attention_local(
         body, (m0, l0, a0, k, v), jnp.arange(n)
     )
     out = acc / jnp.maximum(l, 1e-30)
+    if g > 1:
+        out = out.reshape(B, H, Tl, D)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
@@ -201,11 +221,10 @@ def ring_attention(
         raise ValueError(
             f"seq len {q.shape[1]} not divisible by seq axis {n}"
         )
-    if q.shape[2] != k.shape[2]:
-        raise ValueError(
-            "ring attention requires matching q/kv head counts; expand "
-            "GQA KV heads before sharding the sequence"
-        )
+    # GQA (k/v at H_kv < H heads) is native in both impls: the fold path
+    # folds query groups into rows, the flash path maps groups in the
+    # kernels' index maps — KV rotates the ring at H_kv width either way.
+    attnlib._group_size(q, k)  # validates H % H_kv == 0
     # Validate here so the fold path matches flash/blockwise/reference:
     # an unchecked window <= 0 would silently return all-zero output
     # (every score NEG_INF, normalizer clamped).
@@ -286,17 +305,22 @@ def ulysses_attention(
     window: Optional[int] = None,
 ) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style), BTHD
-    global in/out, sequence sharded over ``seq_axis``.  Heads must divide
-    by the seq-axis size."""
+    global in/out, sequence sharded over ``seq_axis``.  Both the query and
+    KV head counts must divide by the seq-axis size.
+
+    GQA: q scatters at ``H`` heads, k/v at their native ``H_kv`` — the
+    all-to-alls move g-fold less KV.  A contiguous head split preserves
+    the ``h // g`` group mapping on every shard (local head ``h'`` on
+    shard ``p`` is global ``p·H/n + h'``, whose kv head is local
+    ``h'//g`` on the same shard), so the local attention sees a
+    self-consistent GQA problem and the per-shard impls handle it."""
     n = mesh.shape[seq_axis]
-    if q.shape[2] % n:
+    H, Hkv = q.shape[2], k.shape[2]
+    attnlib._group_size(q, k)  # validates H % H_kv == 0
+    if H % n or Hkv % n:
         raise ValueError(
-            f"num heads {q.shape[2]} not divisible by seq axis {n}"
-        )
-    if q.shape[2] != k.shape[2]:
-        raise ValueError(
-            "ulysses attention requires matching q/kv head counts (the "
-            "all_to_all scatters the head axis); expand GQA KV first"
+            f"query heads {H} and kv heads {Hkv} must both divide by the "
+            f"seq axis size {n} (the all_to_all splits the head axis)"
         )
     spec = P(data_axis, seq_axis, None, None)
     fn = jax.shard_map(

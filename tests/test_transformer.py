@@ -117,6 +117,46 @@ class TestParallelismEquivalence:
         res = trainlib.fit(tiny_cfg(mesh_model=2), tempfile.mkdtemp())
         assert abs(res.final_metrics["loss"] - dp_loss) < 1e-3
 
+    def test_gqa_ring_matches_gqa_dp(self):
+        """GQA (num_kv_heads < num_heads) through the ring natively: KV
+        shards and rotates at H_kv heads; trajectory must equal the pure
+        DP run of the identical GQA model."""
+        gqa_kwargs = {**TINY, "num_kv_heads": 2}
+        res_dp = trainlib.fit(
+            tiny_cfg(model_kwargs=gqa_kwargs), tempfile.mkdtemp()
+        )
+        res_ring = trainlib.fit(
+            tiny_cfg(model_kwargs=gqa_kwargs, mesh_seq=2, seq_impl="ring"),
+            tempfile.mkdtemp(),
+        )
+        assert (
+            abs(
+                res_ring.final_metrics["loss"]
+                - res_dp.final_metrics["loss"]
+            )
+            < 1e-3
+        )
+
+    def test_gqa_ulysses_matches_gqa_dp(self):
+        """GQA through Ulysses: q all_to_alls at H, KV at H_kv."""
+        gqa_kwargs = {**TINY, "num_kv_heads": 2}
+        res_dp = trainlib.fit(
+            tiny_cfg(model_kwargs=gqa_kwargs), tempfile.mkdtemp()
+        )
+        res_uly = trainlib.fit(
+            tiny_cfg(
+                model_kwargs=gqa_kwargs, mesh_seq=2, seq_impl="ulysses"
+            ),
+            tempfile.mkdtemp(),
+        )
+        assert (
+            abs(
+                res_uly.final_metrics["loss"]
+                - res_dp.final_metrics["loss"]
+            )
+            < 1e-3
+        )
+
     def test_windowed_ring_matches_windowed_dp(self):
         """attn_window under seq_impl: the harness moves the window into
         the sequence-parallel closure (and off the model) — trajectory
