@@ -511,9 +511,14 @@ def _build_transformer(n_chips, batch_override, steps, *, T, default_batch, rema
         model, tx, jax.random.key(0), jnp.zeros((2, T), jnp.int32)
     )
     state = train_loop.place_state(state, mesh)
+    # Fused chunked unembed+xent by default (DTM_FUSED_UNEMBED=0 reverts
+    # to the two-stage head for A/B): the [B*T, V] f32 logits tensor is
+    # the step's HBM-traffic ceiling at these dims.
+    fused = os.environ.get("DTM_FUSED_UNEMBED", "1") != "0"
     step_fn = train_loop.make_train_step_fn(
-        train_loop.lm_loss_fn(model.apply)
+        train_loop.lm_loss_fn(model.apply, fused_unembed=fused)
     )
+
     def make_batch(i):
         rng = np.random.RandomState(i)
         tokens = rng.randint(0, 10000, (batch_size, T + 1))

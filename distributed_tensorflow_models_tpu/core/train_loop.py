@@ -102,8 +102,14 @@ def classification_loss_fn(
     return loss_fn
 
 
-def lm_loss_fn(apply_fn: Callable) -> LossFn:
+def lm_loss_fn(apply_fn: Callable, fused_unembed: bool = False) -> LossFn:
     """Forward + loss for the PTB LSTM (SURVEY.md §2.1 R8).
+
+    ``fused_unembed=True`` routes the head projection + cross entropy
+    through :func:`...ops.losses.chunked_unembed_xent` (the model must
+    accept ``return_hidden=True`` — the transformer does); bfloat16 MXU
+    matmul, f32 accumulation, O(chunk, V) peak memory instead of
+    O(B·T·V).
 
     Batch keys: ``inputs`` and ``targets``, both ``[B, T]`` int32 (targets
     are inputs shifted by one token, the reference PTB reader convention).
@@ -124,17 +130,41 @@ def lm_loss_fn(apply_fn: Callable) -> LossFn:
     """
 
     def loss_fn(params, state, batch, rngs):
-        (logits, new_carry), updated = apply_fn(
-            {"params": params},
-            batch["inputs"],
-            carry=state.carry,
-            train=True,
-            rngs=dict(rngs),
-            mutable=["losses"],
-        )
-        nll = jnp.mean(
-            losslib.softmax_cross_entropy(logits, batch["targets"])
-        )
+        if fused_unembed:
+            # Fused path: the model stops at the post-ln_f hidden states
+            # and the head projection + xent run chunked in one op —
+            # never materializing [B*T, V] f32 logits
+            # (ops/losses.py::chunked_unembed_xent).
+            (hidden, new_carry), updated = apply_fn(
+                {"params": params},
+                batch["inputs"],
+                carry=state.carry,
+                train=True,
+                rngs=dict(rngs),
+                mutable=["losses"],
+                return_hidden=True,
+            )
+            head = params["head"]
+            nll = jnp.mean(
+                losslib.chunked_unembed_xent(
+                    hidden,
+                    head["kernel"],
+                    head.get("bias"),
+                    batch["targets"],
+                )
+            )
+        else:
+            (logits, new_carry), updated = apply_fn(
+                {"params": params},
+                batch["inputs"],
+                carry=state.carry,
+                train=True,
+                rngs=dict(rngs),
+                mutable=["losses"],
+            )
+            nll = jnp.mean(
+                losslib.softmax_cross_entropy(logits, batch["targets"])
+            )
         aux = sum(
             jnp.sum(leaf)
             for leaf in jax.tree_util.tree_leaves(updated.get("losses", {}))
@@ -178,9 +208,16 @@ def make_train_step(
     if donate is None:
         import os
 
-        donate = jax.default_backend() != "cpu" and not os.environ.get(
-            "PALLAS_AXON_POOL_IPS"
-        )
+        # DTM_DONATE=1/0 overrides the auto-detection — the relay's
+        # INVALID_ARGUMENT on aliasing may get fixed upstream, and a
+        # one-env retry is how we find out without a code change.
+        env = os.environ.get("DTM_DONATE")
+        if env is not None:
+            donate = env != "0"
+        else:
+            donate = jax.default_backend() != "cpu" and not os.environ.get(
+                "PALLAS_AXON_POOL_IPS"
+            )
     step_fn = make_train_step_fn(loss_fn, rng_names)
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 

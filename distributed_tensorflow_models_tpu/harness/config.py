@@ -132,6 +132,10 @@ class ExperimentConfig:
     # Named tensor-parallel rule set (parallel/tensor.py RULE_SETS) applied
     # when mesh_model > 1; "" = fully replicated params.
     param_rules: str = ""
+    # Fused chunked unembed+xent for LM configs (transformer only): the
+    # head projection + cross entropy run chunked in one op, never
+    # materializing [B*T, V] f32 logits (ops/losses.py).
+    fused_unembed: bool = False
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

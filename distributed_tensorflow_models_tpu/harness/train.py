@@ -238,7 +238,14 @@ def build_state(cfg: ExperimentConfig, mesh) -> TrainState:
 
 def build_step(cfg: ExperimentConfig, state: TrainState):
     if cfg.task == "lm":
-        loss_fn = train_loop.lm_loss_fn(state.apply_fn)
+        if cfg.fused_unembed and cfg.model != "transformer_lm":
+            raise ValueError(
+                "fused_unembed requires a model with a return_hidden "
+                "path (transformer_lm)"
+            )
+        loss_fn = train_loop.lm_loss_fn(
+            state.apply_fn, fused_unembed=cfg.fused_unembed
+        )
     else:
         loss_fn = train_loop.classification_loss_fn(
             state.apply_fn,

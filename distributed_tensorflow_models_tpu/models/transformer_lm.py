@@ -486,7 +486,15 @@ class TransformerLM(nn.Module):
     rope_theta: float = 10000.0
 
     @nn.compact
-    def __call__(self, tokens, carry=None, train: bool = False):
+    def __call__(
+        self, tokens, carry=None, train: bool = False,
+        return_hidden: bool = False,
+    ):
+        """``return_hidden=True`` returns the post-``ln_f`` hidden states
+        instead of logits, for the fused chunked unembed+xent loss
+        (:func:`...ops.losses.chunked_unembed_xent`) — the head parameters
+        still exist (init uses the default path) and the loss consumes
+        them directly from ``params``."""
         B, T = tokens.shape
         x = nn.Embed(
             self.vocab_size,
@@ -603,6 +611,8 @@ class TransformerLM(nn.Module):
                     name=f"blocks_{i}",
                 )(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_hidden:
+            return x, carry
         logits = nn.Dense(
             self.vocab_size, dtype=jnp.float32, name="head"
         )(x)

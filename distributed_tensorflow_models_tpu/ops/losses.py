@@ -79,3 +79,72 @@ def l2_weight_decay(
         if predicate(path_str(path)):
             total = total + 0.5 * jnp.sum(jnp.square(leaf))
     return scale * total
+
+
+def chunked_unembed_xent(
+    hidden: jax.Array,
+    kernel: jax.Array,
+    bias: jax.Array | None,
+    targets: jax.Array,
+    *,
+    chunk_rows: int = 2048,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    """Per-token NLL of ``Dense(hidden) -> softmax xent`` WITHOUT ever
+    materializing the full ``[B*T, V]`` float32 logits tensor.
+
+    The LM head is the single largest tensor in a small-vocab-model train
+    step (d512/V10k at B16/T512: 328 MB of f32 logits forward plus the
+    same again for the cotangent — more HBM traffic than all transformer
+    blocks combined) and the reference-style two-stage
+    ``logits = head(x); xent(logits)`` forces XLA to spill it.  This op
+    scans over row chunks: each chunk's ``[chunk, V]`` logits live only
+    inside one fused (projection -> logsumexp -> pick) body, the MXU
+    matmul runs in ``compute_dtype`` (bfloat16 — twice the f32 MXU issue
+    rate) with float32 accumulation, and ``jax.checkpoint`` makes the
+    backward recompute chunk logits instead of storing them — peak memory
+    drops from O(B*T*V) to O(chunk_rows*V) in both passes.  The kernel
+    cotangent accumulates across scan iterations automatically.
+
+    Equivalent math to ``softmax_cross_entropy(hidden @ kernel + bias,
+    targets)`` (no label smoothing — LM targets are hard); with
+    ``compute_dtype=float32`` the results agree to float round-off
+    (pinned in tests/test_lm_train.py).
+
+    Args:
+      hidden: ``[B, T, d]`` final hidden states (post-ln_f).
+      kernel: ``[d, V]`` unembedding matrix (the head Dense kernel).
+      bias: ``[V]`` or None.
+      targets: ``[B, T]`` int labels.
+    Returns:
+      ``[B, T]`` per-token negative log likelihood, float32.
+    """
+    B, T, d = hidden.shape
+    n = B * T
+    x = hidden.reshape(n, d)
+    t = targets.reshape(n)
+    c = min(chunk_rows, n)
+    pad = (-n) % c
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        t = jnp.pad(t, (0, pad))
+    xc = x.reshape(-1, c, d).astype(compute_dtype)
+    tc = t.reshape(-1, c)
+    kmat = kernel.astype(compute_dtype)
+    b32 = None if bias is None else bias.astype(jnp.float32)
+
+    @jax.checkpoint
+    def body(carry, inp):
+        xi, ti = inp
+        logits = jax.lax.dot_general(
+            xi, kmat, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if b32 is not None:
+            logits = logits + b32
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, ti[:, None], axis=-1)[:, 0]
+        return carry, lse - picked
+
+    _, nll = jax.lax.scan(body, None, (xc, tc))
+    return nll.reshape(-1)[:n].reshape(B, T)

@@ -69,3 +69,106 @@ def test_carry_is_data_sharded(mesh8):
     model, state = make_state(mesh8)
     for leaf in jax.tree.leaves(state.carry):
         assert leaf.sharding.spec[0] == AxisNames.DATA
+
+
+# ------------------------------------------------- fused unembed + xent
+
+
+def test_chunked_unembed_xent_exact_in_f32():
+    """compute_dtype=f32: fused == two-stage head + xent to float
+    round-off, values and all grads, including a non-dividing chunk."""
+    from distributed_tensorflow_models_tpu.ops import losses as losslib
+
+    rng = np.random.RandomState(0)
+    Bc, Tc, d, V = 2, 7, 16, 33  # B*T=14, chunk 4 -> padded tail
+    hidden = jnp.asarray(rng.randn(Bc, Tc, d).astype(np.float32))
+    kernel = jnp.asarray(rng.randn(d, V).astype(np.float32) * 0.1)
+    bias = jnp.asarray(rng.randn(V).astype(np.float32) * 0.1)
+    targets = jnp.asarray(rng.randint(0, V, (Bc, Tc)))
+
+    def ref(h, k, b):
+        logits = h.reshape(-1, d) @ k + b
+        return jnp.mean(
+            losslib.softmax_cross_entropy(logits, targets.reshape(-1))
+        )
+
+    def fused(h, k, b):
+        return jnp.mean(
+            losslib.chunked_unembed_xent(
+                h, k, b, targets, chunk_rows=4,
+                compute_dtype=jnp.float32,
+            )
+        )
+
+    np.testing.assert_allclose(
+        fused(hidden, kernel, bias), ref(hidden, kernel, bias),
+        rtol=1e-6, atol=1e-6,
+    )
+    g_ref = jax.grad(ref, argnums=(0, 1, 2))(hidden, kernel, bias)
+    g_fus = jax.grad(fused, argnums=(0, 1, 2))(hidden, kernel, bias)
+    for a, b_ in zip(g_ref, g_fus):
+        np.testing.assert_allclose(a, b_, rtol=1e-5, atol=1e-6)
+
+
+def test_chunked_unembed_xent_no_bias():
+    from distributed_tensorflow_models_tpu.ops import losses as losslib
+
+    rng = np.random.RandomState(1)
+    hidden = jnp.asarray(rng.randn(2, 8, 16).astype(np.float32))
+    kernel = jnp.asarray(rng.randn(16, 20).astype(np.float32) * 0.1)
+    targets = jnp.asarray(rng.randint(0, 20, (2, 8)))
+    logits = hidden.reshape(-1, 16) @ kernel
+    ref = losslib.softmax_cross_entropy(logits, targets.reshape(-1))
+    out = losslib.chunked_unembed_xent(
+        hidden, kernel, None, targets, chunk_rows=8,
+        compute_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(
+        out.reshape(-1), ref, rtol=1e-6, atol=1e-6
+    )
+
+
+def test_fused_unembed_fit_matches_two_stage(mesh8, tmp_path):
+    """fused_unembed through fit: same trajectory as the two-stage head
+    within bf16-matmul tolerance (the fused path's only numeric change is
+    the bf16 MXU projection with f32 accumulation)."""
+    from distributed_tensorflow_models_tpu.harness import train as trainlib
+    from distributed_tensorflow_models_tpu.harness.config import get_config
+
+    kwargs = dict(
+        model_kwargs={
+            "num_layers": 2, "num_heads": 4, "d_model": 64,
+            "d_ff": 128, "max_len": 32, "dropout_rate": 0.0,
+        },
+        num_steps=32,
+        global_batch_size=8,
+        train_steps=3,
+        log_every_steps=1,
+        checkpoint_every_secs=1e9,
+    )
+    res_plain = trainlib.fit(
+        get_config("transformer_lm", **kwargs),
+        str(tmp_path / "plain"), mesh=mesh8,
+    )
+    res_fused = trainlib.fit(
+        get_config("transformer_lm", fused_unembed=True, **kwargs),
+        str(tmp_path / "fused"), mesh=mesh8,
+    )
+    assert (
+        abs(
+            res_fused.final_metrics["loss"]
+            - res_plain.final_metrics["loss"]
+        )
+        < 5e-2
+    )
+
+
+def test_fused_unembed_rejects_non_transformer():
+    import pytest
+
+    from distributed_tensorflow_models_tpu.harness import train as trainlib
+    from distributed_tensorflow_models_tpu.harness.config import get_config
+
+    cfg = get_config("ptb_small", fused_unembed=True)
+    with pytest.raises(ValueError, match="fused_unembed"):
+        trainlib.build_step(cfg, state=None)
