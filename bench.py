@@ -437,7 +437,17 @@ def build_ptb_lstm(n_chips, batch_override, steps):
     per_chip_batch = batch_override or 256
     mesh = meshlib.data_parallel_mesh()
     batch_size = per_chip_batch * n_chips
-    model = get_model("ptb_lstm", config="medium")
+    # bf16 compute (f32 cell state — models/ptb_lstm.py) and the fused
+    # chunked head: the f32 head projection alone is HALF this model's
+    # per-token FLOPs.  DTM_LSTM_DTYPE=float32 / DTM_FUSED_UNEMBED=0
+    # revert for A/B.
+    dtype = (
+        jnp.float32
+        if os.environ.get("DTM_LSTM_DTYPE") == "float32"
+        else jnp.bfloat16
+    )
+    fused = os.environ.get("DTM_FUSED_UNEMBED", "1") != "0"
+    model = get_model("ptb_lstm", config="medium", dtype=dtype)
     tx = optax.chain(optim.clip_by_global_norm(5.0), optim.sgd(1.0))
     state = TrainState.create(
         model,
@@ -448,7 +458,7 @@ def build_ptb_lstm(n_chips, batch_override, steps):
     )
     state = train_loop.place_state(state, mesh)
     step_fn = train_loop.make_train_step_fn(
-        train_loop.lm_loss_fn(model.apply)
+        train_loop.lm_loss_fn(model.apply, fused_unembed=fused)
     )
     def make_batch(i):
         rng = np.random.RandomState(i)

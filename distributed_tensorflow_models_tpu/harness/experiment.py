@@ -71,14 +71,7 @@ class ABResult:
 
 def _loss_fn(cfg: ExperimentConfig, state):
     if cfg.task == "lm":
-        if cfg.fused_unembed and cfg.model != "transformer_lm":
-            raise ValueError(
-                "fused_unembed requires a model with a return_hidden "
-                "path (transformer_lm)"
-            )
-        return train_loop.lm_loss_fn(
-            state.apply_fn, fused_unembed=cfg.fused_unembed
-        )
+        return trainlib.build_lm_loss(cfg, state.apply_fn)
     return train_loop.classification_loss_fn(
         state.apply_fn,
         label_smoothing=cfg.label_smoothing,

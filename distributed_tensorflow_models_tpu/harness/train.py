@@ -236,16 +236,27 @@ def build_state(cfg: ExperimentConfig, mesh) -> TrainState:
     )
 
 
+# Models whose __call__ accepts return_hidden (the fused chunked
+# unembed+xent contract).  One list, shared by every loss-building entry
+# point (fit and the A/B experiment).
+FUSED_UNEMBED_MODELS = ("transformer_lm", "ptb_lstm")
+
+
+def build_lm_loss(cfg: ExperimentConfig, apply_fn):
+    """The one place an LM config becomes a loss fn; validates the
+    fused_unembed capability before tracing can produce an opaque
+    TypeError."""
+    if cfg.fused_unembed and cfg.model not in FUSED_UNEMBED_MODELS:
+        raise ValueError(
+            "fused_unembed requires a model with a return_hidden path "
+            f"({', '.join(FUSED_UNEMBED_MODELS)})"
+        )
+    return train_loop.lm_loss_fn(apply_fn, fused_unembed=cfg.fused_unembed)
+
+
 def build_step(cfg: ExperimentConfig, state: TrainState):
     if cfg.task == "lm":
-        if cfg.fused_unembed and cfg.model != "transformer_lm":
-            raise ValueError(
-                "fused_unembed requires a model with a return_hidden "
-                "path (transformer_lm)"
-            )
-        loss_fn = train_loop.lm_loss_fn(
-            state.apply_fn, fused_unembed=cfg.fused_unembed
-        )
+        loss_fn = build_lm_loss(cfg, state.apply_fn)
     else:
         loss_fn = train_loop.classification_loss_fn(
             state.apply_fn,

@@ -56,6 +56,11 @@ class _RecurrentCore(nn.Module):
 
     @nn.compact
     def __call__(self, carry, gx):
+        # Cell state stays float32 whatever the compute dtype: the c
+        # accumulation is a long additive recurrence, exactly the pattern
+        # bf16 destroys (the standard mixed-precision LSTM recipe —
+        # matmuls in bf16 on the MXU, state in f32).  With dtype=float32
+        # this path is bitwise the pre-mixed-precision behavior.
         c, h = carry
         # No bias here: the hoisted ih projection already carries the one
         # gate bias (total parameter count matches the per-gate layout).
@@ -66,11 +71,12 @@ class _RecurrentCore(nn.Module):
             4 * self.hidden_size, dtype=self.dtype, use_bias=False,
             kernel_init=_blockwise_orthogonal,
             name="hh",
-        )(h)
+        )(h.astype(self.dtype))
+        gates = gates.astype(jnp.float32)
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
-        h = jax.nn.sigmoid(o) * jnp.tanh(c)
-        return (c, h), h
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)  # f32, like c
+        return (c, h), h.astype(self.dtype)
 
 
 class PTBLSTM(nn.Module):
@@ -84,8 +90,9 @@ class PTBLSTM(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     def initial_carry(self, batch_size: int) -> Carry:
+        # float32 regardless of compute dtype — see _RecurrentCore.
         zeros = lambda: jnp.zeros(
-            (batch_size, self.hidden_size), self.dtype
+            (batch_size, self.hidden_size), jnp.float32
         )
         return tuple(
             (zeros(), zeros()) for _ in range(self.num_layers)
@@ -93,7 +100,7 @@ class PTBLSTM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, carry: Carry | None = None,
-                 train: bool = False):
+                 train: bool = False, return_hidden: bool = False):
         if carry is None:
             carry = self.initial_carry(tokens.shape[0])
         x = nn.Embed(
@@ -126,6 +133,12 @@ class PTBLSTM(nn.Module):
                 x = nn.Dropout(
                     self.dropout_rate, deterministic=not train
                 )(x)
+        if return_hidden:
+            # Fused chunked unembed+xent path
+            # (ops/losses.py::chunked_unembed_xent): the head projection —
+            # HALF this model's per-token FLOPs (2·h·V vs ~2·8h² for the
+            # LSTM stack at h=650, V=10k) — runs inside the loss instead.
+            return x, tuple(new_carry)
         logits = nn.Dense(
             self.vocab_size, dtype=jnp.float32, name="head"
         )(x)

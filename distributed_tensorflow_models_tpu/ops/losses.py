@@ -134,8 +134,7 @@ def chunked_unembed_xent(
     b32 = None if bias is None else bias.astype(jnp.float32)
 
     @jax.checkpoint
-    def body(carry, inp):
-        xi, ti = inp
+    def one_chunk(xi, ti):
         logits = jax.lax.dot_general(
             xi, kmat, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -144,7 +143,15 @@ def chunked_unembed_xent(
             logits = logits + b32
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         picked = jnp.take_along_axis(logits, ti[:, None], axis=-1)[:, 0]
-        return carry, lse - picked
+        return lse - picked
 
-    _, nll = jax.lax.scan(body, None, (xc, tc))
-    return nll.reshape(-1)[:n].reshape(B, T)
+    # Static Python unroll, NOT lax.scan: XLA's cost analysis visits a
+    # scan body once regardless of trip count (see bench.py
+    # _flops_per_step_global), so a scanned head would silently vanish
+    # from FLOPs/MFU accounting.  The chunk count is small and static
+    # (B*T/chunk_rows); each body stays checkpointed, so backward
+    # recomputes chunk logits either way.
+    nll = jnp.concatenate(
+        [one_chunk(xc[i], tc[i]) for i in range(xc.shape[0])]
+    )
+    return nll[:n].reshape(B, T)
