@@ -222,10 +222,21 @@ class InceptionV3(nn.Module):
     aux_head: bool = True
     dtype: jnp.dtype = jnp.bfloat16
     conv_impl: str = "auto"
+    # Rematerialize each Inception/Reduction block in backward — the same
+    # im2col-residual lever as ResNet.remat (patches lowering saves 9x+
+    # conv-input buffers per block otherwise).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         c = partial(ConvBN, dtype=self.dtype, impl=self.conv_impl)
+        wrap = (
+            (lambda cls: nn.remat(cls, static_argnums=(2,)))
+            if self.remat
+            else (lambda cls: cls)
+        )
+        IncA, IncB, IncC = wrap(InceptionA), wrap(InceptionB), wrap(InceptionC)
+        RedA, RedB = wrap(ReductionA), wrap(ReductionB)
         pool = partial(
             max_pool, window=(3, 3), strides=(2, 2), padding="VALID",
             impl=self.conv_impl,
@@ -241,15 +252,15 @@ class InceptionV3(nn.Module):
         x = pool(x)
         # 35x35.
         ci = self.conv_impl
-        x = InceptionA(32, self.dtype, ci, name="Mixed_5b")(x, train=train)
-        x = InceptionA(64, self.dtype, ci, name="Mixed_5c")(x, train=train)
-        x = InceptionA(64, self.dtype, ci, name="Mixed_5d")(x, train=train)
-        x = ReductionA(self.dtype, ci, name="Mixed_6a")(x, train=train)
+        x = IncA(32, self.dtype, ci, name="Mixed_5b")(x, train)
+        x = IncA(64, self.dtype, ci, name="Mixed_5c")(x, train)
+        x = IncA(64, self.dtype, ci, name="Mixed_5d")(x, train)
+        x = RedA(self.dtype, ci, name="Mixed_6a")(x, train)
         # 17x17.
-        x = InceptionB(128, self.dtype, ci, name="Mixed_6b")(x, train=train)
-        x = InceptionB(160, self.dtype, ci, name="Mixed_6c")(x, train=train)
-        x = InceptionB(160, self.dtype, ci, name="Mixed_6d")(x, train=train)
-        x = InceptionB(192, self.dtype, ci, name="Mixed_6e")(x, train=train)
+        x = IncB(128, self.dtype, ci, name="Mixed_6b")(x, train)
+        x = IncB(160, self.dtype, ci, name="Mixed_6c")(x, train)
+        x = IncB(160, self.dtype, ci, name="Mixed_6d")(x, train)
+        x = IncB(192, self.dtype, ci, name="Mixed_6e")(x, train)
         aux = None
         if self.aux_head:
             # Run (not just declare) the aux head regardless of mode so a
@@ -262,10 +273,10 @@ class InceptionV3(nn.Module):
             aux = AuxHead(
                 self.num_classes, self.dtype, self.conv_impl, name="AuxHead"
             )(x, train=train)
-        x = ReductionB(self.dtype, ci, name="Mixed_7a")(x, train=train)
+        x = RedB(self.dtype, ci, name="Mixed_7a")(x, train)
         # 8x8.
-        x = InceptionC(self.dtype, ci, name="Mixed_7b")(x, train=train)
-        x = InceptionC(self.dtype, ci, name="Mixed_7c")(x, train=train)
+        x = IncC(self.dtype, ci, name="Mixed_7b")(x, train)
+        x = IncC(self.dtype, ci, name="Mixed_7c")(x, train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = x.astype(jnp.float32)

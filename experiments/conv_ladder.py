@@ -14,11 +14,15 @@ poisons the backend for every later rung anyway).
 Usage: python experiments/conv_ladder.py [--timeout 420] [--out FILE]
 """
 
+# Runnable from anywhere (same idiom as recompute_mfu.py).
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import json
-import os
 import subprocess
-import sys
 import time
 
 RUNGS = {

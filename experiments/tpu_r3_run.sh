@@ -1,0 +1,121 @@
+#!/bin/bash
+# Round-3 auto-runner for the moment the axon relay answers.
+#
+# Priority order = evidence value per minute of healthy-relay time, under
+# the standing constraint that NATIVE conv HLO wedges the relay
+# (experiments/TPU_BENCH_r2.md) while matmul-class programs compile:
+#
+#   1. ResNet-50 through the PATCHES lowering (matmul-only HLO — the
+#      relay-safe route to the BASELINE.json:5 headline), batch ladder
+#      smallest-first so something banks even if a later size OOMs.
+#   2. Inception-v3 patches ladder (the other headline conv model).
+#   3. Transformer LM fused + unfused head (the MFU #3 A/B).
+#   4. PTB LSTM bf16+fused and the r2-comparable f32 two-stage variant.
+#   5. flash_check (re-time the overhauled Pallas kernel — VERDICT #2).
+#   6. Long-context + decode.
+#   7. LeNet/ResNet-32 patches (completes the conv-family coverage).
+#   8. The named flagship A/B on TPU (patches, modest steps).
+#   9. Convergence artifacts on hardware.
+#  10. NATIVE conv ladder LAST — pure diagnosis; a wedge here costs
+#      nothing already banked.
+#
+# Every bench runs in its own subprocess (bench.py --child isolation via
+# --config) with a timeout; every artifact is written before the next
+# config starts.
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+R=r3
+echo "$(date) [$R] runner started" >> "$LOG"
+
+# Poll for recovery.  The platform assert keeps a CPU fallback from
+# counting as recovery (the benches below must record TPU numbers only).
+while ! timeout 90 python -c \
+    "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    >/dev/null 2>&1; do
+    sleep 600
+done
+date > /tmp/tpu_alive
+echo "$(date) [$R] backend ANSWERED" >> "$LOG"
+
+bench_one() {  # name outfile [extra bench args...]
+    local name="$1" out="$2"; shift 2
+    echo "$(date) [$R] bench $name -> $out $*" >> "$LOG"
+    timeout 1500 python bench.py --config "$name" --no-probe "$@" \
+        > "experiments/$out" 2>> "$LOG"
+    local rc=$?
+    echo "$(date) [$R] bench $name rc=$rc $(tail -c 300 "experiments/$out" 2>/dev/null)" >> "$LOG"
+    return $rc
+}
+
+# 1+2. Conv headliners through patches, batch ladder smallest-first.
+for b in 32 64 128 256; do
+    bench_one resnet50 "tpu_r3_resnet50_b${b}.json" --batch "$b" || break
+done
+for b in 16 32 64 128; do
+    bench_one inception_v3 "tpu_r3_inception_b${b}.json" --batch "$b" || break
+done
+
+# 3. Transformer MFU A/B: fused (default) vs two-stage head.
+bench_one transformer_lm "tpu_r3_transformer_fused.json"
+( export DTM_FUSED_UNEMBED=0; bench_one transformer_lm "tpu_r3_transformer_twostage.json" )
+# Bigger batch often lifts MFU at d512/T512 — record the landscape.
+for b in 32 64; do
+    bench_one transformer_lm "tpu_r3_transformer_fused_b${b}.json" --batch "$b"
+done
+
+# 4. LSTM: bf16+fused (new default) vs the r2-comparable f32 two-stage.
+bench_one ptb_lstm "tpu_r3_ptb_bf16_fused.json"
+( export DTM_LSTM_DTYPE=float32 DTM_FUSED_UNEMBED=0
+  bench_one ptb_lstm "tpu_r3_ptb_f32_twostage.json" )
+for b in 512; do
+    bench_one ptb_lstm "tpu_r3_ptb_bf16_fused_b${b}.json" --batch "$b"
+done
+
+# 5. Flash kernel re-time (bf16 + FA2 backward + block sweep).
+bench_one flash_check "tpu_r3_flash_check.json"
+
+# 6. Long context + decode.
+bench_one transformer_lm_long "tpu_r3_transformer_long.json"
+bench_one decode "tpu_r3_decode.json"
+
+# 7. Small convs (patches).
+bench_one lenet "tpu_r3_lenet.json"
+bench_one resnet32 "tpu_r3_resnet32.json"
+
+# 8. Flagship A/B on TPU: ResNet-50 patches, synthetic ImageNet input.
+echo "$(date) [$R] flagship A/B" >> "$LOG"
+timeout 3000 python experiments/run_ab.py --config resnet50_synthetic \
+    --steps 40 --batch 16 --workers 4 --conv-impl patches --tag tpu \
+    >> "$LOG" 2>&1
+echo "$(date) [$R] flagship A/B rc=$?" >> "$LOG"
+
+# 9. Convergence on hardware (matmul-only configs).
+for cconf in ptb_small transformer_lm; do
+    echo "$(date) [$R] $cconf convergence" >> "$LOG"
+    timeout 2400 python experiments/run_convergence.py --config "$cconf" \
+        --steps 2000 >> "$LOG" 2>&1
+    rc=$?
+    echo "$(date) [$R] $cconf convergence rc=$rc" >> "$LOG"
+    if [ "$rc" -eq 0 ]; then
+        for ext in json md; do
+            for f in experiments/convergence_${cconf}.$ext \
+                     experiments/CONVERGENCE_${cconf}.$ext; do
+                [ -f "$f" ] && mv "$f" "${f%.$ext}_tpu.$ext"
+            done
+        done
+    fi
+    # A mid-write failure must not leave TPU numbers under the committed
+    # CPU artifact's filename.
+    git checkout -- "experiments/convergence_${cconf}.json" \
+        "experiments/CONVERGENCE_${cconf}.md" 2>/dev/null
+done
+
+# 10. NATIVE conv ladder, dead last (this is the thing that wedges).
+echo "$(date) [$R] native conv ladder" >> "$LOG"
+DTM_CONV_IMPL=xla python experiments/conv_ladder.py --timeout 420 \
+    --out experiments/conv_ladder_r3.json >> "$LOG" 2>&1
+echo "$(date) [$R] native conv ladder rc=$?" >> "$LOG"
+
+echo "$(date) [$R] runner DONE" >> "$LOG"
+touch /tmp/tpu_r3_done
