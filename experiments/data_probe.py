@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Probe the machine for real datasets and write DATA_AVAILABILITY.md.
+
+Every convergence/A-B artifact in this repo is honest about running on
+synthetic data; this probe is the companion evidence that real data was
+actually *looked for* (VERDICT r2 "Missing #5": the accuracy-parity
+corridors in SURVEY.md §6 are untestable without MNIST/CIFAR/ImageNet/PTB
+on disk, and the repo should document that fact rather than assert it).
+
+Checks the exact paths the dataset loaders read (data/datasets.py):
+  - $DTM_DATA_DIR (default /root/data)/mnist.npz
+  - .../cifar10.npz
+  - .../imagenet/train-* + validation-* TFRecord shards
+  - .../ptb.{train,valid,test}.txt
+and records sizes/counts for whatever exists.
+"""
+# Runnable from anywhere (same idiom as recompute_mfu.py).
+import glob
+import json
+import os
+import sys
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_tensorflow_models_tpu.data.datasets import DATA_DIR  # noqa: E402
+
+
+def probe():
+    checks = {}
+
+    def record(name, paths, found, detail=""):
+        checks[name] = {
+            "paths_checked": paths,
+            "found": found,
+            "detail": detail,
+        }
+
+    # MNIST
+    p = os.path.join(DATA_DIR, "mnist.npz")
+    record("mnist", [p], os.path.isfile(p),
+           f"{os.path.getsize(p)} bytes" if os.path.isfile(p) else "")
+
+    # CIFAR-10 (loader reads one npz — datasets.py::load_cifar10)
+    p = os.path.join(DATA_DIR, "cifar10.npz")
+    record("cifar10", [p], os.path.isfile(p),
+           f"{os.path.getsize(p)} bytes" if os.path.isfile(p) else "")
+
+    # ImageNet TFRecords.  The loader falls back to synthetic PER SPLIT
+    # (harness/train.py), so either split alone counts as "found" — the
+    # detail records the per-split truth.
+    tr = sorted(glob.glob(os.path.join(DATA_DIR, "imagenet", "train-*")))
+    va = sorted(glob.glob(os.path.join(DATA_DIR, "imagenet", "validation-*")))
+    record(
+        "imagenet",
+        [os.path.join(DATA_DIR, "imagenet", "{train,validation}-*")],
+        bool(tr) or bool(va),
+        f"{len(tr)} train / {len(va)} validation shards",
+    )
+
+    # PTB (loader reads DATA_DIR/ptb.{split}.txt —
+    # datasets.py::load_ptb_tokens)
+    ptb = [
+        os.path.join(DATA_DIR, f"ptb.{s}.txt")
+        for s in ("train", "valid", "test")
+    ]
+    record("ptb", ptb, all(os.path.isfile(p) for p in ptb))
+
+    return {
+        "data_dir": DATA_DIR,
+        "data_dir_exists": os.path.isdir(DATA_DIR),
+        "network_egress": _probe_egress(),
+        "timestamp": datetime.now(timezone.utc).isoformat(),
+        "datasets": checks,
+    }
+
+
+def _probe_egress(timeout=5.0):
+    """Measured, not assumed: can this machine complete a real outbound
+    HTTP fetch?  A bare TCP connect is NOT evidence — this machine's
+    transparent proxy accepts the handshake and then walls the request
+    (DNS fails, raw-IP HTTP returns 403) — so the probe requires an
+    end-to-end 2xx/3xx response, which is what fetching a dataset would
+    need."""
+    import urllib.request
+
+    for url in ("http://example.com/", "https://example.com/"):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                if 200 <= r.status < 400:
+                    return True
+        except Exception:  # noqa: BLE001 — any failure means no egress
+            continue
+    return False
+
+
+def main():
+    result = probe()
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "data_probe.json"), "w") as f:
+        json.dump(result, f, indent=1)
+
+    any_found = any(d["found"] for d in result["datasets"].values())
+    lines = [
+        "# Data availability on this machine",
+        "",
+        f"Probed {result['timestamp']} by `experiments/data_probe.py`.",
+        f"`DTM_DATA_DIR` resolves to `{result['data_dir']}` "
+        f"(directory {'exists' if result['data_dir_exists'] else 'ABSENT'}).",
+        f"Outbound network egress (measured by end-to-end HTTP fetch): "
+        f"{'yes' if result['network_egress'] else 'no'}.",
+        "",
+        "| dataset | found | paths checked | detail |",
+        "|---|---|---|---|",
+    ]
+    for name, d in result["datasets"].items():
+        lines.append(
+            f"| {name} | {'YES' if d['found'] else 'no'} | "
+            f"`{'`, `'.join(d['paths_checked'])}` | {d['detail']} |"
+        )
+    lines += [
+        "",
+        (
+            "Real data present — convergence/accuracy artifacts can (and "
+            "should) use it."
+            if any_found
+            else
+            "No real dataset is present on this machine"
+            + (
+                " and the measured egress probe also failed, so none can "
+                "be fetched"
+                if not result["network_egress"]
+                else " (egress exists — data could in principle be "
+                "fetched, but no fetcher runs unattended here)"
+            )
+            + ".  The SURVEY.md §6 accuracy corridors (ResNet-50 75.9% "
+            "top-1, PTB valid perplexity ~86) remain untestable here.  "
+            "Every convergence/A-B artifact in this directory therefore "
+            "runs on the deterministic synthetic substitutes from "
+            "`data/datasets.py` and says so in its header; loaders switch "
+            "to real data automatically the moment it appears under "
+            "`DTM_DATA_DIR`."
+        ),
+        "",
+    ]
+    with open(os.path.join(here, "DATA_AVAILABILITY.md"), "w") as f:
+        f.write("\n".join(lines))
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

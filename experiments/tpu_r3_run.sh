@@ -59,6 +59,11 @@ done
 # 3. Transformer MFU A/B: fused (default) vs two-stage head.
 bench_one transformer_lm "tpu_r3_transformer_fused.json"
 ( export DTM_FUSED_UNEMBED=0; bench_one transformer_lm "tpu_r3_transformer_twostage.json" )
+# End-to-end attention-impl A/B: auto routes to the Pallas flash kernel
+# on TPU; this run pins XLA blockwise so the r2 "flash 0.86x" question is
+# settled at the model level, not just the microbench.
+( export DTM_BENCH_ATTN_IMPL=blockwise
+  bench_one transformer_lm "tpu_r3_transformer_fused_blockattn.json" )
 # Bigger batch often lifts MFU at d512/T512 — record the landscape.
 for b in 32 64; do
     bench_one transformer_lm "tpu_r3_transformer_fused_b${b}.json" --batch "$b"
