@@ -55,6 +55,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="attention kernel (auto = Pallas flash on TPU)",
     )
     p.add_argument(
+        "--fused-unembed", action="store_true", default=None,
+        help="fuse the LM head projection + cross entropy (chunked bf16 "
+        "matmul, no [B*T, V] f32 logits tensor — ops/losses.py)",
+    )
+    p.add_argument(
         "--multihost", action="store_true",
         help="initialize jax.distributed (multi-host SPMD)",
     )
@@ -75,6 +80,7 @@ def _overrides(args) -> dict:
         ("mesh_expert", "mesh_expert"),
         ("seq_impl", "seq_impl"),
         ("attn_impl", "attn_impl"),
+        ("fused_unembed", "fused_unembed"),
     ):
         if getattr(args, attr, None) is not None:
             out[key] = getattr(args, attr)
@@ -112,6 +118,10 @@ def main(argv: list[str] | None = None) -> int:
     p_ab.add_argument("--batch-size", type=int, default=None)
     p_ab.add_argument("--seed", type=int, default=None)
     p_ab.add_argument("--mesh-model", type=int, default=None)
+    p_ab.add_argument(
+        "--fused-unembed", action="store_true", default=None,
+        help="fused chunked LM head in both arms (LM configs)",
+    )
     p_ab.add_argument("--multihost", action="store_true")
     # Shared override plumbing (_overrides) expects these attributes.
     p_ab.set_defaults(train_steps=None, workdir=None)

@@ -58,13 +58,19 @@ def probe():
         f"{len(tr)} train / {len(va)} validation shards",
     )
 
-    # PTB (loader reads DATA_DIR/ptb.{split}.txt —
-    # datasets.py::load_ptb_tokens)
+    # PTB (loader reads DATA_DIR/ptb.{split}.txt and goes real for any
+    # split whose file exists alongside ptb.train.txt —
+    # datasets.py::load_ptb_tokens — so the train file alone means real
+    # data is in use; the detail records the per-split truth).
     ptb = [
         os.path.join(DATA_DIR, f"ptb.{s}.txt")
         for s in ("train", "valid", "test")
     ]
-    record("ptb", ptb, all(os.path.isfile(p) for p in ptb))
+    present = [os.path.basename(p) for p in ptb if os.path.isfile(p)]
+    record(
+        "ptb", ptb, os.path.isfile(ptb[0]),
+        f"present: {', '.join(present) or 'none'}",
+    )
 
     return {
         "data_dir": DATA_DIR,
