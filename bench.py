@@ -599,7 +599,11 @@ def run_decode(args):
     Decode is latency-shaped work (matmul panels of batch rows against
     the weights, cache gathers), so tokens/sec here is NOT comparable to
     training tokens/sec — it is the serving-side metric.  Matmul-only:
-    safe for this relay (no conv compiles)."""
+    safe for this relay (no conv compiles).
+
+    Times TWO cache layouts: MHA (8 KV heads) and GQA (2 KV heads, a
+    4x-smaller cache) — decode is cache-bandwidth-bound, so the GQA
+    speedup is the direct measurement of that claim."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -609,56 +613,74 @@ def run_decode(args):
 
     B = args.batch or 8
     T_prompt, T_new = 64, 192
-    model = get_model(
-        "transformer_lm",
-        num_layers=8,
-        num_heads=8,
-        d_model=512,
-        d_ff=2048,
-        max_len=T_prompt + T_new,
-        dropout_rate=0.0,
-    )
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(rng.randint(0, 10000, (B, T_prompt)), jnp.int32)
-    params = model.init(jax.random.key(0), prompt[:, :8])["params"]
-
-    fn = jax.jit(lambda p, t: generate(model, p, t, T_new))
-    # Prefill-only run (1 new token ~= the prompt pass + one sample):
-    # subtracted out so the reported numbers are decode-step latency, not
-    # prefill amortization.
-    fn_prefill = jax.jit(lambda p, t: generate(model, p, t, 1))
 
     # Each sample is already 191 decode steps, but the prefill subtraction
     # amplifies single-run jitter — take the min over a few repeats (the
     # standard noise floor estimator; every other config here averages
     # over its fused scan for the same reason).
     repeats = 3
-
-    def timed(f, label):
-        t0 = time.time()
-        np.asarray(f(params, prompt))  # readback = the only real sync
-        log(f"decode {label}: compiled+first run in {time.time()-t0:.1f}s")
-        best = float("inf")
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            np.asarray(f(params, prompt))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    dt_prefill = timed(fn_prefill, "prefill")
-    dt_full = timed(fn, "full")
-    dt_decode = max(dt_full - dt_prefill, 1e-9)
     steps = T_new - 1  # tokens produced by the scan, prefill excluded
+
+    def measure(num_kv_heads):
+        model = get_model(
+            "transformer_lm",
+            num_layers=8,
+            num_heads=8,
+            d_model=512,
+            d_ff=2048,
+            max_len=T_prompt + T_new,
+            dropout_rate=0.0,
+            num_kv_heads=num_kv_heads,
+        )
+        params = model.init(jax.random.key(0), prompt[:, :8])["params"]
+
+        fn = jax.jit(lambda p, t: generate(model, p, t, T_new))
+        # Prefill-only run (1 new token ~= the prompt pass + one
+        # sample): subtracted out so the reported numbers are
+        # decode-step latency, not prefill amortization.
+        fn_prefill = jax.jit(lambda p, t: generate(model, p, t, 1))
+
+        def timed(f, label):
+            t0 = time.time()
+            np.asarray(f(params, prompt))  # readback = the only real sync
+            log(
+                f"decode kv{num_kv_heads} {label}: compiled+first run "
+                f"in {time.time()-t0:.1f}s"
+            )
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                np.asarray(f(params, prompt))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        dt_prefill = timed(fn_prefill, "prefill")
+        dt_full = timed(fn, "full")
+        dt_decode = max(dt_full - dt_prefill, 1e-9)
+        return dt_decode, {
+            "tokens_per_sec": round(B * steps / dt_decode, 1),
+            "seconds_total": round(dt_full, 3),
+            "seconds_prefill": round(dt_prefill, 3),
+            "ms_per_token_step": round(dt_decode / steps * 1e3, 3),
+        }
+
+    mha_dt, mha = measure(num_kv_heads=0)  # 0 = MHA (8 KV heads)
+    gqa_dt, gqa = measure(num_kv_heads=2)  # 4x smaller cache
     return {
         "metric": "transformer_lm_decode_throughput",
-        "value": round(B * steps / dt_decode, 1),
+        "value": mha["tokens_per_sec"],
         "unit": "tokens/sec/chip",
         "batch": B,
         "prompt_len": T_prompt,
         "new_tokens": T_new,
-        "seconds_total": round(dt_full, 3),
-        "seconds_prefill": round(dt_prefill, 3),
-        "ms_per_token_step": round(dt_decode / steps * 1e3, 3),
+        **{f"mha_{k}": v for k, v in mha.items()},
+        **{f"gqa_kv2_{k}": v for k, v in gqa.items()},
+        # Ratio from the UNROUNDED clamped times: the 1e-9 clamp can
+        # round a display value to 0.0, and a ratio of two 3-decimal
+        # numbers loses precision anyway.
+        "gqa_speedup": round(mha_dt / gqa_dt, 3),
     }
 
 
