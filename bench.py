@@ -326,12 +326,16 @@ def _bench_conv_impl():
 def build_resnet50(n_chips, batch_override, steps):
     # Under the patches lowering, remat each block: the im2col buffers
     # (9x the 3x3-conv inputs) would otherwise all be stored as backward
-    # residuals — several GB at batch 256.
-    extra = (
-        {"remat": True} if _bench_conv_impl() == "patches" else {}
-    )
+    # residuals — several GB at batch 256.  Default batch is also halved
+    # there: the im2col transients put b256 near the 16 GB HBM edge, and
+    # if the relay's first healthy window IS the driver's bench run, an
+    # OOM would cost the headline number (the r3 runner's batch ladder
+    # probes larger sizes separately).
+    patches = _bench_conv_impl() == "patches"
+    extra = {"remat": True} if patches else {}
     return _build_classifier(
-        "resnet50", 224, batch_override or 256, n_chips, weight_decay=1e-4,
+        "resnet50", 224, batch_override or (128 if patches else 256),
+        n_chips, weight_decay=1e-4,
         model_extra=extra,
     )
 
@@ -659,12 +663,17 @@ def run_decode(args):
         dt_prefill = timed(fn_prefill, "prefill")
         dt_full = timed(fn, "full")
         dt_decode = max(dt_full - dt_prefill, 1e-9)
-        return dt_decode, {
+        out = {
             "tokens_per_sec": round(B * steps / dt_decode, 1),
             "seconds_total": round(dt_full, 3),
             "seconds_prefill": round(dt_prefill, 3),
             "ms_per_token_step": round(dt_decode / steps * 1e3, 3),
         }
+        # Bank each arm's numbers on stderr the moment they exist: if
+        # the second arm wedges the relay or blows the config timeout,
+        # the first arm's measurement survives in the captured log.
+        log(f"decode kv{num_kv_heads} result: {json.dumps(out)}")
+        return dt_decode, out
 
     mha_dt, mha = measure(num_kv_heads=0)  # 0 = MHA (8 KV heads)
     gqa_dt, gqa = measure(num_kv_heads=2)  # 4x smaller cache
