@@ -78,6 +78,10 @@ ANALYTIC_TRAIN_FLOPS_PER_ITEM = {
     # 3136x1024 (3.2M), x2 FLOPs/MAC ~= 27.8M fwd
     "lenet": 3 * 2.78e7,
     "resnet32": 3 * 1.4e8,  # CIFAR ResNet-32 (6n+2, n=5) @32
+    # VGG-16 @224: ~15.3 GMACs fwd -> 30.5 GFLOPs (XLA cost analysis of
+    # the full step measured 91.5 GFLOP/image = 3x this).
+    "vgg16": 3 * 30.5e9,
+    "alexnet": 3 * 1.41e9,  # alexnet_v2 @224 (~0.7 GMACs fwd), same check
     "ptb_lstm": 3 * 2.65e7,  # medium: 2 LSTM layers 4*650*1300 MACs + head
     # 8L x d512 transformer @T512: ~6*12*L*d^2 + attention terms per token
     "transformer_lm": 3 * 6.0e7,
@@ -456,6 +460,26 @@ def _build_classifier(
         extras["remat"] = True
     return (
         state, batches, step_fn, per_chip_batch, "images/sec/chip", extras,
+    )
+
+
+def build_vgg16(n_chips, batch_override, steps):
+    # R7 throughput model #1 (SURVEY.md §2.1): huge dense gradients.  No
+    # remat attr on the plain sequential stack, so the patches default
+    # batch stays small enough that the im2col backward residuals
+    # (~3.9 GB at b16) fit beside the 500 MB of fc weights + opt state.
+    patches = _bench_conv_impl() == "patches"
+    return _build_classifier(
+        "vgg16", 224, batch_override or (16 if patches else 64),
+        n_chips, weight_decay=5e-4,
+    )
+
+
+def build_alexnet(n_chips, batch_override, steps):
+    # R7 throughput model #2: the 11x11/4 stem collapses spatial size
+    # fast, so even the patches lowering is light.
+    return _build_classifier(
+        "alexnet", 224, batch_override or 128, n_chips, weight_decay=5e-4,
     )
 
 
@@ -852,6 +876,8 @@ BUILDERS = {
     "inception_v3": build_inception_v3,
     "lenet": build_lenet,
     "resnet32": build_resnet32,
+    "vgg16": build_vgg16,
+    "alexnet": build_alexnet,
     "ptb_lstm": build_ptb_lstm,
     "transformer_lm": build_transformer_lm,
     "transformer_lm_long": build_transformer_lm_long,
@@ -873,6 +899,10 @@ ORDER = [
     "resnet32",
     "resnet50",
     "inception_v3",
+    # R7 throughput models last: worthwhile but junior to the headline
+    # pair, and the watchdog now emits partial results if they run long.
+    "alexnet",
+    "vgg16",
 ]
 CHILD_MODES = sorted(BUILDERS) + ["flash_check", "decode"]
 
@@ -959,11 +989,21 @@ def main():
 
 def _orchestrate(args):
     run_info = {"attempts": 1}
+    # Defined BEFORE the alarm is armed: the watchdog must emit whatever
+    # has already been banked, not discard finished configs (a partial
+    # result line beats a bare failure every time — the headline may
+    # already be in it).
+    results, errors = {}, {}
 
     def on_alarm(signum, frame):
-        emit_failure(
-            f"watchdog expired after {args.watchdog}s", run_info["attempts"]
-        )
+        if results:
+            errors["_watchdog"] = f"expired after {args.watchdog}s"
+            _emit_final(results, errors, run_info["attempts"])
+        else:
+            emit_failure(
+                f"watchdog expired after {args.watchdog}s",
+                run_info["attempts"],
+            )
         os._exit(2)
 
     signal.signal(signal.SIGALRM, on_alarm)
@@ -1002,7 +1042,6 @@ def _orchestrate(args):
             f"CPU fallback: shrinking workload to steps={args.steps}, "
             f"batch={args.batch}/chip"
         )
-    results, errors = {}, {}
     for name in names:
         # Each config runs in its own subprocess: a wedged backend call
         # (e.g. a hung remote compile) blocks in C++ where no in-process
@@ -1077,10 +1116,14 @@ def _orchestrate(args):
         else:
             log(f"{name}: {results[name]}")
 
+    signal.alarm(0)
     if not results:
         emit_failure(f"all configs failed: {errors}", attempts)
         sys.exit(1)
+    _emit_final(results, errors, attempts)
 
+
+def _emit_final(results, errors, attempts):
     head_name = HEADLINE if HEADLINE in results else next(iter(results))
     head = results[head_name]
     # Full per-config detail goes to a FILE (the round-2 lesson:

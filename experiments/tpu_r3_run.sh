@@ -116,7 +116,12 @@ for cconf in ptb_small transformer_lm; do
         "experiments/CONVERGENCE_${cconf}.md" 2>/dev/null
 done
 
-# 10. NATIVE conv ladder, dead last (this is the thing that wedges).
+# 10. R7 throughput pair — junior to everything above (vgg16 is the
+#     heaviest new conv program; keep it off the critical path).
+bench_one alexnet "tpu_r3_alexnet.json"
+bench_one vgg16 "tpu_r3_vgg16.json"
+
+# 11. NATIVE conv ladder, dead last (this is the thing that wedges).
 echo "$(date) [$R] native conv ladder" >> "$LOG"
 DTM_CONV_IMPL=xla python experiments/conv_ladder.py --timeout 420 \
     --out experiments/conv_ladder_r3.json >> "$LOG" 2>&1
