@@ -961,7 +961,10 @@ def main():
         "--watchdog",
         type=float,
         default=3300.0,
-        help="whole-run wall-clock limit (s); emits error JSON on expiry",
+        help="whole-run wall-clock limit (s); on expiry emits the "
+        "partial per-config results banked so far (config_errors gains a "
+        "_watchdog entry, exit code 2), or an error JSON if nothing "
+        "finished",
     )
     p.add_argument(
         "--no-probe",

@@ -16,7 +16,9 @@
 #   7. LeNet/ResNet-32 patches (completes the conv-family coverage).
 #   8. The named flagship A/B on TPU (patches, modest steps).
 #   9. Convergence artifacts on hardware.
-#  10. NATIVE conv ladder LAST — pure diagnosis; a wedge here costs
+#  10. The R7 throughput pair (AlexNet/VGG-16 patches) — junior to all
+#      of the above.
+#  11. NATIVE conv ladder LAST — pure diagnosis; a wedge here costs
 #      nothing already banked.
 #
 # Every bench runs in its own subprocess (bench.py --child isolation via

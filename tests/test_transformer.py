@@ -157,6 +157,20 @@ class TestParallelismEquivalence:
             < 1e-3
         )
 
+    def test_tp_times_ring_matches_dp(self, dp_loss):
+        """TP and ring sequence parallelism COMPOSED on one mesh
+        (data=2 x model=2 x seq=2 on 8 devices): Megatron rule set
+        shards the block weights while ring shards the sequence — the
+        trajectory must still equal pure DP."""
+        res = trainlib.fit(
+            tiny_cfg(
+                mesh_model=2, mesh_seq=2, seq_impl="ring",
+                param_rules="transformer_tp",
+            ),
+            tempfile.mkdtemp(),
+        )
+        assert abs(res.final_metrics["loss"] - dp_loss) < 1e-3
+
     def test_windowed_ring_matches_windowed_dp(self):
         """attn_window under seq_impl: the harness moves the window into
         the sequence-parallel closure (and off the model) — trajectory
