@@ -55,9 +55,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="attention kernel (auto = Pallas flash on TPU)",
     )
     p.add_argument(
-        "--fused-unembed", action="store_true", default=None,
+        "--fused-unembed", action=argparse.BooleanOptionalAction,
+        default=None,
         help="fuse the LM head projection + cross entropy (chunked bf16 "
-        "matmul, no [B*T, V] f32 logits tensor — ops/losses.py)",
+        "matmul, no [B*T, V] f32 logits tensor — ops/losses.py); "
+        "--no-fused-unembed forces the two-stage f32 head on configs "
+        "that default fused",
     )
     p.add_argument(
         "--multihost", action="store_true",
@@ -119,7 +122,8 @@ def main(argv: list[str] | None = None) -> int:
     p_ab.add_argument("--seed", type=int, default=None)
     p_ab.add_argument("--mesh-model", type=int, default=None)
     p_ab.add_argument(
-        "--fused-unembed", action="store_true", default=None,
+        "--fused-unembed", action=argparse.BooleanOptionalAction,
+        default=None,
         help="fused chunked LM head in both arms (LM configs)",
     )
     p_ab.add_argument("--multihost", action="store_true")

@@ -301,6 +301,12 @@ _add(
             name="adam", learning_rate=3e-4, clip_global_norm=1.0
         ),
         param_rules="transformer_tp",
+        # Fused chunked head by default: this family is the
+        # beyond-parity flagship, and the [B*T, V] f32 logits tensor is
+        # its HBM ceiling (the PTB reference configs keep the two-stage
+        # f32 head for TF-parity numerics; opt in there via
+        # --fused-unembed).
+        fused_unembed=True,
         train_steps=10_000,
     )
 )

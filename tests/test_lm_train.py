@@ -146,8 +146,10 @@ def test_fused_unembed_fit_matches_two_stage(mesh8, tmp_path):
         log_every_steps=1,
         checkpoint_every_secs=1e9,
     )
+    # Explicit False: the transformer_lm family defaults fused, and a
+    # defaulted "plain" arm would silently compare fused vs fused.
     res_plain = trainlib.fit(
-        get_config("transformer_lm", **kwargs),
+        get_config("transformer_lm", fused_unembed=False, **kwargs),
         str(tmp_path / "plain"), mesh=mesh8,
     )
     res_fused = trainlib.fit(
