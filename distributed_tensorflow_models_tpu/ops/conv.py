@@ -47,7 +47,7 @@ from jax import lax
 
 Padding = Union[str, Sequence[tuple[int, int]]]
 
-_VALID_IMPLS = ("xla", "patches")
+_VALID_IMPLS = ("xla", "patches", "mxu")
 
 # Process-wide default used by impl="auto".  Read at *trace* time: two jits
 # traced under different defaults produce different programs, so callers that
@@ -160,6 +160,13 @@ def conv2d(x, kernel, strides=(1, 1), padding: Padding = "SAME",
     impl = resolve_conv_impl(impl)
     if impl == "patches":
         return conv2d_patches(x, kernel, strides, padding)
+    if impl == "mxu":
+        # Pallas implicit-GEMM kernel (ops/conv_mxu.py): the same matmul
+        # HLO class as patches but without the materialized im2col.
+        # Deferred import: conv_mxu reuses this module's padding helpers.
+        from .conv_mxu import conv2d_mxu
+
+        return conv2d_mxu(x, kernel, strides, padding)
     if isinstance(padding, str):
         pad = padding.upper()
     else:
@@ -177,6 +184,8 @@ def _pool(x, window, strides, padding: Padding, impl: str, kind: str):
     kh, kw = window
     sh, sw = strides
     impl = resolve_conv_impl(impl)
+    # Pooling carries no matmul FLOPs, so "mxu" shares the patches
+    # shifted-slice folds — the relay-safe windowless lowering.
     if impl == "xla":
         if kind == "max":
             return nn.max_pool(x, window, strides=strides, padding=padding)

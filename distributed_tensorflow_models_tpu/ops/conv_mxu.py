@@ -1,0 +1,279 @@
+"""Implicit-GEMM 2-D convolution as a Pallas TPU kernel.
+
+Why a third lowering exists (beside ``impl="xla"`` and ``impl="patches"``,
+ops/conv.py): the conv models are the reference's headline benchmarks
+(SURVEY.md §2.1 R3-R7) and on this machine the only relay-viable HLO class
+is matmul-shaped programs (experiments/TPU_BENCH_r2.md).  ``patches`` is in
+that class but materializes the im2col tensor — a kh*kw-fold HBM blow-up
+that caps ResNet-50 near 4% MFU (experiments/tpu_r3_resnet50_b*.json).
+This module computes the same contraction *inside* a Pallas kernel: the
+input tile is DMA'd to VMEM once, the kh*kw shifted windows are read from
+VMEM (free), and the only HBM traffic is one read of x, one read of the
+kernel per output-channel tile, and one write of y — the implicit-GEMM
+scheme every native conv engine uses on a systolic array, here built
+directly on the MXU.
+
+Structure:
+
+- ``_core`` — stride-1 VALID conv ``[B,Hp,Wp,Cin] x [kh,kw,Cin,Cout]``,
+  the only Pallas entry point.  Grid ``(B/bb, OH/boh, Cout/bco)``; each
+  step manually DMAs a ``[bb, boh+kh-1, Wp, Cin]`` halo slab (overlapping
+  row windows are inexpressible as BlockSpec tiles), then accumulates
+  kh*kw MXU matmuls ``[bb*boh*OW, Cin] @ [Cin, bco]`` in f32.
+- strides are decomposed OUTSIDE the kernel into a sum of s_h*s_w
+  decimated stride-1 convs (``y = sum_pq core(x[p::s, q::s], k[p::s,
+  q::s])``) — exact, zero wasted FLOPs, and the surrounding HLO is only
+  strided-slice/pad/add (relay-safe).
+- 1x1 convs skip Pallas entirely: after decimation they ARE a single
+  ``dot_general`` (the patches 1x1 path, which has no blow-up).
+- tiny input channels (the RGB stem) fall back to ``patches``: with
+  Cin < 16 the MXU contraction is lane-starved either way and the im2col
+  concat is what lifts K to kh*kw*Cin.
+- ``custom_vjp``: dx re-enters the same kernel on the (kh-1,kw-1)-padded
+  cotangent with the spatially-rotated, IO-transposed kernel; dw is kh*kw
+  plain window-slice dots (weight-sized outputs — no large intermediate).
+  Everything outside ``_core`` (padding, phase slices, sums) is plain
+  differentiable jnp, so autodiff composes.
+
+Numerics: pinned against ``lax.conv_general_dilated`` in
+tests/test_conv_mxu.py (fwd + grads, every shape class in the model zoo).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .conv import _explicit_padding, conv2d_patches
+
+Padding = Union[str, Sequence[tuple[int, int]]]
+
+# Below this Cin the kernel's K dimension is lane-starved and im2col's
+# K = kh*kw*Cin concat is the better MXU shape (the 7x7 RGB stem).
+_MIN_CIN = 16
+# VMEM budget for the manually-DMA'd input slab (bytes).  Conservative:
+# the auto-pipelined kernel/output blocks and the f32 accumulator share
+# the ~16 MiB VMEM with it.
+_SLAB_BUDGET = 4 * 1024 * 1024
+# Target rows for the GEMM M dimension per grid step.
+_M_TARGET = 1024
+
+
+def _divisors_desc(n: int):
+    out = [d for d in range(n, 0, -1) if n % d == 0]
+    return out
+
+
+def _pick_tiles(b, oh, ow, wp, cin, cout, kh, itemsize):
+    """(bb, boh, bco): batch-fold, output-row tile, out-channel tile.
+
+    boh: largest divisor of OH whose halo slab fits the VMEM budget with
+    M = boh*OW not far past the target.  bb: fold batch images into the
+    GEMM M dim when one image's rows leave the MXU starved (deep 7x7
+    feature maps).  bco: largest divisor of Cout <= 256.
+    """
+    boh = 1
+    for d in _divisors_desc(oh):
+        slab = (d + kh - 1) * wp * cin * itemsize
+        if slab <= _SLAB_BUDGET and d * ow <= 2 * _M_TARGET:
+            boh = d
+            break
+    bb = 1
+    for d in _divisors_desc(b):
+        slab = d * (boh + kh - 1) * wp * cin * itemsize
+        if slab <= _SLAB_BUDGET and d * boh * ow <= 2 * _M_TARGET:
+            bb = d
+            break
+    bco = next((d for d in _divisors_desc(cout) if d <= 256), cout)
+    return bb, boh, bco
+
+
+def _core_kernel(x_hbm, k_ref, y_ref, slab, sem, *, kh, kw, bb, boh, ow,
+                 cin, bco, interpreted):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    rows = boh + kh - 1
+
+    # One halo slab per (b, i); j only cycles output-channel tiles over
+    # the same input rows, so on hardware copy on its first visit only
+    # (Mosaic scratch persists across sequential grid steps).  The
+    # interpreter reinitializes scratch per grid point, so there the copy
+    # runs every step — same data, so numerics are identical.
+    @pl.when(jnp.logical_or(j == 0, interpreted))
+    def _copy():
+        from jax.experimental.pallas import tpu as pltpu
+
+        b0 = pl.program_id(0) * bb
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(b0, bb), pl.ds(i * boh, rows)], slab, sem
+        )
+        cp.start()
+        cp.wait()
+
+    xs = slab[...]  # [bb, rows, Wp, Cin]
+    acc = jnp.zeros((bb * boh * ow, bco), jnp.float32)
+    for dy in range(kh):
+        for dx in range(kw):
+            win = lax.slice(
+                xs, (0, dy, dx, 0), (bb, dy + boh, dx + ow, cin)
+            ).reshape(bb * boh * ow, cin)
+            acc += lax.dot_general(
+                win, k_ref[dy, dx], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+    y_ref[...] = acc.reshape(bb, boh, ow, bco).astype(y_ref.dtype)
+
+
+def _core_fwd_impl(xpad, kernel, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hp, wp, cin = xpad.shape
+    kh, kw, _, cout = kernel.shape
+    oh = hp - kh + 1
+    ow = wp - kw + 1
+    bb, boh, bco = _pick_tiles(
+        b, oh, ow, wp, cin, cout, kh, xpad.dtype.itemsize
+    )
+    rows = boh + kh - 1
+    body = functools.partial(
+        _core_kernel, kh=kh, kw=kw, bb=bb, boh=boh, ow=ow, cin=cin, bco=bco,
+        interpreted=bool(interpret),
+    )
+    if interpret:
+        # The generic interpreter doesn't model ANY-space refs, DMA or
+        # semaphores; the TPU-flavored interpreter does.
+        interpret = pltpu.InterpretParams()
+    return pl.pallas_call(
+        body,
+        grid=(b // bb, oh // boh, cout // bco),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(
+                (kh, kw, cin, bco), lambda bq, i, j: (0, 0, 0, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (bb, boh, ow, bco), lambda bq, i, j: (bq, i, 0, j),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, oh, ow, cout), xpad.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bb, rows, wp, cin), xpad.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        # j must be "arbitrary": the j==0 slab copy feeds later j steps
+        # through persistent scratch, so the channel-tile dim can be
+        # neither reordered nor split across Megacore cores.  bq/i stay
+        # parallel — a core slice along them always opens at j==0.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(xpad, kernel)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _core(xpad, kernel, interpret):
+    """Stride-1 VALID conv, NHWC x HWIO, via the Pallas kernel."""
+    return _core_fwd_impl(xpad, kernel, interpret)
+
+
+def _core_fwd(xpad, kernel, interpret):
+    return _core_fwd_impl(xpad, kernel, interpret), (xpad, kernel)
+
+
+def _core_bwd(interpret, res, g):
+    xpad, kernel = res
+    kh, kw, cin, cout = kernel.shape
+    _, oh, ow, _ = g.shape
+    # dw: one weight-sized dot per tap — contraction over (B, OH, OW).
+    taps = []
+    for dy in range(kh):
+        row = []
+        for dx in range(kw):
+            win = lax.slice(
+                xpad, (0, dy, dx, 0),
+                (xpad.shape[0], dy + oh, dx + ow, cin),
+            )
+            row.append(
+                lax.dot_general(
+                    win, g, (((0, 1, 2), (0, 1, 2)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        taps.append(jnp.stack(row))
+    dw = jnp.stack(taps).astype(kernel.dtype)
+    # dx: full correlation = the same stride-1 kernel on the
+    # (kh-1, kw-1)-padded cotangent with the rotated, IO-swapped kernel.
+    gp = jnp.pad(g, ((0, 0), (kh - 1, kh - 1), (kw - 1, kw - 1), (0, 0)))
+    krot = kernel[::-1, ::-1].transpose(0, 1, 3, 2)
+    # Re-enter _core (not the raw pallas_call) so the backward pass is
+    # itself differentiable — higher-order autodiff re-uses this VJP.
+    dx = _core(gp, krot, interpret)
+    return dx, dw
+
+
+_core.defvjp(_core_fwd, _core_bwd)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - backend probe failure
+        return False
+
+
+def conv2d_mxu(x, kernel, strides=(1, 1), padding: Padding = "SAME",
+               interpret: Optional[bool] = None):
+    """``lax.conv_general_dilated`` (NHWC, HWIO) semantics on the Pallas
+    implicit-GEMM kernel.  ``interpret=None`` auto-selects interpret mode
+    off-TPU (the kernel is Mosaic-only; CPU runs use the interpreter)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    kh, kw, cin, cout = kernel.shape
+    sh, sw = strides
+    if x.shape[-1] != cin:
+        raise ValueError(
+            f"input channels {x.shape[-1]} != kernel input channels {cin}"
+        )
+    if kh == kw == 1 or cin < _MIN_CIN:
+        # 1x1 is already a bare dot in the patches path (no im2col
+        # blow-up exists); tiny Cin wants the im2col K-dim lift.
+        return conv2d_patches(x, kernel, strides, padding)
+    (ph0, ph1), (pw0, pw1) = _explicit_padding(
+        padding, kh, kw, sh, sw, x.shape[1], x.shape[2]
+    )
+    if ph0 or ph1 or pw0 or pw1:
+        x = jnp.pad(x, ((0, 0), (ph0, ph1), (pw0, pw1), (0, 0)))
+    b, hp, wp, _ = x.shape
+    oh = (hp - kh) // sh + 1
+    ow = (wp - kw) // sw + 1
+    if sh == 1 and sw == 1:
+        return _core(x, kernel, interpret)
+    # Phase decomposition: y = sum_{p,q} core(x[p::s], k[p::s]) — each
+    # phase is an exact stride-1 conv on a decimated image; taps
+    # partition over phases so total MACs equal the strided conv's.
+    y = None
+    for p in range(min(sh, kh)):
+        khp = len(range(p, kh, sh))
+        for q in range(min(sw, kw)):
+            kwq = len(range(q, kw, sw))
+            xs = lax.slice(
+                x,
+                (0, p, q, 0),
+                (b, p + (oh + khp - 2) * sh + 1, q + (ow + kwq - 2) * sw + 1,
+                 cin),
+                (1, sh, sw, 1),
+            )
+            kp = kernel[p::sh, q::sw]
+            yp = _core(xs, kp, interpret)
+            y = yp if y is None else y + yp
+    return y
