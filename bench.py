@@ -148,7 +148,8 @@ def probe_backend(max_attempts, timeout_s, backoff_s):
     return False, max_attempts, err
 
 
-def _flops_per_step_global(single_step_lowered, name, items_per_step):
+def _flops_per_step_global(single_step_lowered, name, items_per_step,
+                           prefer_analytic=False):
     """GLOBAL (all-chip) FLOPs for one train step, from HLO cost analysis
     of a SINGLE-step lowering (trace-only — no extra backend compile).
     Callers divide by device count for per-chip numbers.
@@ -171,6 +172,10 @@ def _flops_per_step_global(single_step_lowered, name, items_per_step):
     fallback is scaled by the global item count to match.
     """
     try:
+        if prefer_analytic:
+            raise RuntimeError(
+                "caller requested analytic FLOPs (Pallas-dominated program)"
+            )
         cost = single_step_lowered.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
@@ -245,6 +250,7 @@ def run_one(name, builder, steps, batch_override):
         ),
         name,
         items_per_step,
+        prefer_analytic=extras.pop("prefer_analytic", False),
     )
     flops_chip = flops_global / n_chips
 
@@ -455,6 +461,11 @@ def _build_classifier(
 
     batches = _stack_batches(mesh, make_batch)
     extras = {"conv_impl": conv_impl}
+    if conv_impl == "mxu":
+        # The implicit-GEMM convs are Pallas custom-calls — invisible to
+        # XLA cost analysis, which would report a near-zero FLOP count
+        # and a nonsense MFU.  Use the analytic model.
+        extras["prefer_analytic"] = True
     if flops_model is not None:
         extras["flops_step_fn"] = make_step(flops_model)
         extras["remat"] = True

@@ -1,0 +1,47 @@
+#!/bin/bash
+# Chained round-3 runner: banks the Pallas implicit-GEMM (impl=mxu) conv
+# benches AFTER the main priority ladder (tpu_r3_run.sh) completes, and
+# only THEN re-arms and runs the native-conv ladder — the one program
+# class that historically wedges the relay, so it stays dead last across
+# both runners (the deferral sentinel in conv_ladder.py parks the main
+# runner's attempt).
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+R=r3-mxu
+
+echo "$(date) [$R] waiting for main runner" >> "$LOG"
+while [ ! -f /tmp/tpu_r3_done ]; do sleep 60; done
+echo "$(date) [$R] main runner done; starting mxu benches" >> "$LOG"
+
+bench_one() {  # name outfile [extra bench args...]
+    local name="$1" out="$2"; shift 2
+    echo "$(date) [$R] bench $name -> $out $*" >> "$LOG"
+    DTM_CONV_IMPL=mxu timeout 1500 python bench.py --config "$name" \
+        --no-probe "$@" > "experiments/$out" 2>> "$LOG"
+    local rc=$?
+    echo "$(date) [$R] bench $name rc=$rc $(tail -c 300 "experiments/$out" 2>/dev/null)" >> "$LOG"
+    return $rc
+}
+
+# Headliner first, best-known batches first so something banks early.
+for b in 128 256 64; do
+    bench_one resnet50 "tpu_r3_mxu_resnet50_b${b}.json" --batch "$b"
+done
+for b in 64 128; do
+    bench_one inception_v3 "tpu_r3_mxu_inception_b${b}.json" --batch "$b"
+done
+bench_one resnet32 "tpu_r3_mxu_resnet32.json"
+bench_one vgg16 "tpu_r3_mxu_vgg16.json"
+bench_one alexnet "tpu_r3_mxu_alexnet.json"
+bench_one lenet "tpu_r3_mxu_lenet.json"
+
+# Native conv ladder: re-arm and run, still dead last.
+echo "$(date) [$R] native conv ladder (re-armed)" >> "$LOG"
+rm -f /tmp/dtm_defer_native_ladder
+DTM_CONV_IMPL=xla python experiments/conv_ladder.py --timeout 420 \
+    --out experiments/conv_ladder_r3.json >> "$LOG" 2>&1
+echo "$(date) [$R] native conv ladder rc=$?" >> "$LOG"
+
+echo "$(date) [$R] runner DONE" >> "$LOG"
+touch /tmp/tpu_r3_mxu_done
