@@ -314,9 +314,10 @@ def _flash_kernel(
         o_ref[0] = (
             acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
         ).astype(o_ref.dtype)
-        lse_ref[0, :] = (
-            m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
-        )
+        # [block_q, 1] write: LSE rides with a trailing unit lane dim —
+        # Mosaic requires block second-minor dims divisible by 8, which a
+        # [1, block_q] 2-D block violates (b-h rows are blocked at 1).
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
 def _check_blocks(Tq, Tkv, block_q, block_kv):
@@ -397,13 +398,13 @@ def _flash_forward(
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, block_q), lambda b, i, j: (b, i),
+                (1, block_q, 1), lambda b, i, j: (b, i, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -493,7 +494,7 @@ def _flash_dkv_kernel(
     def _compute():
         qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         p, ds = _p_and_ds(
-            qb, kb, vb, dob, lse_ref[0, :], delta_ref[0, :], i, j,
+            qb, kb, vb, dob, lse_ref[0, :, 0], delta_ref[0, :, 0], i, j,
             q_base, kv_base,
             scale=scale, causal=causal,
             block_q=block_q, block_kv=block_kv, window=window,
@@ -550,7 +551,7 @@ def _flash_dq_kernel(
     def _compute():
         qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         _, ds = _p_and_ds(
-            qb, kb, vb, dob, lse_ref[0, :], delta_ref[0, :], i, j,
+            qb, kb, vb, dob, lse_ref[0, :, 0], delta_ref[0, :, 0], i, j,
             q_base, kv_base,
             scale=scale, causal=causal,
             block_q=block_q, block_kv=block_kv, window=window,
@@ -569,7 +570,7 @@ def _flash_backward(
     q, k, v, out, lse, g, *, causal, scale, block_q, block_kv, interpret,
     q_offset=0, kv_offset=0, g_lse=None, window=None,
 ):
-    """``lse`` here is the kernel-internal [B*H, Tq] layout.  ``g_lse``
+    """``lse`` here is the kernel-internal [B*H, Tq, 1] layout.  ``g_lse``
     (same layout, optional) is the LSE cotangent from callers that
     consumed the (out, lse) pair — it folds into delta (see
     :func:`_p_and_ds`)."""
@@ -591,7 +592,8 @@ def _flash_backward(
         doh.astype(jnp.float32)
         * _heads_first(out).astype(jnp.float32),
         axis=-1,
-    )  # [B*H, Tq] f32
+        keepdims=True,
+    )  # [B*H, Tq, 1] f32
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
 
@@ -601,8 +603,11 @@ def _flash_backward(
     kvspec = lambda im: pl.BlockSpec(
         (1, block_kv, D), im, memory_space=pltpu.VMEM
     )
+    # Per-row residuals (LSE, delta) carry a trailing unit lane dim so
+    # the block's last two dims are (block_q, 1) — Mosaic-legal where a
+    # [1, block_q] block is not (second-minor must divide by 8).
     rowspec = lambda im: pl.BlockSpec(
-        (1, block_q), im, memory_space=pltpu.VMEM
+        (1, block_q, 1), im, memory_space=pltpu.VMEM
     )
 
     dkv_kernel = functools.partial(
@@ -624,8 +629,8 @@ def _flash_backward(
             kvspec(lambda b, j, i: (kv_row(b), j, 0)),
             kvspec(lambda b, j, i: (kv_row(b), j, 0)),
             qspec(lambda b, j, i: (b, i, 0)),
-            rowspec(lambda b, j, i: (b, i)),
-            rowspec(lambda b, j, i: (b, i)),
+            rowspec(lambda b, j, i: (b, i, 0)),
+            rowspec(lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             kvspec(lambda b, j, i: (b, j, 0)),
@@ -660,8 +665,8 @@ def _flash_backward(
             kvspec(lambda b, i, j: (kv_row(b), j, 0)),
             kvspec(lambda b, i, j: (kv_row(b), j, 0)),
             qspec(lambda b, i, j: (b, i, 0)),
-            rowspec(lambda b, i, j: (b, i)),
-            rowspec(lambda b, i, j: (b, i)),
+            rowspec(lambda b, i, j: (b, i, 0)),
+            rowspec(lambda b, i, j: (b, i, 0)),
         ],
         out_specs=qspec(lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
@@ -721,9 +726,9 @@ def flash_attention(
 
 
 def _lse_rows(lse):
-    """[B, T, H] public LSE layout -> the kernels' [B*H, T]."""
+    """[B, T, H] public LSE layout -> the kernels' [B*H, T, 1]."""
     B, T, H = lse.shape
-    return jnp.swapaxes(lse, 1, 2).reshape(B * H, T)
+    return jnp.swapaxes(lse, 1, 2).reshape(B * H, T, 1)
 
 
 def _flash_fwd(
