@@ -655,11 +655,17 @@ def run_decode(args):
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(rng.randint(0, 10000, (B, T_prompt)), jnp.int32)
 
-    # Each sample is already 191 decode steps, but the prefill subtraction
-    # amplifies single-run jitter — take the min over a few repeats (the
-    # standard noise floor estimator; every other config here averages
-    # over its fused scan for the same reason).
+    # Per-dispatch relay overhead on this machine is tens of ms — the
+    # same order as one 192-token generation — so timing single calls
+    # and subtracting prefill produced pure noise (the r3 first-pass
+    # artifact recorded dt_full < dt_prefill and a 1.5e12 tokens/s
+    # "throughput").  Fix: fold R generations into ONE dispatch with an
+    # outer lax.scan, so fixed overhead is amortized R-fold before the
+    # prefill subtraction.  The scan body takes a carry dependence
+    # (prompt + carry%2) so XLA cannot hoist the loop-invariant body out
+    # of the while loop.
     repeats = 3
+    scan_gens = 8
     steps = T_new - 1  # tokens produced by the scan, prefill excluded
 
     def measure(num_kv_heads):
@@ -675,11 +681,22 @@ def run_decode(args):
         )
         params = model.init(jax.random.key(0), prompt[:, :8])["params"]
 
-        fn = jax.jit(lambda p, t: generate(model, p, t, T_new))
+        def many(t_new):
+            def f(p, t):
+                def body(c, _):
+                    toks = generate(model, p, t + (c % 2), t_new)
+                    return c + 1, toks[:, -1]
+                _, outs = jax.lax.scan(
+                    body, jnp.int32(0), None, length=scan_gens
+                )
+                return outs
+            return jax.jit(f)
+
+        fn = many(T_new)
         # Prefill-only run (1 new token ~= the prompt pass + one
         # sample): subtracted out so the reported numbers are
         # decode-step latency, not prefill amortization.
-        fn_prefill = jax.jit(lambda p, t: generate(model, p, t, 1))
+        fn_prefill = many(1)
 
         def timed(f, label):
             t0 = time.time()
@@ -693,7 +710,7 @@ def run_decode(args):
                 t0 = time.perf_counter()
                 np.asarray(f(params, prompt))
                 best = min(best, time.perf_counter() - t0)
-            return best
+            return best / scan_gens
 
         dt_prefill = timed(fn_prefill, "prefill")
         dt_full = timed(fn, "full")

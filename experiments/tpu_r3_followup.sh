@@ -1,0 +1,71 @@
+#!/bin/bash
+# Chained round-3 follow-up runner (supersedes tpu_r3_mxu.sh): waits for
+# the main priority ladder (tpu_r3_run.sh), then banks in order:
+#
+#   1. Flash-path re-runs.  The r2 kernel overhaul had a Mosaic-illegal
+#      LSE blockspec that broke EVERY flash compile on hardware (fixed
+#      in ops/attention.py this round; verified on-chip) — the
+#      transformer fused/twostage A/B, batch ladder, T=4096 long
+#      context, flash_check, and the decode bench (its first-pass
+#      timing was also dispatch-overhead-dominated; rewritten to
+#      amortize R generations per dispatch) all re-run here.
+#   2. The Pallas implicit-GEMM (impl=mxu) conv benches vs the patches
+#      numbers banked by the main ladder.
+#   3. The native-conv ladder, re-armed, still dead last — the one
+#      program class that historically wedges the relay.
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+R=r3-fu
+
+echo "$(date) [$R] waiting for main runner" >> "$LOG"
+while [ ! -f /tmp/tpu_r3_done ]; do sleep 60; done
+echo "$(date) [$R] main runner done; starting follow-up benches" >> "$LOG"
+
+bench_one() {  # name outfile [extra bench args...]
+    local name="$1" out="$2"; shift 2
+    echo "$(date) [$R] bench $name -> $out $*" >> "$LOG"
+    timeout 1500 python bench.py --config "$name" --no-probe "$@" \
+        > "experiments/$out" 2>> "$LOG"
+    local rc=$?
+    echo "$(date) [$R] bench $name rc=$rc $(tail -c 300 "experiments/$out" 2>/dev/null)" >> "$LOG"
+    return $rc
+}
+
+# 1. Flash-path re-runs (kernel fix) + fixed decode timing.
+bench_one transformer_lm "tpu_r3_transformer_fused.json"
+( export DTM_FUSED_UNEMBED=0
+  bench_one transformer_lm "tpu_r3_transformer_twostage.json" )
+for b in 32 64; do
+    bench_one transformer_lm "tpu_r3_transformer_fused_b${b}.json" --batch "$b"
+done
+( export DTM_DONATE=1
+  bench_one transformer_lm "tpu_r3_transformer_fused_donate.json" )
+bench_one flash_check "tpu_r3_flash_check.json"
+bench_one transformer_lm_long "tpu_r3_transformer_long.json"
+bench_one decode "tpu_r3_decode.json"
+
+# 2. mxu conv benches, headliner first, best-known batches first.
+mxu_one() {
+    DTM_CONV_IMPL=mxu bench_one "$@"
+}
+for b in 128 256 64; do
+    mxu_one resnet50 "tpu_r3_mxu_resnet50_b${b}.json" --batch "$b"
+done
+for b in 64 128; do
+    mxu_one inception_v3 "tpu_r3_mxu_inception_b${b}.json" --batch "$b"
+done
+mxu_one resnet32 "tpu_r3_mxu_resnet32.json"
+mxu_one vgg16 "tpu_r3_mxu_vgg16.json"
+mxu_one alexnet "tpu_r3_mxu_alexnet.json"
+mxu_one lenet "tpu_r3_mxu_lenet.json"
+
+# 3. Native conv ladder: re-arm and run, still dead last.
+echo "$(date) [$R] native conv ladder (re-armed)" >> "$LOG"
+rm -f /tmp/dtm_defer_native_ladder
+DTM_CONV_IMPL=xla python experiments/conv_ladder.py --timeout 420 \
+    --out experiments/conv_ladder_r3.json >> "$LOG" 2>&1
+echo "$(date) [$R] native conv ladder rc=$?" >> "$LOG"
+
+echo "$(date) [$R] runner DONE" >> "$LOG"
+touch /tmp/tpu_r3_followup_done
