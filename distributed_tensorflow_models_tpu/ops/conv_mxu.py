@@ -88,7 +88,15 @@ def _pick_tiles(b, oh, ow, wp, cin, cout, kh, itemsize):
         if slab <= _SLAB_BUDGET and d * boh * ow <= 2 * _M_TARGET:
             bb = d
             break
-    bco = next((d for d in _divisors_desc(cout) if d <= 256), cout)
+    # Mosaic block rule: the block's last dim must be a multiple of 128
+    # or equal the full array dim.  Inception-style channel counts (384,
+    # 320, 448...) have divisors ≤256 that satisfy neither, so restrict
+    # the search and fall back to channel-full blocks (always legal).
+    bco = next(
+        (d for d in _divisors_desc(cout)
+         if d <= 256 and (d % 128 == 0 or d == cout)),
+        cout,
+    )
     return bb, boh, bco
 
 
@@ -138,6 +146,14 @@ def _core_fwd_impl(xpad, kernel, interpret):
     kh, kw, _, cout = kernel.shape
     oh = hp - kh + 1
     ow = wp - kw + 1
+    # Mosaic DMA slices must be 8-aligned along the sublane (W) dim; pad
+    # W up to a multiple of 8.  The extra zero columns sit past the last
+    # window (ow is computed from the true wp above) and are never read
+    # into any output.
+    wp8 = -(-wp // 8) * 8
+    if wp8 != wp:
+        xpad = jnp.pad(xpad, ((0, 0), (0, 0), (0, wp8 - wp), (0, 0)))
+        wp = wp8
     bb, boh, bco = _pick_tiles(
         b, oh, ow, wp, cin, cout, kh, xpad.dtype.itemsize
     )
