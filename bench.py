@@ -563,7 +563,10 @@ def build_transformer_lm(n_chips, batch_override, steps):
     )
 
 
-def _build_transformer(n_chips, batch_override, steps, *, T, default_batch, remat):
+def _build_transformer(
+    n_chips, batch_override, steps, *, T, default_batch, remat,
+    attn_default="auto",
+):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -590,7 +593,7 @@ def _build_transformer(n_chips, batch_override, steps, *, T, default_batch, rema
         # DTM_BENCH_ATTN_IMPL pins the attention impl — used by
         # experiments/recompute_mfu.py to lower a FLOPs-accounting program
         # consistent with MFU convention (see that script's docstring).
-        attn_impl=os.environ.get("DTM_BENCH_ATTN_IMPL", "auto"),
+        attn_impl=os.environ.get("DTM_BENCH_ATTN_IMPL", attn_default),
     )
     tx = optax.chain(optim.clip_by_global_norm(1.0), optim.adam(3e-4))
     state = TrainState.create(
@@ -622,13 +625,17 @@ def _build_transformer(n_chips, batch_override, steps, *, T, default_batch, rema
 
 
 def build_transformer_lm_long(n_chips, batch_override, steps):
-    """Long-context showcase: the same model at T=4096 through the Pallas
-    flash kernel (auto on TPU), remat'd blocks — the regime the
-    blockwise/flash stack exists for.  At this length an
-    O(T^2)-materializing attention would need ~16M-element score buffers
-    per head; flash keeps it at O(T·block).  Unit: tokens/sec/chip."""
+    """Long-context config: the same model at T=4096, remat'd blocks,
+    streaming (O(T·block)-memory) attention.  Defaults to BLOCKWISE, not
+    flash: the flash path at T=4096 never banked a number through this
+    relay — its one attempt timed out at 900 s before the first compile
+    log and left the relay wedged (tpu_r3_transformer_long.json,
+    2026-07-31) — so the Pallas route is opt-in via
+    DTM_BENCH_ATTN_IMPL=flash until it is proven at this length.
+    Unit: tokens/sec/chip."""
     return _build_transformer(
-        n_chips, batch_override, steps, T=4096, default_batch=4, remat=True
+        n_chips, batch_override, steps, T=4096, default_batch=4, remat=True,
+        attn_default="blockwise",
     )
 
 
@@ -849,13 +856,13 @@ def run_flash_check(args):
         lambda q, k, v: attnlib.blockwise_attention(q, k, v, causal=True)
     )
 
-    # Forward block-size sweep: the (128,128) default was never tuned on
-    # hardware; this records the landscape so the right tile is a config
-    # change, not a guess.  (128,128) reuses the f_dt measurement above;
-    # timed()'s trailing eager call is skipped — only the fused timing
-    # program runs per tile.
-    sweep = {"128x128": round(f_dt * 1e3, 3)}
-    for bq, bkv in ((128, 256), (256, 128), (256, 256),
+    # Forward block-size sweep with EXPLICIT tiles (the no-args call above
+    # resolves blocks via _auto_block, so f_dt is recorded separately
+    # under the resolved tile name — reusing it for a fixed key would
+    # mislabel the measurement if the auto choice ever changes again).
+    auto_bq, auto_bkv = attnlib._check_blocks(T, T, None, None)
+    sweep = {f"auto:{auto_bq}x{auto_bkv}": round(f_dt * 1e3, 3)}
+    for bq, bkv in ((128, 128), (128, 256), (256, 128), (256, 256),
                     (128, 512), (512, 128)):
         try:
             _, dt = timed(
@@ -867,6 +874,25 @@ def run_flash_check(args):
             sweep[f"{bq}x{bkv}"] = round(dt * 1e3, 3)
         except Exception as e:  # noqa: BLE001 — record, keep sweeping
             sweep[f"{bq}x{bkv}"] = f"error: {e}"[:120]
+
+    # Backward tile sweep (fwd+bwd total via grad_timed): the forward
+    # winner is not automatically the backward winner — the FA2 kernel
+    # pair re-walks the score blocks with different matmul shapes.  The
+    # auto-resolved tile reuses f_grad_dt (measured above) instead of
+    # recompiling the identical program on scarce relay time.
+    grad_sweep = {f"auto:{auto_bq}x{auto_bkv}": round(f_grad_dt * 1e3, 3)}
+    for bq, bkv in ((128, 128), (256, 256)):
+        if (bq, bkv) == (auto_bq, auto_bkv):
+            continue
+        try:
+            dt = grad_timed(
+                lambda q, k, v, bq=bq, bkv=bkv: attnlib.flash_attention(
+                    q, k, v, True, None, bq, bkv
+                )
+            )
+            grad_sweep[f"{bq}x{bkv}"] = round(dt * 1e3, 3)
+        except Exception as e:  # noqa: BLE001
+            grad_sweep[f"{bq}x{bkv}"] = f"error: {e}"[:120]
     jax.block_until_ready((f_out, b_out))
     # Numerics gate in f32: the bf16 impls must land within bf16 round-off
     # of the exact O(T^2) answer.
@@ -888,6 +914,7 @@ def run_flash_check(args):
         "blockwise_grad_ms": round(b_grad_dt * 1e3, 3),
         "grad_speedup_vs_blockwise": round(b_grad_dt / f_grad_dt, 3),
         "forward_block_sweep_ms": sweep,
+        "grad_block_sweep_ms": grad_sweep,
         "flash_tflops": round(flash_flops / f_dt / 1e12, 2),
         "max_err_flash_vs_reference": float(
             jnp.max(jnp.abs(f_out.astype(jnp.float32) - ref))
@@ -911,26 +938,27 @@ BUILDERS = {
     "transformer_lm_long": build_transformer_lm_long,
 }
 HEADLINE = "resnet50"
-# Execution order: matmul-dominated configs and the Pallas microbench
-# first — a conv remote-compile can wedge the relay for every process
-# after it (the observed failure mode), so everything conv-free must
-# already have its number banked.  Then convs smallest-first (lenet →
-# resnet32 → resnet50 → inception_v3): if the wedge hits, the boundary
-# it hit at is itself recorded.
+# Execution order = relay-risk order, safest first: a killed or wedged
+# remote compile can poison the relay for every process after it.  The
+# r1-r2 trigger was conv HLO; on 2026-07-31 the T=4096 flash config
+# became the second known trigger (timed out at 900 s without reaching
+# its first compile log, and the relay answered nothing afterwards —
+# experiments/tpu_r3_transformer_long.json).  So: proven matmul configs
+# first, patches-lowered convs next (proven on hardware this round),
+# then the rewritten decode bench (heavier nested-scan compile, not yet
+# proven), and transformer_lm_long DEAD LAST.
 ORDER = [
     "ptb_lstm",
     "transformer_lm",
-    "transformer_lm_long",
     "flash_check",
-    "decode",
     "lenet",
     "resnet32",
     "resnet50",
     "inception_v3",
-    # R7 throughput models last: worthwhile but junior to the headline
-    # pair, and the watchdog now emits partial results if they run long.
     "alexnet",
     "vgg16",
+    "decode",
+    "transformer_lm_long",
 ]
 CHILD_MODES = sorted(BUILDERS) + ["flash_check", "decode"]
 

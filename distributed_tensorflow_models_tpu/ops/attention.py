@@ -320,9 +320,18 @@ def _flash_kernel(
         lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
+def _auto_block(T: int) -> int:
+    """Largest measured-good tile the length divides: the v5e forward
+    sweep put 256x256 first (experiments/tpu_r3_flash_check_detail.json);
+    128 is the Mosaic-aligned fallback for lengths 256 doesn't divide."""
+    return 256 if T % 256 == 0 else 128
+
+
 def _check_blocks(Tq, Tkv, block_q, block_kv):
-    block_q = min(block_q, Tq)
-    block_kv = min(block_kv, Tkv)
+    block_q = min(block_q if block_q is not None else _auto_block(Tq), Tq)
+    block_kv = min(
+        block_kv if block_kv is not None else _auto_block(Tkv), Tkv
+    )
     if Tq % block_q or Tkv % block_kv:
         raise ValueError(
             f"seq lens ({Tq},{Tkv}) not divisible by blocks "
@@ -705,12 +714,19 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
     interpret: bool = False,
     window: Optional[int] = None,
 ) -> jax.Array:
     """Pallas TPU flash attention, BTHD in/out.
+
+    Default tiles (``None``) resolve via :func:`_auto_block`: 256 where
+    the length divides it, else 128.  The on-hardware forward block
+    sweep (bench.py --config flash_check, v5e, B4 T2048 H8 D64 causal
+    bf16) measured 7.78 ms at 256x256 vs 9.21 ms at the untuned
+    128x128 — the best of the 128-512 grid; full per-tile numbers in
+    experiments/tpu_r3_flash_check_detail.json.
 
     Forward is the fused kernel (which also emits per-row LSE); backward
     is the FlashAttention-2 kernel pair (:func:`_flash_dkv_kernel` /
@@ -767,8 +783,8 @@ def flash_attention_chunk(
     kv_offset: jax.Array = 0,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
     interpret: bool = False,
     window: Optional[int] = None,
 ) -> tuple[jax.Array, jax.Array]:
@@ -851,6 +867,10 @@ def attention(
             q, k, v, causal=causal, scale=scale, window=window
         )
     if impl == "flash":
-        # Positional: custom_vjp + nondiff_argnums is positional-indexed.
-        return flash_attention(q, k, v, causal, scale, 128, 128, False, window)
+        # None blocks resolve per-length via _auto_block (256 where the
+        # sweep-measured winner divides, else 128).  Positional:
+        # custom_vjp + nondiff_argnums is positional-indexed.
+        return flash_attention(
+            q, k, v, causal, scale, None, None, False, window
+        )
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,106 @@
+#!/bin/bash
+# Health-GATED round-3 bench queue — supersedes tpu_r3_followup.sh +
+# tpu_r3_tune.sh after the 2026-07-31 03:43 re-wedge.
+#
+# What happened: the relay was healthy from ~02:00 (patches conv ladder,
+# flagship A/B, convergence all banked) until the transformer_lm_long
+# flash-T=4096 bench hit its 900 s config timeout — after which
+# jax.devices() hung for every new process (decode burned 900 s, the
+# first mxu bench burned 9 min before being killed).  Killed/wedged
+# remote compiles poison the relay (the r1-r2 conv lesson; flash@4096 is
+# trigger #2), and a blind queue then burns its whole timeout budget
+# against a dead backend.
+#
+# This runner probes the backend (subprocess, 90 s cap) BEFORE each
+# bench and sleeps until it comes back, so every second of healthy relay
+# time goes to banking numbers, priority order:
+#   1. mxu (Pallas implicit-GEMM) conv ladder — the headline metric
+#   2. transformer attention/batch tuning matrix (blockwise/reference)
+#   3. LSTM batch push, decode (rewritten timing, gated)
+#   4. long-context via blockwise (flash@4096 is the known poison: NOT
+#      re-run here), native-conv ladder dead last (trigger #1).
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+R=r3-gated
+
+probe() {
+    timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax
+import jax.numpy as jnp
+d = jax.devices()
+if d[0].platform != "tpu":
+    raise SystemExit(1)
+x = jnp.ones((512, 512), jnp.bfloat16)
+(x @ x).block_until_ready()
+EOF
+}
+
+wait_healthy() {
+    local n=0
+    until probe; do
+        n=$((n + 1))
+        if [ $((n % 3)) -eq 1 ]; then
+            echo "$(date) [$R] relay unhealthy (probe $n); waiting" >> "$LOG"
+        fi
+        sleep 240
+    done
+    if [ "$n" -gt 0 ]; then
+        echo "$(date) [$R] relay RECOVERED after $n failed probes" >> "$LOG"
+    fi
+}
+
+bench_one() {  # name outfile [extra bench args...]
+    local name="$1" out="$2"; shift 2
+    if [ -s "experiments/$out" ] && ! grep -q '"error"' "experiments/$out"; then
+        echo "$(date) [$R] skip $name -> $out (already banked)" >> "$LOG"
+        return 0
+    fi
+    wait_healthy
+    echo "$(date) [$R] bench $name -> $out $*" >> "$LOG"
+    timeout 1500 python bench.py --config "$name" --no-probe "$@" \
+        > "experiments/$out" 2>> "$LOG"
+    local rc=$?
+    echo "$(date) [$R] bench $name rc=$rc $(tail -c 300 "experiments/$out" 2>/dev/null)" >> "$LOG"
+    return $rc
+}
+
+# 1. mxu conv ladder, headliner first.
+for b in 128 256 64; do
+    DTM_CONV_IMPL=mxu bench_one resnet50 "tpu_r3_mxu_resnet50_b${b}.json" --batch "$b"
+done
+for b in 64 128; do
+    DTM_CONV_IMPL=mxu bench_one inception_v3 "tpu_r3_mxu_inception_b${b}.json" --batch "$b"
+done
+
+# 2. Transformer attention/batch matrix (fused head everywhere).
+for attn in blockwise reference; do
+    for b in 16 32 64; do
+        DTM_BENCH_ATTN_IMPL=$attn \
+            bench_one transformer_lm "tpu_r3_tune_${attn}_b${b}.json" --batch "$b"
+    done
+done
+DTM_BENCH_ATTN_IMPL=blockwise DTM_FUSED_UNEMBED=0 \
+    bench_one transformer_lm "tpu_r3_tune_blockwise_b16_twostage.json"
+
+# 3. LSTM batch push + flash_check retime (new auto tiles + grad sweep)
+#    + decode (rewritten amortized timing — compile-heavy, so late).
+bench_one ptb_lstm "tpu_r3_tune_ptb_b1024.json" --batch 1024
+bench_one flash_check "tpu_r3_flash_check2.json"
+bench_one decode "tpu_r3_decode.json"
+
+# 4. Remaining mxu models.
+DTM_CONV_IMPL=mxu bench_one resnet32 "tpu_r3_mxu_resnet32.json"
+DTM_CONV_IMPL=mxu bench_one vgg16 "tpu_r3_mxu_vgg16.json"
+DTM_CONV_IMPL=mxu bench_one alexnet "tpu_r3_mxu_alexnet.json"
+
+# 5. Risky tail: long-context through blockwise (the new builder
+#    default), then the native-conv ladder (known trigger #1) dead last.
+bench_one transformer_lm_long "tpu_r3_tune_long_blockwise.json"
+rm -f /tmp/dtm_defer_native_ladder
+DTM_CONV_IMPL=xla python experiments/conv_ladder.py --timeout 420 \
+    --out experiments/conv_ladder_r3.json >> "$LOG" 2>&1
+echo "$(date) [$R] native conv ladder rc=$?" >> "$LOG"
+
+echo "$(date) [$R] gated queue DONE" >> "$LOG"
+touch /tmp/tpu_r3_gated_done
