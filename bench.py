@@ -563,6 +563,14 @@ def build_transformer_lm(n_chips, batch_override, steps):
     )
 
 
+# Flagship transformer dims, shared by the throughput builder, the decode
+# bench and the transformer_parts ablation so they can never silently
+# measure different models.
+FLAGSHIP_TRANSFORMER = dict(
+    num_layers=8, num_heads=8, d_model=512, d_ff=2048
+)
+
+
 def _build_transformer(
     n_chips, batch_override, steps, *, T, default_batch, remat,
     attn_default="auto",
@@ -583,10 +591,7 @@ def _build_transformer(
     batch_size = per_chip_batch * n_chips
     model = get_model(
         "transformer_lm",
-        num_layers=8,
-        num_heads=8,
-        d_model=512,
-        d_ff=2048,
+        **FLAGSHIP_TRANSFORMER,
         max_len=T,
         dropout_rate=0.0,
         remat=remat,
@@ -678,10 +683,7 @@ def run_decode(args):
     def measure(num_kv_heads):
         model = get_model(
             "transformer_lm",
-            num_layers=8,
-            num_heads=8,
-            d_model=512,
-            d_ff=2048,
+            **FLAGSHIP_TRANSFORMER,
             max_len=T_prompt + T_new,
             dropout_rate=0.0,
             num_kv_heads=num_kv_heads,
@@ -960,7 +962,178 @@ ORDER = [
     "decode",
     "transformer_lm_long",
 ]
-CHILD_MODES = sorted(BUILDERS) + ["flash_check", "decode"]
+CHILD_MODES = sorted(BUILDERS) + [
+    "flash_check", "decode", "transformer_parts",
+]
+
+
+def run_transformer_parts(args):
+    """Step-time ablation for the flagship transformer config: times the
+    SAME B16/T=512 model under component knockouts so the gap between
+    measured MFU (25.9% blockwise, tpu_r3_transformer_fused_blockattn)
+    and the matmul roofline can be attributed instead of guessed.
+
+    Variants (each timed as `steps` scanned iterations, one dispatch,
+    identical to run_one's protocol):
+
+    - ``full``          — the real train step (grads + clip + adam)
+    - ``fwd_loss``      — forward + loss only, no grad/update: splits
+                          the step into fwd vs bwd+opt
+    - ``no_head``       — train step with ``loss = mean(h²)`` on the
+                          post-ln_f hidden states: removes the d→V head
+                          matmul + xent from BOTH passes (~17% of
+                          analytic FLOPs at d512/V10k)
+    - ``frozen_embed``  — real loss, but ``stop_gradient`` on the token
+                          embedding table: removes the gather's
+                          scatter-add backward, the classic hidden cost
+                          of TPU LM steps (XLA lowers scatter far less
+                          efficiently than the matmuls around it)
+    - ``no_opt``        — grads computed but state returned un-updated:
+                          isolates clip+adam+param-write traffic
+
+    Attention impl follows DTM_BENCH_ATTN_IMPL (default blockwise — the
+    measured winner at this scale)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_models_tpu.core import mesh as meshlib
+    from distributed_tensorflow_models_tpu.core import train_loop
+    from distributed_tensorflow_models_tpu.core.train_state import TrainState
+    from distributed_tensorflow_models_tpu.models import get_model
+    from distributed_tensorflow_models_tpu.ops import optim
+
+    n_chips = len(jax.devices())
+    steps = args.steps
+    # DTM_PARTS_SMOKE=1 shrinks the model so the 5-variant matrix can be
+    # smoke-tested on a CPU host in seconds; the measurement config is
+    # the flagship one.
+    smoke = os.environ.get("DTM_PARTS_SMOKE") == "1"
+    T = 64 if smoke else 512
+    per_chip_batch = args.batch or 16
+    mesh = meshlib.data_parallel_mesh()
+    batch_size = per_chip_batch * n_chips
+    dims = (
+        dict(num_layers=2, num_heads=2, d_model=64, d_ff=128)
+        if smoke
+        else FLAGSHIP_TRANSFORMER
+    )
+    model = get_model(
+        "transformer_lm",
+        **dims,
+        max_len=T, dropout_rate=0.0,
+        attn_impl=os.environ.get("DTM_BENCH_ATTN_IMPL", "blockwise"),
+    )
+    tx = optax.chain(optim.clip_by_global_norm(1.0), optim.adam(3e-4))
+    state = TrainState.create(
+        model, tx, jax.random.key(0), jnp.zeros((2, T), jnp.int32)
+    )
+    state = train_loop.place_state(state, mesh)
+
+    def make_batch(i):
+        rng = np.random.RandomState(i)
+        tokens = rng.randint(0, 10000, (batch_size, T + 1))
+        return {
+            "inputs": tokens[:, :-1].astype(np.int32),
+            "targets": tokens[:, 1:].astype(np.int32),
+        }
+
+    batches = _stack_batches(mesh, make_batch, nb=max(8, steps))
+    nb = jax.tree.leaves(batches)[0].shape[0]
+    base_loss = train_loop.lm_loss_fn(model.apply, fused_unembed=True)
+
+    def freeze_embed_loss(params, state, batch, rngs):
+        params = dict(params)
+        params["embedding"] = jax.lax.stop_gradient(params["embedding"])
+        params["pos_embedding"] = jax.lax.stop_gradient(
+            params["pos_embedding"]
+        )
+        return base_loss(params, state, batch, rngs)
+
+    def no_head_loss(params, state, batch, rngs):
+        (hidden, _), _ = model.apply(
+            {"params": params}, batch["inputs"], carry=state.carry,
+            train=True, rngs=dict(rngs), mutable=["losses"],
+            return_hidden=True,
+        )
+        loss = jnp.mean(jnp.square(hidden.astype(jnp.float32)))
+        return loss, {"metrics": {"loss": loss}}
+
+    full_step = train_loop.make_train_step_fn(base_loss)
+    nohead_step = train_loop.make_train_step_fn(no_head_loss)
+    frozen_step = train_loop.make_train_step_fn(freeze_embed_loss)
+
+    def fwd_step(state, batch, rng):
+        rngs = train_loop.per_step_rngs(rng, state.step, ("dropout",))
+        loss, _ = base_loss(state.params, state, batch, rngs)
+        # Advance step so the scan carry changes shape-compatibly; no
+        # param update — this variant times the forward pass alone.
+        return state.replace(step=state.step + 1), {"loss": loss}
+
+    def noopt_step(state, batch, rng):
+        rngs = train_loop.per_step_rngs(rng, state.step, ("dropout",))
+        grad_fn = jax.value_and_grad(base_loss, has_aux=True)
+        (loss, _), grads = grad_fn(state.params, state, batch, rngs)
+        # Consume the grads without the optimizer: fold their global
+        # norm into the RETURNED loss (scaled to vanish numerically) —
+        # a separate metric key would be dropped by the scan body and
+        # XLA would dead-code the whole backward out of this variant.
+        loss = loss + 0.0 * optax.global_norm(grads)
+        return state.replace(step=state.step + 1), {"loss": loss}
+
+    def timed(step_fn):
+        def fn(state, batches, rng):
+            def body(s, i):
+                b = jax.tree.map(
+                    lambda x: jax.lax.dynamic_index_in_dim(
+                        x, i % nb, 0, keepdims=False
+                    ),
+                    batches,
+                )
+                s, metrics = step_fn(s, b, rng)
+                return s, metrics["loss"]
+
+            s, losses = jax.lax.scan(body, state, jnp.arange(steps))
+            return losses[-1]
+
+        jfn = jax.jit(fn)
+        rng = jax.random.key(42)
+        float(jfn(state, batches, rng))  # compile + warm
+        t0 = time.perf_counter()
+        loss = float(jfn(state, batches, rng))
+        dt = (time.perf_counter() - t0) / steps
+        return dt, loss
+
+    out = {}
+    for name, fn in (
+        ("full", full_step),
+        ("fwd_loss", fwd_step),
+        ("no_opt", noopt_step),
+        ("no_head", nohead_step),
+        ("frozen_embed", frozen_step),
+    ):
+        dt, loss = timed(fn)
+        out[f"{name}_ms"] = round(dt * 1e3, 3)
+        out[f"{name}_loss"] = round(loss, 4)
+        log(f"transformer_parts {name}: {dt*1e3:.3f} ms/step")
+
+    full = out["full_ms"]
+    return {
+        "metric": "transformer_step_ablation",
+        "value": full,
+        "unit": "ms/step",
+        "batch": per_chip_batch,
+        "seq_len": T,
+        "steps": steps,
+        **out,
+        "implied_bwd_plus_opt_ms": round(full - out["fwd_loss_ms"], 3),
+        "implied_opt_ms": round(full - out["no_opt_ms"], 3),
+        "implied_head_ms": round(full - out["no_head_ms"], 3),
+        "implied_embed_grad_ms": round(
+            full - out["frozen_embed_ms"], 3
+        ),
+    }
 
 
 def run_mode(name, args):
@@ -971,6 +1144,8 @@ def run_mode(name, args):
         return run_flash_check(args)
     if name == "decode":
         return run_decode(args)
+    if name == "transformer_parts":
+        return run_transformer_parts(args)
     return run_one(name, BUILDERS[name], args.steps, args.batch or None)
 
 
