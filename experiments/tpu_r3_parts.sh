@@ -1,0 +1,40 @@
+#!/bin/bash
+# Chained after tpu_r3_gated.sh: banks the transformer_parts step-time
+# ablation (bench.py::run_transformer_parts) once the main gated queue
+# has drained — it shares the queue's health-gating rationale but is
+# junior to every throughput number, so it must not delay them.
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+R=r3-parts
+
+echo "$(date) [$R] waiting for gated queue" >> "$LOG"
+while [ ! -f /tmp/tpu_r3_gated_done ]; do sleep 120; done
+
+probe() {
+    timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax
+import jax.numpy as jnp
+d = jax.devices()
+if d[0].platform != "tpu":
+    raise SystemExit(1)
+x = jnp.ones((512, 512), jnp.bfloat16)
+(x @ x).block_until_ready()
+EOF
+}
+
+until probe; do sleep 240; done
+echo "$(date) [$R] banking transformer_parts (blockwise)" >> "$LOG"
+timeout 1500 python bench.py --config transformer_parts --no-probe \
+    > experiments/tpu_r3_parts_blockwise.json 2>> "$LOG"
+echo "$(date) [$R] rc=$? $(tail -c 300 experiments/tpu_r3_parts_blockwise.json)" >> "$LOG"
+
+until probe; do sleep 240; done
+echo "$(date) [$R] banking transformer_parts (flash)" >> "$LOG"
+DTM_BENCH_ATTN_IMPL=flash timeout 1500 python bench.py \
+    --config transformer_parts --no-probe \
+    > experiments/tpu_r3_parts_flash.json 2>> "$LOG"
+echo "$(date) [$R] rc=$? $(tail -c 300 experiments/tpu_r3_parts_flash.json)" >> "$LOG"
+
+echo "$(date) [$R] DONE" >> "$LOG"
+touch /tmp/tpu_r3_parts_done
