@@ -136,8 +136,12 @@ def _block_update(carry, s_block, v_block):
     alpha = jnp.exp(m - m_new)
     p = jnp.exp(s_block - m_new)
     l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    # p·V in the value dtype with f32 accumulation (p ∈ [0,1]; bf16
+    # round-off here is the standard flash-kernel tradeoff) — f32 values
+    # keep exact f32 math.
     acc_new = alpha * acc + jnp.einsum(
-        "...qk,...kd->...qd", p, v_block.astype(jnp.float32)
+        "...qk,...kd->...qd", p.astype(v_block.dtype), v_block,
+        preferred_element_type=jnp.float32,
     )
     return m_new, l_new, acc_new
 
@@ -172,7 +176,13 @@ def blockwise_attention(
     nblocks = (Tkv + pad) // block_kv
     s = _scale(q, scale)
 
-    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * s  # [B,H,Tq,D]
+    # Scores run in the INPUT dtype with f32 accumulation (the flash
+    # kernel's scheme, _masked_scores): upcasting q/k to f32 first would
+    # push the score matmul to the MXU's f32 rate — measured ~4x slower
+    # on v5e — and double the scanned KV bytes.  f32 inputs keep full
+    # f32 math, so CPU oracle tests are unchanged; the scale folds in
+    # AFTER the dot, in f32.
+    qf = jnp.swapaxes(q, 1, 2)  # [B,H,Tq,D]
     kf = jnp.swapaxes(k, 1, 2)  # [B,H,Tkv,D]
     vf = jnp.swapaxes(v, 1, 2)
     if pad:
@@ -190,9 +200,9 @@ def blockwise_attention(
         # pass O(T·block) too.
         j, k_j, v_j = inp
         s_block = jnp.einsum(
-            "bhqd,bhkd->bhqk", qf, k_j.astype(jnp.float32),
+            "bhqd,bhkd->bhqk", qf, k_j,
             preferred_element_type=jnp.float32,
-        )
+        ) * s
         lk = j * block_kv + jnp.arange(block_kv)[None, :]  # local kv index
         valid = lk < Tkv
         if causal:
@@ -204,10 +214,12 @@ def blockwise_attention(
         return _block_update(carry, s_block, v_j), None
 
     # Carries derive from qf to inherit its device-varying axis type, so
-    # this scan also works nested inside shard_map (Ulysses path).
-    m0 = jnp.zeros_like(qf[..., :1]) + NEG_INF
-    l0 = jnp.zeros_like(qf[..., :1])
-    a0 = jnp.zeros_like(qf)
+    # this scan also works nested inside shard_map (Ulysses path) — but
+    # are pinned to f32 (qf now keeps the input dtype, and the softmax
+    # state must not accumulate in bf16).
+    m0 = jnp.zeros_like(qf[..., :1], dtype=jnp.float32) + NEG_INF
+    l0 = jnp.zeros_like(qf[..., :1], dtype=jnp.float32)
+    a0 = jnp.zeros_like(qf, dtype=jnp.float32)
     (m, l, acc), _ = jax.lax.scan(
         body, (m0, l0, a0), (jnp.arange(nblocks), kb, vb)
     )

@@ -121,17 +121,24 @@ def _ring_attention_local(
     g = attnlib._group_size(q, k)
     s = attnlib._scale(q, scale)
 
-    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * s  # [B,H,Tl,D]
+    # Scores run in the INPUT dtype with f32 accumulation, matching
+    # blockwise_attention and the Pallas kernels (_masked_scores): an f32
+    # upcast would run the score matmul at the MXU's f32 rate (~4x
+    # slower, measured on v5e) and re-pay the cast on every rotation's
+    # k_cur.  The scale folds in after the dot, in f32.
+    qf = jnp.swapaxes(q, 1, 2)  # [B,H,Tl,D]
     if g > 1:
         qf = qf.reshape(B, Hkv, g * Tl, D)
     rows = qf.shape[2]  # g*Tl folded rows; row r sits at position r % Tl
     q_off = my * Tl
 
     # Derive the carries from qf so they inherit its varying-axis type
-    # (shard_map requires scan carries device-varying like the body output).
-    m0 = jnp.zeros_like(qf[..., :1]) + attnlib.NEG_INF
-    l0 = jnp.zeros_like(qf[..., :1])
-    a0 = jnp.zeros_like(qf)
+    # (shard_map requires scan carries device-varying like the body
+    # output) — pinned to f32: the softmax state must not accumulate in
+    # the (possibly bf16) input dtype.
+    m0 = jnp.zeros_like(qf[..., :1], dtype=jnp.float32) + attnlib.NEG_INF
+    l0 = jnp.zeros_like(qf[..., :1], dtype=jnp.float32)
+    a0 = jnp.zeros_like(qf, dtype=jnp.float32)
 
     # Rotate KV around the ring; at rotation r this device holds the chunk
     # that originated on rank (my - r) mod n.
@@ -148,9 +155,9 @@ def _ring_attention_local(
         def fold(mla):
             m, l, acc = mla
             s_block = jnp.einsum(
-                "bhqd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+                "bhqd,bkhd->bhqk", qf, k_cur,
                 preferred_element_type=jnp.float32,
-            )
+            ) * s
             if causal or window is not None:
                 qi = q_off + (jnp.arange(rows) % Tl)[:, None]
                 kj = kv_off + jnp.arange(Tl)[None, :]

@@ -1,8 +1,10 @@
 #!/bin/bash
 # Chained after tpu_r3_gated.sh: banks the transformer_parts step-time
 # ablation (bench.py::run_transformer_parts) once the main gated queue
-# has drained — it shares the queue's health-gating rationale but is
-# junior to every throughput number, so it must not delay them.
+# has drained — it shares the queue's health-gating but is junior to
+# every throughput number, so it must not delay them.  Re-runnable:
+# already-banked (error-free) artifacts are skipped, so a re-launch
+# after a partial pass only re-measures what failed.
 set -u
 cd "$(dirname "$0")/.."
 LOG=experiments/tpu_recovery.log
@@ -23,18 +25,38 @@ x = jnp.ones((512, 512), jnp.bfloat16)
 EOF
 }
 
-until probe; do sleep 240; done
-echo "$(date) [$R] banking transformer_parts (blockwise)" >> "$LOG"
-timeout 1500 python bench.py --config transformer_parts --no-probe \
-    > experiments/tpu_r3_parts_blockwise.json 2>> "$LOG"
-echo "$(date) [$R] rc=$? $(tail -c 300 experiments/tpu_r3_parts_blockwise.json)" >> "$LOG"
+wait_healthy() {
+    local n=0
+    until probe; do
+        n=$((n + 1))
+        if [ $((n % 3)) -eq 1 ]; then
+            echo "$(date) [$R] relay unhealthy (probe $n); waiting" >> "$LOG"
+        fi
+        sleep 240
+    done
+    if [ "$n" -gt 0 ]; then
+        echo "$(date) [$R] relay RECOVERED after $n failed probes" >> "$LOG"
+    fi
+}
 
-until probe; do sleep 240; done
-echo "$(date) [$R] banking transformer_parts (flash)" >> "$LOG"
-DTM_BENCH_ATTN_IMPL=flash timeout 1500 python bench.py \
-    --config transformer_parts --no-probe \
-    > experiments/tpu_r3_parts_flash.json 2>> "$LOG"
-echo "$(date) [$R] rc=$? $(tail -c 300 experiments/tpu_r3_parts_flash.json)" >> "$LOG"
+bench_one() {  # name outfile [extra bench args...]
+    local name="$1" out="$2"; shift 2
+    if [ -s "experiments/$out" ] && ! grep -q '"error"' "experiments/$out"; then
+        echo "$(date) [$R] skip $name -> $out (already banked)" >> "$LOG"
+        return 0
+    fi
+    wait_healthy
+    echo "$(date) [$R] bench $name -> $out $*" >> "$LOG"
+    timeout 1500 python bench.py --config "$name" --no-probe "$@" \
+        > "experiments/$out" 2>> "$LOG"
+    local rc=$?
+    echo "$(date) [$R] bench $name rc=$rc $(tail -c 300 "experiments/$out" 2>/dev/null)" >> "$LOG"
+    return $rc
+}
+
+bench_one transformer_parts "tpu_r3_parts_blockwise.json"
+DTM_BENCH_ATTN_IMPL=flash \
+    bench_one transformer_parts "tpu_r3_parts_flash.json"
 
 echo "$(date) [$R] DONE" >> "$LOG"
 touch /tmp/tpu_r3_parts_done
