@@ -555,11 +555,14 @@ def build_ptb_lstm(n_chips, batch_override, steps):
 
 
 def build_transformer_lm(n_chips, batch_override, steps):
-    """Flagship causal LM at T=512: 8-layer d512, attention via
-    ops/attention.py 'auto' (Pallas flash on TPU — tile-aligned seq —
-    blockwise elsewhere).  Unit: tokens/sec/chip."""
+    """Flagship causal LM at T=512: 8-layer d512.  Attention defaults to
+    BLOCKWISE — the measured end-to-end training winner at this shape
+    (25.9% vs 20.6% MFU for the Pallas flash route on v5e,
+    experiments/TPU_BENCH_r3.md); DTM_BENCH_ATTN_IMPL overrides for
+    A/Bs.  Unit: tokens/sec/chip."""
     return _build_transformer(
-        n_chips, batch_override, steps, T=512, default_batch=16, remat=False
+        n_chips, batch_override, steps, T=512, default_batch=16,
+        remat=False, attn_default="blockwise",
     )
 
 

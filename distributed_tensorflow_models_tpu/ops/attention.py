@@ -861,15 +861,18 @@ def attention(
     window: Optional[int] = None,
 ) -> jax.Array:
     """Dispatching entry point: ``impl`` in {auto, reference, blockwise,
-    flash}.  ``auto`` = flash kernel on TPU (when seq lens are
-    tile-aligned), blockwise elsewhere."""
+    flash}.
+
+    ``auto`` routes to BLOCKWISE on every backend: it is the measured
+    end-to-end training winner at every shape banked on hardware so far
+    (v5e, experiments/TPU_BENCH_r3.md — 25.9% vs 20.6% MFU at T=512;
+    at T=2048 the tuned flash forward wins 1.14x but the FA2 backward
+    pair loses 0.65x, which dominates a train step).  The Pallas kernels
+    stay first-class via ``impl="flash"`` (and the ring path's fused
+    chunk kernels) — ``auto`` flips back the day the kernel pair wins a
+    banked end-to-end measurement."""
     if impl == "auto":
-        aligned = q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
-        impl = (
-            "flash"
-            if jax.default_backend() == "tpu" and aligned
-            else "blockwise"
-        )
+        impl = "blockwise"
     if impl == "reference":
         return reference_attention(
             q, k, v, causal=causal, scale=scale, window=window
