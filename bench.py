@@ -868,7 +868,8 @@ def run_flash_check(args):
     auto_bq, auto_bkv = attnlib._check_blocks(T, T, None, None)
     sweep = {f"auto:{auto_bq}x{auto_bkv}": round(f_dt * 1e3, 3)}
     for bq, bkv in ((128, 128), (128, 256), (256, 128), (256, 256),
-                    (128, 512), (512, 128)):
+                    (128, 512), (512, 128), (256, 512), (512, 256),
+                    (512, 512)):
         try:
             _, dt = timed(
                 lambda q, k, v, bq=bq, bkv=bkv: attnlib.flash_attention(
@@ -886,7 +887,7 @@ def run_flash_check(args):
     # auto-resolved tile reuses f_grad_dt (measured above) instead of
     # recompiling the identical program on scarce relay time.
     grad_sweep = {f"auto:{auto_bq}x{auto_bkv}": round(f_grad_dt * 1e3, 3)}
-    for bq, bkv in ((128, 128), (256, 256)):
+    for bq, bkv in ((128, 128), (256, 256), (512, 512)):
         if (bq, bkv) == (auto_bq, auto_bkv):
             continue
         try:

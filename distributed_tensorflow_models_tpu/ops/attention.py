@@ -28,6 +28,7 @@ Layout convention everywhere: ``[batch, seq, heads, head_dim]`` (BTHD).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -883,9 +884,28 @@ def attention(
         )
     if impl == "flash":
         # None blocks resolve per-length via _auto_block (256 where the
-        # sweep-measured winner divides, else 128).  Positional:
-        # custom_vjp + nondiff_argnums is positional-indexed.
+        # sweep-measured winner divides, else 128).  DTM_FLASH_TILE
+        # forces a square tile for end-to-end tile A/Bs (read at trace
+        # time, same contract as DTM_CONV_IMPL in ops/conv.py).
+        # Positional: custom_vjp + nondiff_argnums is positional-indexed.
+        tile = os.environ.get("DTM_FLASH_TILE")
+        bq = bkv = None
+        if tile:
+            # Fail loudly naming the knob (the DTM_CONV_IMPL contract):
+            # a typo must not surface as a bare int()/ZeroDivisionError
+            # mid-trace on a scarce healthy-relay bench slot.
+            try:
+                bq = bkv = int(tile)
+            except ValueError:
+                raise ValueError(
+                    f"DTM_FLASH_TILE must be an integer, got {tile!r}"
+                ) from None
+            if bq <= 0 or bq % 8:
+                raise ValueError(
+                    "DTM_FLASH_TILE must be a positive multiple of 8, "
+                    f"got {tile!r}"
+                )
         return flash_attention(
-            q, k, v, causal, scale, None, None, False, window
+            q, k, v, causal, scale, bq, bkv, False, window
         )
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,64 @@
+# Shared helpers for the chained TPU bench runners — source this with
+# R (log tag) and LOG set:
+#
+#   LOG=experiments/tpu_recovery.log
+#   R=my-runner
+#   . "$(dirname "$0")/tpu_gate_lib.sh"
+#
+# probe        — subprocess backend check, 90 s cap: devices() answers,
+#                platform is tpu, and a small matmul completes.  A
+#                wedged relay hangs at devices(); the timeout kills the
+#                probe before it reaches any compile, so probing never
+#                worsens the wedge.
+# wait_healthy — sleep-loop on probe with progress logging (one line
+#                per 3 failed probes, one on recovery).
+# bench_one    — health-gated, re-runnable bench.py invocation: skips
+#                outputs already banked without an "error" key, so a
+#                re-launched runner only re-measures what failed.
+#
+# History: rounds 1-3 showed killed/wedged remote compiles poison the
+# relay for every later process (conv HLO, then flash at T=4096), and a
+# blind queue then burns its whole timeout budget against a dead
+# backend.  Every runner after the 2026-07-31 re-wedge gates on these
+# helpers instead of carrying its own copy.
+
+probe() {
+    timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax
+import jax.numpy as jnp
+d = jax.devices()
+if d[0].platform != "tpu":
+    raise SystemExit(1)
+x = jnp.ones((512, 512), jnp.bfloat16)
+(x @ x).block_until_ready()
+EOF
+}
+
+wait_healthy() {
+    local n=0
+    until probe; do
+        n=$((n + 1))
+        if [ $((n % 3)) -eq 1 ]; then
+            echo "$(date) [$R] relay unhealthy (probe $n); waiting" >> "$LOG"
+        fi
+        sleep 240
+    done
+    if [ "$n" -gt 0 ]; then
+        echo "$(date) [$R] relay RECOVERED after $n failed probes" >> "$LOG"
+    fi
+}
+
+bench_one() {  # name outfile [extra bench args...]
+    local name="$1" out="$2"; shift 2
+    if [ -s "experiments/$out" ] && ! grep -q '"error"' "experiments/$out"; then
+        echo "$(date) [$R] skip $name -> $out (already banked)" >> "$LOG"
+        return 0
+    fi
+    wait_healthy
+    echo "$(date) [$R] bench $name -> $out $*" >> "$LOG"
+    timeout 1500 python bench.py --config "$name" --no-probe "$@" \
+        > "experiments/$out" 2>> "$LOG"
+    local rc=$?
+    echo "$(date) [$R] bench $name rc=$rc $(tail -c 300 "experiments/$out" 2>/dev/null)" >> "$LOG"
+    return $rc
+}

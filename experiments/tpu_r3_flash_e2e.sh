@@ -1,0 +1,22 @@
+#!/bin/bash
+# Chained after tpu_r3_parts.sh: end-to-end flash-tile A/B at the
+# flagship T=512 config.  flash_check times the kernel alone; this
+# answers whether a whole-sequence tile (512 = one grid step per head
+# at T=512) or the sweep-winning 256 can beat the blockwise route in a
+# real train step — the measurement that would flip auto back to flash.
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+R=r3-flash-e2e
+. "$(dirname "$0")/tpu_gate_lib.sh"
+
+echo "$(date) [$R] waiting for parts runner" >> "$LOG"
+while [ ! -f /tmp/tpu_r3_parts_done ]; do sleep 120; done
+
+DTM_BENCH_ATTN_IMPL=flash DTM_FLASH_TILE=512 \
+    bench_one transformer_lm "tpu_r3_flash_e2e_t512.json"
+DTM_BENCH_ATTN_IMPL=flash DTM_FLASH_TILE=256 \
+    bench_one transformer_lm "tpu_r3_flash_e2e_t256.json"
+
+echo "$(date) [$R] DONE" >> "$LOG"
+touch /tmp/tpu_r3_flash_e2e_done
