@@ -905,6 +905,17 @@ def attention(
                     "DTM_FLASH_TILE must be a positive multiple of 8, "
                     f"got {tile!r}"
                 )
+            # The knob exists for tile A/Bs: a tile the lengths don't
+            # divide would be silently clamped by _check_blocks (tile >
+            # T) or die mid-trace with an error that doesn't name the
+            # knob — either way the A/B artifacts would mislabel what
+            # they measured.
+            for which, L in (("query", q.shape[1]), ("key", k.shape[1])):
+                if L % bq:
+                    raise ValueError(
+                        f"DTM_FLASH_TILE={tile} does not divide the "
+                        f"{which} length {L}"
+                    )
         return flash_attention(
             q, k, v, causal, scale, bq, bkv, False, window
         )

@@ -8,7 +8,11 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=experiments/tpu_recovery.log
 R=r3-flash-e2e
-. "$(dirname "$0")/tpu_gate_lib.sh"
+# Source AFTER the cd, repo-root-relative: $(dirname "$0") would be '.'
+# when invoked from inside experiments/, and a failed source under set
+# -u alone would let the script log DONE without ever defining
+# bench_one.
+. experiments/tpu_gate_lib.sh
 
 echo "$(date) [$R] waiting for parts runner" >> "$LOG"
 while [ ! -f /tmp/tpu_r3_parts_done ]; do sleep 120; done

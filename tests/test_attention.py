@@ -611,3 +611,92 @@ def test_ring_gqa_rejects_indivisible_heads(seq_mesh):
     q, k, v = _qkv(B=2, T=64, H=4, D=16)
     with pytest.raises(ValueError):
         ring.ring_attention(q, k[:, :, :3], v[:, :, :3], seq_mesh)
+
+
+# --------------------------------------------------- round-3 tuning layer
+
+
+def test_auto_block_resolution():
+    """None tiles resolve per-length: 256 where divisible (the v5e sweep
+    winner, experiments/tpu_r3_flash_check_detail.json), 128 fallback,
+    clamped to the sequence length."""
+    assert attnlib._check_blocks(512, 512, None, None) == (256, 256)
+    assert attnlib._check_blocks(2048, 2048, None, None) == (256, 256)
+    assert attnlib._check_blocks(384, 384, None, None) == (128, 128)
+    assert attnlib._check_blocks(64, 64, None, None) == (64, 64)
+    assert attnlib._check_blocks(512, 384, None, None) == (256, 128)
+    # Explicit tiles still validated against divisibility.
+    with pytest.raises(ValueError):
+        attnlib._check_blocks(384, 384, 256, 256)
+
+
+def test_auto_impl_is_blockwise():
+    """auto == blockwise bit-for-bit (the measured end-to-end training
+    winner on every banked hardware shape — TPU_BENCH_r3.md); flash
+    stays opt-in."""
+    q, k, v = _qkv(T=256)
+    a = attnlib.attention(q, k, v, causal=True, impl="auto")
+    b = attnlib.attention(q, k, v, causal=True, impl="blockwise")
+    assert jnp.array_equal(a, b)
+
+
+def test_flash_tile_env_validated(monkeypatch):
+    """DTM_FLASH_TILE typos must fail loudly naming the knob (the
+    DTM_CONV_IMPL contract), not as a bare int()/ZeroDivisionError
+    mid-trace."""
+    q, k, v = _qkv(T=128)
+    for bad in ("bogus", "0", "-128", "100"):
+        monkeypatch.setenv("DTM_FLASH_TILE", bad)
+        with pytest.raises(ValueError, match="DTM_FLASH_TILE"):
+            attnlib.attention(q, k, v, impl="flash")
+
+
+def test_blockwise_bf16_matches_f32_reference():
+    """bf16 inputs take the input-dtype matmul path (f32 accumulation):
+    results must stay within bf16 round-off of the full-f32 reference,
+    forward and grad."""
+    q, k, v = _qkv(T=192)
+    ref = attnlib.reference_attention(q, k, v, causal=True)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = attnlib.blockwise_attention(qb, kb, vb, causal=True, block_kv=64)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=3e-2, atol=3e-2
+    )
+
+    g_ref = jax.grad(
+        lambda q: jnp.sum(
+            attnlib.reference_attention(q, k, v, causal=True) ** 2
+        )
+    )(q)
+    g_bf = jax.grad(
+        lambda q: jnp.sum(
+            attnlib.blockwise_attention(
+                q, kb, vb, causal=True, block_kv=64
+            ).astype(jnp.float32)
+            ** 2
+        )
+    )(qb)
+    np.testing.assert_allclose(
+        np.asarray(g_bf, np.float32), np.asarray(g_ref),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
+def test_blockwise_f32_unchanged_by_dtype_scheme():
+    """f32 inputs keep full f32 math — the input-dtype scheme must not
+    perturb the CPU oracle path beyond reordering-level noise."""
+    q, k, v = _qkv(T=192)
+    ref = attnlib.reference_attention(q, k, v, causal=True)
+    out = attnlib.blockwise_attention(q, k, v, causal=True, block_kv=64)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_tile_env_must_divide_lengths(monkeypatch):
+    """A forced tile the lengths don't divide must fail naming the knob
+    — not silently clamp (tile > T) or die with a generic block error."""
+    q, k, v = _qkv(T=128)
+    monkeypatch.setenv("DTM_FLASH_TILE", "512")
+    with pytest.raises(ValueError, match="DTM_FLASH_TILE"):
+        attnlib.attention(q, k, v, impl="flash")
