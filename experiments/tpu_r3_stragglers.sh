@@ -1,0 +1,20 @@
+#!/bin/bash
+# Last link of the round-3 chain (after tpu_r3_flash_e2e.sh): banks the
+# R7 throughput pair through the patches lowering — the one BASELINE
+# model family the 02:00-03:43 healthy window never reached — plus a
+# fused-vs-twostage LSTM head A/B at the winning batch.
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+R=r3-stragglers
+. experiments/tpu_gate_lib.sh
+
+echo "$(date) [$R] waiting for flash-e2e runner" >> "$LOG"
+while [ ! -f /tmp/tpu_r3_flash_e2e_done ]; do sleep 120; done
+
+bench_one vgg16 "tpu_r3_vgg16.json"
+bench_one alexnet "tpu_r3_alexnet.json"
+DTM_FUSED_UNEMBED=0 bench_one ptb_lstm "tpu_r3_ptb_b512_twostage.json" --batch 512
+
+echo "$(date) [$R] DONE" >> "$LOG"
+touch /tmp/tpu_r3_stragglers_done
