@@ -1,0 +1,60 @@
+#!/bin/bash
+# Tail of the round-3 chain: a definitive answer to VERDICT r2 Weak #4
+# ("buffer donation is disabled on the platform that matters").  The
+# fused-scan benches never test aliasing — the train state is a scan
+# CARRY inside one compiled program there, so donate_argnums never
+# enters the picture (which is also why the DTM_DONATE=1 bench arm
+# measured no change).  Donation matters for the real per-dispatch
+# `fit` loop; this probe jits a real train step with donate_argnums=(0,)
+# on the relay, runs two steps, and records worked / INVALID_ARGUMENT.
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+R=r3-donate
+. experiments/tpu_gate_lib.sh
+
+echo "$(date) [$R] waiting for stragglers runner" >> "$LOG"
+while [ ! -f /tmp/tpu_r3_stragglers_done ]; do sleep 120; done
+wait_healthy
+
+echo "$(date) [$R] probing donation on the relay" >> "$LOG"
+timeout 600 python - > experiments/tpu_r3_donate_probe.json 2>> "$LOG" <<'EOF'
+import json
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_tensorflow_models_tpu.core import mesh as meshlib
+from distributed_tensorflow_models_tpu.core import train_loop
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.ops import optim
+
+mesh = meshlib.data_parallel_mesh()
+model = get_model("transformer_lm", num_layers=2, num_heads=2, d_model=64,
+                  d_ff=128, max_len=32, dropout_rate=0.0)
+tx = optax.chain(optim.clip_by_global_norm(1.0), optim.adam(1e-3))
+state = TrainState.create(model, tx, jax.random.key(0),
+                          jnp.zeros((2, 32), jnp.int32))
+state = train_loop.place_state(state, mesh)
+loss_fn = train_loop.lm_loss_fn(model.apply, fused_unembed=True)
+step = jax.jit(train_loop.make_train_step_fn(loss_fn),
+               donate_argnums=(0,))
+tok = jnp.zeros((4, 32), jnp.int32)
+batch = {"inputs": tok, "targets": tok}
+out = {"platform": jax.devices()[0].platform,
+       "device": jax.devices()[0].device_kind}
+try:
+    state, m = step(state, batch, jax.random.key(1))
+    state, m = step(state, batch, jax.random.key(1))
+    jax.block_until_ready(state.params)
+    out.update(donation="works",
+               loss=float(m["loss"]),
+               step=int(state.step))
+except Exception as e:  # noqa: BLE001 — the error IS the result
+    out.update(donation="rejected", error=f"{type(e).__name__}: {e}"[:300])
+print(json.dumps(out))
+EOF
+echo "$(date) [$R] rc=$? $(cat experiments/tpu_r3_donate_probe.json 2>/dev/null | head -c 300)" >> "$LOG"
+echo "$(date) [$R] DONE" >> "$LOG"
+touch /tmp/tpu_r3_donate_probe_done
