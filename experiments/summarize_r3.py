@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Collate every round-3 TPU artifact into one markdown table.
+
+Reads ``experiments/tpu_r3_*.json`` (the one-line bench outputs) and
+prints | artifact | metric | value | unit | MFU | platform | — errors
+and empty files are listed separately so a partially-banked queue is
+visible at a glance.  Used to refresh TPU_BENCH_r3.md after the gated
+runners drain; writes nothing itself.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    rows, errors, empty = [], [], []
+    for path in sorted(glob.glob(os.path.join(here, "tpu_r3_*.json"))):
+        name = os.path.basename(path)
+        if name.endswith("_detail.json"):
+            continue
+        try:
+            with open(path) as f:
+                text = f.read().strip()
+        except OSError as e:
+            errors.append((name, f"unreadable: {e}"))
+            continue
+        if not text:
+            empty.append(name)
+            continue
+        try:
+            d = json.loads(text.splitlines()[-1])
+        except json.JSONDecodeError as e:
+            errors.append((name, f"bad json: {e}"))
+            continue
+        if "error" in d:
+            errors.append((name, str(d["error"])[:100]))
+            continue
+        mfu = d.get("mfu")
+        rows.append(
+            (
+                name,
+                d.get("metric", "?"),
+                d.get("value"),
+                d.get("unit", ""),
+                f"{mfu:.1%}" if isinstance(mfu, float) else "—",
+                d.get("platform", "?"),
+            )
+        )
+
+    print("| artifact | metric | value | unit | MFU | platform |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print("| " + " | ".join(str(x) for x in r) + " |")
+    if errors:
+        print("\nErrored artifacts:\n")
+        for name, err in errors:
+            print(f"- `{name}` — {err}")
+    if empty:
+        print("\nEmpty (in-flight or killed):\n")
+        for name in empty:
+            print(f"- `{name}`")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
