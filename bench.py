@@ -1236,7 +1236,11 @@ def _orchestrate(args):
     def on_alarm(signum, frame):
         if results:
             errors["_watchdog"] = f"expired after {args.watchdog}s"
-            _emit_final(results, errors, run_info["attempts"])
+            # force_cpu resolves at fire time: the alarm only goes off
+            # inside the bench loop, after it was assigned.
+            _emit_final(
+                results, errors, run_info["attempts"], force_cpu=force_cpu
+            )
         else:
             emit_failure(
                 f"watchdog expired after {args.watchdog}s",
@@ -1358,10 +1362,10 @@ def _orchestrate(args):
     if not results:
         emit_failure(f"all configs failed: {errors}", attempts)
         sys.exit(1)
-    _emit_final(results, errors, attempts)
+    _emit_final(results, errors, attempts, force_cpu=force_cpu)
 
 
-def _emit_final(results, errors, attempts):
+def _emit_final(results, errors, attempts, force_cpu=False):
     head_name = HEADLINE if HEADLINE in results else next(iter(results))
     head = results[head_name]
     # Full per-config detail goes to a FILE (the round-2 lesson:
@@ -1417,6 +1421,11 @@ def _emit_final(results, errors, attempts):
         line["config_errors"] = {
             k: str(v)[:120] for k, v in errors.items()
         }
+    if force_cpu:
+        # A CPU-fallback run must not read as "this framework has no TPU
+        # numbers": point the consumer at the committed hardware
+        # artifacts from the last healthy relay window.
+        line["tpu_artifacts"] = "experiments/TPU_BENCH_r3.md"
     emit(line)
 
 

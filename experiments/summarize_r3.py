@@ -41,10 +41,17 @@ def main() -> int:
             errors.append((name, str(d["error"])[:100]))
             continue
         mfu = d.get("mfu")
+        metric = d.get("metric", "?")
+        if d.get("config_errors"):
+            # A partial (e.g. watchdog-truncated) run still carries a
+            # headline; flag it so the table can't pass it off as a
+            # clean full-queue result.
+            bad = ", ".join(sorted(d["config_errors"]))
+            metric += f" (PARTIAL: {bad} errored)"
         rows.append(
             (
                 name,
-                d.get("metric", "?"),
+                metric,
                 d.get("value"),
                 d.get("unit", ""),
                 f"{mfu:.1%}" if isinstance(mfu, float) else "—",
