@@ -884,12 +884,18 @@ def run_flash_check(args):
     # Backward tile sweep (fwd+bwd total via grad_timed): the forward
     # winner is not automatically the backward winner — the FA2 kernel
     # pair re-walks the score blocks with different matmul shapes.  The
-    # auto-resolved tile reuses f_grad_dt (measured above) instead of
-    # recompiling the identical program on scarce relay time.
-    grad_sweep = {f"auto:{auto_bq}x{auto_bkv}": round(f_grad_dt * 1e3, 3)}
+    # default (tiles=None) path now resolves fwd and bwd tiles
+    # INDEPENDENTLY (_auto_block vs _auto_block_bwd), so f_grad_dt is a
+    # fwd@auto/bwd@auto measurement and must be labeled as such — and
+    # every explicit square tile (which pins BOTH directions) must run,
+    # including the one matching the forward auto tile, or the sweep
+    # never measures a true 256x256 backward.
+    auto_bwd = attnlib._auto_block_bwd(T)
+    grad_sweep = {
+        f"auto:fwd{auto_bq}x{auto_bkv}/bwd{auto_bwd}x{auto_bwd}":
+            round(f_grad_dt * 1e3, 3)
+    }
     for bq, bkv in ((128, 128), (256, 256), (512, 512)):
-        if (bq, bkv) == (auto_bq, auto_bkv):
-            continue
         try:
             dt = grad_timed(
                 lambda q, k, v, bq=bq, bkv=bkv: attnlib.flash_attention(
@@ -944,23 +950,25 @@ BUILDERS = {
     "transformer_lm_long": build_transformer_lm_long,
 }
 HEADLINE = "resnet50"
-# Execution order = relay-risk order, safest first: a killed or wedged
-# remote compile can poison the relay for every process after it.  The
-# r1-r2 trigger was conv HLO; on 2026-07-31 the T=4096 flash config
-# became the second known trigger (timed out at 900 s without reaching
-# its first compile log, and the relay answered nothing afterwards —
-# experiments/tpu_r3_transformer_long.json).  So: proven matmul configs
-# first, patches-lowered convs next (proven on hardware this round),
-# then the rewritten decode bench (heavier nested-scan compile, not yet
-# proven), and transformer_lm_long DEAD LAST.
+# Execution order = relay-risk order crossed with headline-first: a
+# killed or wedged remote compile can poison the relay for every process
+# after it (r1-r2 trigger: conv HLO; 2026-07-31 trigger #2: the T=4096
+# flash config — experiments/tpu_r3_transformer_long.json), and the
+# driver may kill the whole run at any budget, so whatever matters most
+# must complete earliest.  ptb/transformer are the proven matmul warmup;
+# resnet50 (the headline, patches-lowered — proven on hardware in r3)
+# comes THIRD so an external kill after ~5 min still leaves a headline
+# line with vs_baseline populated; then the remaining proven convs,
+# flash_check's many Pallas compiles, the unproven decode compile, and
+# transformer_lm_long DEAD LAST.
 ORDER = [
     "ptb_lstm",
     "transformer_lm",
-    "flash_check",
+    "resnet50",
     "lenet",
     "resnet32",
-    "resnet50",
     "inception_v3",
+    "flash_check",
     "alexnet",
     "vgg16",
     "decode",
@@ -1183,9 +1191,14 @@ def main():
     p.add_argument(
         "--batch", type=int, default=0, help="per-chip batch override"
     )
-    p.add_argument("--probe-attempts", type=int, default=3)
-    p.add_argument("--probe-timeout", type=float, default=120.0)
-    p.add_argument("--probe-backoff", type=float, default=10.0)
+    # Probe defaults sized so that even a fully wedged relay (every probe
+    # hangs to its timeout) resolves to CPU fallback in ~2.5 min — the
+    # r1-r3 driver budgets were evidently ~5-20 min total, and 3x120s of
+    # probing alone could eat a short one.  A healthy relay answers
+    # devices() in seconds, so two 70s attempts lose no real coverage.
+    p.add_argument("--probe-attempts", type=int, default=2)
+    p.add_argument("--probe-timeout", type=float, default=70.0)
+    p.add_argument("--probe-backoff", type=float, default=5.0)
     p.add_argument(
         "--config-timeout",
         type=float,
@@ -1230,14 +1243,14 @@ def _orchestrate(args):
     # Defined BEFORE the alarm is armed: the watchdog must emit whatever
     # has already been banked, not discard finished configs (a partial
     # result line beats a bare failure every time — the headline may
-    # already be in it).
+    # already be in it).  force_cpu likewise: the handler closes over it,
+    # so it must exist from the moment the alarm can fire.
     results, errors = {}, {}
+    force_cpu = False
 
     def on_alarm(signum, frame):
         if results:
             errors["_watchdog"] = f"expired after {args.watchdog}s"
-            # force_cpu resolves at fire time: the alarm only goes off
-            # inside the bench loop, after it was assigned.
             _emit_final(
                 results, errors, run_info["attempts"], force_cpu=force_cpu
             )
@@ -1251,7 +1264,6 @@ def _orchestrate(args):
     signal.signal(signal.SIGALRM, on_alarm)
     signal.alarm(int(args.watchdog))
 
-    force_cpu = False
     if not args.no_probe:
         ok, attempts, err = probe_backend(
             args.probe_attempts, args.probe_timeout, args.probe_backoff
@@ -1264,22 +1276,27 @@ def _orchestrate(args):
 
     names = list(ORDER) if args.config == "all" else [args.config]
     if force_cpu and args.config == "all":
-        # No point paying a subprocess JAX startup just to learn the
-        # Mosaic kernel needs the TPU we already know is unusable; and the
-        # T=4096 long-context config is CPU-hopeless at any batch (one
-        # remat'd step is ~40x the shrunk transformer_lm step — it would
-        # burn its whole config timeout on this 2-core host).
-        for name in ("flash_check", "transformer_lm_long"):
-            if name in names:
-                names.remove(name)
-                log(f"skipping {name}: TPU backend unusable")
+        # CPU fallback runs ONLY configs proven to finish in seconds on a
+        # 2-core host.  The round-3 driver record (BENCH_r03.json, rc=124,
+        # parsed: null) is the lesson: its fallback queued resnet50, which
+        # alone ate 421.9 s at steps=3/batch=4 and the external kill landed
+        # before any stdout JSON.  flash_check needs the Mosaic TPU path;
+        # transformer_lm_long's remat'd T=4096 step is CPU-hopeless; the
+        # 224x224 conv models and decode each burn minutes.  Their absence
+        # is recorded in config_errors so the line says what was skipped.
+        cpu_fast = ["ptb_lstm", "transformer_lm", "lenet", "resnet32"]
+        for name in names:
+            if name not in cpu_fast:
+                errors[name] = "skipped on CPU fallback (too slow for 2-core host)"
+        names = [n for n in names if n in cpu_fast]
+        log(f"CPU fallback: pruned config list to {names}")
     if force_cpu:
         # CPU numbers are evidence-of-life, not performance: shrink the
         # workload so every config finishes inside its timeout on a
-        # 2-core host (a batch-256 ResNet-50 would burn the whole budget).
+        # 2-core host.
         if not args.batch:
-            args.batch = 4
-        args.steps = min(args.steps, 3)
+            args.batch = 2
+        args.steps = min(args.steps, 2)
         log(
             f"CPU fallback: shrinking workload to steps={args.steps}, "
             f"batch={args.batch}/chip"
@@ -1357,6 +1374,17 @@ def _orchestrate(args):
             log(f"{name} FAILED: {errors[name]}")
         else:
             log(f"{name}: {results[name]}")
+        if len(names) > 1 and results and name is not names[-1]:
+            # Last-line-wins: re-emit the full compact headline line after
+            # EVERY config, so an external kill at any moment (the r1-r3
+            # failure mode: driver budget < watchdog, rc=124, parsed: null)
+            # still leaves a parseable final stdout line with everything
+            # banked so far.  Single-config runs keep exactly one line for
+            # the gated-runner artifacts.
+            _emit_final(
+                results, dict(errors), attempts,
+                force_cpu=force_cpu, partial=True,
+            )
 
     signal.alarm(0)
     if not results:
@@ -1365,7 +1393,7 @@ def _orchestrate(args):
     _emit_final(results, errors, attempts, force_cpu=force_cpu)
 
 
-def _emit_final(results, errors, attempts, force_cpu=False):
+def _emit_final(results, errors, attempts, force_cpu=False, partial=False):
     head_name = HEADLINE if HEADLINE in results else next(iter(results))
     head = results[head_name]
     # Full per-config detail goes to a FILE (the round-2 lesson:
@@ -1421,6 +1449,11 @@ def _emit_final(results, errors, attempts, force_cpu=False):
         line["config_errors"] = {
             k: str(v)[:120] for k, v in errors.items()
         }
+    if partial:
+        # This line was emitted mid-run (last-line-wins); if it is the
+        # last one in the stream, the run was killed externally after
+        # these configs completed.
+        line["partial"] = True
     if force_cpu:
         # A CPU-fallback run must not read as "this framework has no TPU
         # numbers": point the consumer at the committed hardware

@@ -123,8 +123,9 @@ class ExperimentConfig:
     mesh_pipe: int = 1
     mesh_expert: int = 1
     # Attention implementation for attention models: auto | reference |
-    # blockwise | flash ("auto" = Pallas flash on TPU when tile-aligned,
-    # blockwise elsewhere — ops/attention.py).
+    # blockwise | flash ("auto" = blockwise on every backend — the
+    # measured end-to-end training winner; Pallas flash is opt-in until
+    # its backward beats blockwise's — ops/attention.py:auto routing).
     attn_impl: str = "auto"
     # Sequence/context parallelism over the ``seq`` axis: None | "ring"
     # (ppermute KV rotation) | "ulysses" (all_to_all head scatter).

@@ -340,6 +340,19 @@ def _auto_block(T: int) -> int:
     return 256 if T % 256 == 0 else 128
 
 
+def _auto_block_bwd(T: int) -> int:
+    """Backward default tile, resolved INDEPENDENTLY of the forward's:
+    only the forward 256 tile has a banked hardware win
+    (tpu_r3_flash_check_detail.json); the FA2 kernel-pair grad sweep
+    (flash_check's grad_block_sweep_ms) has no artifact yet, so carrying
+    256 into the backward would be an untested assumption on the grad
+    path.  Constant 128 for every T the kernels accept (it is the
+    Mosaic-aligned floor both _check_blocks fallbacks share); the T
+    parameter stays so a banked grad sweep can make this
+    length-dependent like _auto_block without touching call sites."""
+    return 128 if T >= 128 else T
+
+
 def _check_blocks(Tq, Tkv, block_q, block_kv):
     block_q = min(block_q if block_q is not None else _auto_block(Tq), Tq)
     block_kv = min(
@@ -734,12 +747,14 @@ def flash_attention(
 ) -> jax.Array:
     """Pallas TPU flash attention, BTHD in/out.
 
-    Default tiles (``None``) resolve via :func:`_auto_block`: 256 where
-    the length divides it, else 128.  The on-hardware forward block
-    sweep (bench.py --config flash_check, v5e, B4 T2048 H8 D64 causal
-    bf16) measured 7.78 ms at 256x256 vs 9.21 ms at the untuned
-    128x128 — the best of the 128-512 grid; full per-tile numbers in
-    experiments/tpu_r3_flash_check_detail.json.
+    Default tiles (``None``) resolve per direction: the FORWARD via
+    :func:`_auto_block` (256 where the length divides it — the
+    on-hardware block sweep, bench.py --config flash_check, v5e, B4
+    T2048 H8 D64 causal bf16, measured 7.78 ms at 256x256 vs 9.21 ms at
+    the untuned 128x128; full grid in
+    experiments/tpu_r3_flash_check_detail.json), the BACKWARD via
+    :func:`_auto_block_bwd` (128 until a grad-sweep artifact lands).
+    Explicit tiles apply to both directions unchanged.
 
     Forward is the fused kernel (which also emits per-row LSE); backward
     is the FlashAttention-2 kernel pair (:func:`_flash_dkv_kernel` /
@@ -775,9 +790,13 @@ def _flash_bwd(
     causal, scale, block_q, block_kv, interpret, window, res, g
 ):
     q, k, v, out, lse = res
+    bq = block_q if block_q is not None else _auto_block_bwd(q.shape[1])
+    bkv = (
+        block_kv if block_kv is not None else _auto_block_bwd(k.shape[1])
+    )
     return _flash_backward(
         q, k, v, out, _lse_rows(lse), g, causal=causal, scale=scale,
-        block_q=block_q, block_kv=block_kv, interpret=interpret,
+        block_q=bq, block_kv=bkv, interpret=interpret,
         window=window,
     )
 
@@ -838,9 +857,13 @@ def _flash_chunk_bwd(
 ):
     q, k, v, out, lse, q_offset, kv_offset = res
     g_out, g_lse = cotangents
+    bq = block_q if block_q is not None else _auto_block_bwd(q.shape[1])
+    bkv = (
+        block_kv if block_kv is not None else _auto_block_bwd(k.shape[1])
+    )
     dq, dk, dv = _flash_backward(
         q, k, v, out, _lse_rows(lse), g_out, causal=causal, scale=scale,
-        block_q=block_q, block_kv=block_kv, interpret=interpret,
+        block_q=bq, block_kv=bkv, interpret=interpret,
         q_offset=q_offset, kv_offset=kv_offset,
         g_lse=_lse_rows(g_lse), window=window,
     )
@@ -883,10 +906,12 @@ def attention(
             q, k, v, causal=causal, scale=scale, window=window
         )
     if impl == "flash":
-        # None blocks resolve per-length via _auto_block (256 where the
-        # sweep-measured winner divides, else 128).  DTM_FLASH_TILE
-        # forces a square tile for end-to-end tile A/Bs (read at trace
-        # time, same contract as DTM_CONV_IMPL in ops/conv.py).
+        # None blocks resolve per-length and per-direction: forward via
+        # _auto_block (256 where the sweep-measured winner divides, else
+        # 128), backward via _auto_block_bwd (128 until a grad-sweep
+        # artifact lands).  DTM_FLASH_TILE forces a square tile for
+        # end-to-end tile A/Bs in BOTH directions (read at trace time,
+        # same contract as DTM_CONV_IMPL in ops/conv.py).
         # Positional: custom_vjp + nondiff_argnums is positional-indexed.
         tile = os.environ.get("DTM_FLASH_TILE")
         bq = bkv = None

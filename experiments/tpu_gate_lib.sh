@@ -13,8 +13,10 @@
 # wait_healthy — sleep-loop on probe with progress logging (one line
 #                per 3 failed probes, one on recovery).
 # bench_one    — health-gated, re-runnable bench.py invocation: skips
-#                outputs already banked without an "error" key, so a
-#                re-launched runner only re-measures what failed.
+#                outputs already banked error-free AND carrying a
+#                "metric" success marker (a truncated/garbage artifact
+#                re-runs), so a re-launched runner only re-measures
+#                what failed.
 #
 # History: rounds 1-3 showed killed/wedged remote compiles poison the
 # relay for every later process (conv HLO, then flash at T=4096), and a
@@ -50,7 +52,8 @@ wait_healthy() {
 
 bench_one() {  # name outfile [extra bench args...]
     local name="$1" out="$2"; shift 2
-    if [ -s "experiments/$out" ] && ! grep -q '"error"' "experiments/$out"; then
+    if [ -s "experiments/$out" ] && ! grep -q '"error"' "experiments/$out" \
+            && grep -q '"metric"' "experiments/$out"; then
         echo "$(date) [$R] skip $name -> $out (already banked)" >> "$LOG"
         return 0
     fi

@@ -13,56 +13,63 @@
 set -u
 cd "$(dirname "$0")/.."
 LOG=experiments/tpu_recovery.log
-R=r3-next
+R=r4-next
 . experiments/tpu_gate_lib.sh
 
 echo "$(date) [$R] queue start" >> "$LOG"
 
 # 1. mxu (Pallas implicit-GEMM) conv ladder — the headline metric.
 for b in 128 256 64; do
-    DTM_CONV_IMPL=mxu bench_one resnet50 "tpu_r3_mxu_resnet50_b${b}.json" --batch "$b"
+    DTM_CONV_IMPL=mxu bench_one resnet50 "tpu_r4_mxu_resnet50_b${b}.json" --batch "$b"
 done
 for b in 64 128; do
-    DTM_CONV_IMPL=mxu bench_one inception_v3 "tpu_r3_mxu_inception_b${b}.json" --batch "$b"
+    DTM_CONV_IMPL=mxu bench_one inception_v3 "tpu_r4_mxu_inception_b${b}.json" --batch "$b"
 done
+
+# 1b. Settle the non-monotonic patches ladder rows (VERDICT r3 Weak #2:
+#     resnet50 b256 < b128, inception b16 > b32 — compile variance or
+#     real occupancy cliff?).
+bench_one resnet50 "tpu_r4_resnet50_b256_rerun.json" --batch 256
+bench_one inception_v3 "tpu_r4_inception_b16_rerun.json" --batch 16
+bench_one inception_v3 "tpu_r4_inception_b32_rerun.json" --batch 32
 
 # 2. Transformer attention/batch matrix (fused head everywhere).
 for attn in blockwise reference; do
     for b in 16 32 64; do
         DTM_BENCH_ATTN_IMPL=$attn \
-            bench_one transformer_lm "tpu_r3_tune_${attn}_b${b}.json" --batch "$b"
+            bench_one transformer_lm "tpu_r4_tune_${attn}_b${b}.json" --batch "$b"
     done
 done
 DTM_BENCH_ATTN_IMPL=blockwise DTM_FUSED_UNEMBED=0 \
-    bench_one transformer_lm "tpu_r3_tune_blockwise_b16_twostage.json"
+    bench_one transformer_lm "tpu_r4_tune_blockwise_b16_twostage.json"
 
 # 3. Step-time ablation (MFU attribution) + whole-sequence-tile e2e A/B.
-bench_one transformer_parts "tpu_r3_parts_blockwise.json"
+bench_one transformer_parts "tpu_r4_parts_blockwise.json"
 DTM_BENCH_ATTN_IMPL=flash \
-    bench_one transformer_parts "tpu_r3_parts_flash.json"
+    bench_one transformer_parts "tpu_r4_parts_flash.json"
 DTM_BENCH_ATTN_IMPL=flash DTM_FLASH_TILE=512 \
-    bench_one transformer_lm "tpu_r3_flash_e2e_t512.json"
+    bench_one transformer_lm "tpu_r4_flash_e2e_t512.json"
 DTM_BENCH_ATTN_IMPL=flash DTM_FLASH_TILE=256 \
-    bench_one transformer_lm "tpu_r3_flash_e2e_t256.json"
+    bench_one transformer_lm "tpu_r4_flash_e2e_t256.json"
 
 # 4. LSTM batch push + head A/B, flash_check re-time (new auto tiles +
 #    fwd/bwd tile sweeps), R7 throughput pair.
-bench_one ptb_lstm "tpu_r3_tune_ptb_b1024.json" --batch 1024
-DTM_FUSED_UNEMBED=0 bench_one ptb_lstm "tpu_r3_ptb_b512_twostage.json" --batch 512
-bench_one flash_check "tpu_r3_flash_check2.json"
-bench_one vgg16 "tpu_r3_vgg16.json"
-bench_one alexnet "tpu_r3_alexnet.json"
+bench_one ptb_lstm "tpu_r4_tune_ptb_b1024.json" --batch 1024
+DTM_FUSED_UNEMBED=0 bench_one ptb_lstm "tpu_r4_ptb_b512_twostage.json" --batch 512
+bench_one flash_check "tpu_r4_flash_check2.json"
+bench_one vgg16 "tpu_r4_vgg16.json"
+bench_one alexnet "tpu_r4_alexnet.json"
 
 # 5. Donation probe (VERDICT r2 Weak #4): jit a real per-dispatch train
 #    step with donate_argnums on the relay; works / INVALID_ARGUMENT is
 #    the datum either way.
-if [ -s experiments/tpu_r3_donate_probe.json ] \
-        && grep -q '"donation"' experiments/tpu_r3_donate_probe.json; then
+if [ -s experiments/tpu_r4_donate_probe.json ] \
+        && grep -q '"donation"' experiments/tpu_r4_donate_probe.json; then
     echo "$(date) [$R] skip donate probe (already banked)" >> "$LOG"
 else
     wait_healthy
     echo "$(date) [$R] donation probe" >> "$LOG"
-    timeout 600 python - > experiments/tpu_r3_donate_probe.json 2>> "$LOG" <<'EOF'
+    timeout 600 python - > experiments/tpu_r4_donate_probe.json 2>> "$LOG" <<'EOF'
 import json
 import jax
 import jax.numpy as jnp
@@ -99,22 +106,22 @@ except Exception as e:  # noqa: BLE001 — the error IS the result
     out.update(donation="rejected", error=f"{type(e).__name__}: {e}"[:300])
 print(json.dumps(out))
 EOF
-    echo "$(date) [$R] donate rc=$? $(head -c 300 experiments/tpu_r3_donate_probe.json)" >> "$LOG"
+    echo "$(date) [$R] donate rc=$? $(head -c 300 experiments/tpu_r4_donate_probe.json)" >> "$LOG"
 fi
 
 # 6. Risky tail: rewritten decode bench, long-context via blockwise
 #    (flash@4096 is poison trigger #2 — NOT re-run), native conv ladder
 #    (trigger #1) dead last.
-bench_one decode "tpu_r3_decode.json"
-bench_one transformer_lm_long "tpu_r3_tune_long_blockwise.json"
-if [ ! -s experiments/conv_ladder_r3.json ]; then
+bench_one decode "tpu_r4_decode.json"
+bench_one transformer_lm_long "tpu_r4_tune_long_blockwise.json"
+if [ ! -s experiments/conv_ladder_r4.json ]; then
     wait_healthy
     echo "$(date) [$R] native conv ladder" >> "$LOG"
     rm -f /tmp/dtm_defer_native_ladder
     DTM_CONV_IMPL=xla python experiments/conv_ladder.py --timeout 420 \
-        --out experiments/conv_ladder_r3.json >> "$LOG" 2>&1
+        --out experiments/conv_ladder_r4.json >> "$LOG" 2>&1
     echo "$(date) [$R] native conv ladder rc=$?" >> "$LOG"
 fi
 
 echo "$(date) [$R] queue DONE" >> "$LOG"
-touch /tmp/tpu_r3_next_done
+touch /tmp/tpu_r4_next_done
