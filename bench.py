@@ -895,7 +895,12 @@ def run_flash_check(args):
         f"auto:fwd{auto_bq}x{auto_bkv}/bwd{auto_bwd}x{auto_bwd}":
             round(f_grad_dt * 1e3, 3)
     }
-    for bq, bkv in ((128, 128), (256, 256), (512, 512)):
+    # Rectangles included: the dKV kernel (Q innermost) and dQ kernel
+    # (KV innermost) accumulate along opposite axes, so their preferred
+    # aspect ratios need not match the forward's square winner.
+    for bq, bkv in ((128, 128), (256, 256), (512, 512),
+                    (128, 256), (256, 128), (256, 512), (512, 256),
+                    (128, 512), (512, 128)):
         try:
             dt = grad_timed(
                 lambda q, k, v, bq=bq, bkv=bkv: attnlib.flash_attention(
