@@ -860,6 +860,14 @@ def run_flash_check(args):
     b_grad_dt = grad_timed(
         lambda q, k, v: attnlib.blockwise_attention(q, k, v, causal=True)
     )
+    # dS-staging backward (O(T²) transient HBM for no second S/P rebuild
+    # in the dQ sweep — experiments/FLASH_BWD_r4.md): auto tiles, so this
+    # arm directly A/Bs the production pair at its own defaults.
+    st_grad_dt = grad_timed(
+        lambda q, k, v: attnlib.flash_attention(
+            q, k, v, True, None, None, None, False, None, True
+        )
+    )
 
     # Forward block-size sweep with EXPLICIT tiles (the no-args call above
     # resolves blocks via _auto_block, so f_dt is recorded separately
@@ -929,7 +937,9 @@ def run_flash_check(args):
         "blockwise_ms": round(b_dt * 1e3, 3),
         "flash_grad_ms": round(f_grad_dt * 1e3, 3),
         "blockwise_grad_ms": round(b_grad_dt * 1e3, 3),
+        "flash_grad_staged_ms": round(st_grad_dt * 1e3, 3),
         "grad_speedup_vs_blockwise": round(b_grad_dt / f_grad_dt, 3),
+        "staged_grad_speedup_vs_pair": round(f_grad_dt / st_grad_dt, 3),
         "forward_block_sweep_ms": sweep,
         "grad_block_sweep_ms": grad_sweep,
         "flash_tflops": round(flash_flops / f_dt / 1e12, 2),

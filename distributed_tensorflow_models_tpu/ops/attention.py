@@ -298,6 +298,31 @@ def _dispatch_masked(
             _step(True)
 
 
+def _block_should_run(
+    i, j, q_base, kv_base, *, causal, block_q, block_kv, window=None
+):
+    """Scalar predicate: True iff ANY (q, k) pair in block (i, j) passes
+    the causal/window mask — the block-skip test shared by the forward
+    kernel, both pair backward kernels, and the staged dQ kernel.  ONE
+    definition: the staged dQ kernel reads dS blocks the dKV sweep
+    conditionally wrote, so a predicate drift between them would read
+    unwritten HBM garbage and silently corrupt gradients."""
+    should = True
+    if causal:
+        # Q block i ends before KV block j starts -> block is all-masked.
+        should = (
+            q_base + i * block_q + block_q - 1 >= kv_base + j * block_kv
+        )
+    if window is not None:
+        # Whole KV block older than every query's window -> skip.
+        should = should & (
+            q_base + i * block_q
+            - (kv_base + (j + 1) * block_kv - 1)
+            < window
+        )
+    return should
+
+
 def _block_fully_valid(
     i, j, q_base, kv_base, *, causal, block_q, block_kv, window=None
 ):
@@ -348,22 +373,10 @@ def _flash_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal block skip: the whole KV block is in the future of the whole
-    # Q block iff its first global key position exceeds the block's last
-    # global query position.
-    should_run = True
-    if causal:
-        should_run = (
-            kv_base + j * block_kv
-            <= q_base + i * block_q + block_q - 1
-        )
-    if window is not None:
-        # Whole KV block older than every query's window -> skip.
-        should_run = should_run & (
-            q_base + i * block_q
-            - (kv_base + (j + 1) * block_kv - 1)
-            < window
-        )
+    should_run = _block_should_run(
+        i, j, q_base, kv_base, causal=causal,
+        block_q=block_q, block_kv=block_kv, window=window,
+    )
 
     def _step(apply_mask):
         s = _masked_scores(
@@ -558,9 +571,9 @@ def _p_and_ds(
 
 def _flash_dkv_kernel(
     qoff_ref, kvoff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, dk_scr, dv_scr,
-    *, scale: float, causal: bool, block_q: int, block_kv: int,
-    window=None,
+    dk_ref, dv_ref, *rest,
+    scale: float, causal: bool, block_q: int, block_kv: int,
+    window=None, stage_ds: bool = False,
 ):
     """dK/dV kernel: grid = (B*H, Tkv/block_kv, Tq/block_q), Q innermost;
     dK_j / dV_j accumulate in VMEM scratch across the Q sweep.
@@ -570,8 +583,20 @@ def _flash_dkv_kernel(
       dV_j += P_ij^T dO_i
       dS_ij = P_ij ∘ (dO_i V_j^T - delta_i)
       dK_j += scale * dS_ij^T Q_i
+
+    ``stage_ds=True`` additionally writes each computed dS block (in the
+    matmul dtype — bitwise what the dQ kernel would feed its MXU) to an
+    HBM-resident [B*H, Tq, Tkv] output, so the dQ sweep can skip the
+    second S/P rebuild entirely (:func:`_flash_dq_staged_kernel`).
+    Skipped blocks leave their dS garbage — the staged dQ kernel skips
+    the same blocks by the same predicate and never reads them.
     """
     import jax.experimental.pallas as pl
+
+    if stage_ds:
+        ds_ref, dk_scr, dv_scr = rest
+    else:
+        dk_scr, dv_scr = rest
 
     j = pl.program_id(1)
     i = pl.program_id(2)
@@ -583,18 +608,10 @@ def _flash_dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    should_run = True
-    if causal:
-        # Q block i ends before KV block j starts -> gradient block is 0.
-        should_run = (
-            q_base + i * block_q + block_q - 1 >= kv_base + j * block_kv
-        )
-    if window is not None:
-        should_run = should_run & (
-            q_base + i * block_q
-            - (kv_base + (j + 1) * block_kv - 1)
-            < window
-        )
+    should_run = _block_should_run(
+        i, j, q_base, kv_base, causal=causal,
+        block_q=block_q, block_kv=block_kv, window=window,
+    )
 
     def _step(apply_mask):
         qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
@@ -613,6 +630,11 @@ def _flash_dkv_kernel(
             ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bkv, D]
+        if stage_ds:
+            # Staged in K's dtype: the pair dQ kernel feeds its MXU
+            # ds.astype(kb.dtype), so this keeps staged dQ bitwise equal
+            # even if q and k dtypes ever diverge.
+            ds_ref[0] = ds.astype(ds_ref.dtype)
 
     _dispatch_masked(
         pl, _step, should_run, i, j, q_base, kv_base,
@@ -623,6 +645,45 @@ def _flash_dkv_kernel(
     def _finish():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_dq_staged_kernel(
+    qoff_ref, kvoff_ref, ds_ref, k_ref, dq_ref, dq_scr,
+    *, scale: float, causal: bool, block_q: int, block_kv: int,
+    window=None,
+):
+    """Staged dQ kernel: grid = (B*H, Tq/block_q, Tkv/block_kv), KV
+    innermost; consumes the dS blocks staged by the dKV sweep instead of
+    rebuilding S/P — one matmul and zero field passes per block:
+      dQ_i += scale * dS_ij K_j.
+    Must skip exactly the blocks the dKV sweep skipped (same predicate)
+    or it would read unwritten dS garbage."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+    q_base, kv_base = qoff_ref[0], kvoff_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    should_run = _block_should_run(
+        i, j, q_base, kv_base, causal=causal,
+        block_q=block_q, block_kv=block_kv, window=window,
+    )
+
+    @pl.when(should_run)
+    def _compute():
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds_ref[0], k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_j - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
 def _flash_dq_kernel(
@@ -645,18 +706,10 @@ def _flash_dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    should_run = True
-    if causal:
-        should_run = (
-            kv_base + j * block_kv
-            <= q_base + i * block_q + block_q - 1
-        )
-    if window is not None:
-        should_run = should_run & (
-            q_base + i * block_q
-            - (kv_base + (j + 1) * block_kv - 1)
-            < window
-        )
+    should_run = _block_should_run(
+        i, j, q_base, kv_base, causal=causal,
+        block_q=block_q, block_kv=block_kv, window=window,
+    )
 
     def _step(apply_mask):
         qb, kb, vb, dob = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
@@ -684,12 +737,22 @@ def _flash_dq_kernel(
 
 def _flash_backward(
     q, k, v, out, lse, g, *, causal, scale, block_q, block_kv, interpret,
-    q_offset=0, kv_offset=0, g_lse=None, window=None,
+    q_offset=0, kv_offset=0, g_lse=None, window=None, staged=False,
 ):
     """``lse`` here is the kernel-internal [B*H, Tq, 1] layout.  ``g_lse``
     (same layout, optional) is the LSE cotangent from callers that
     consumed the (out, lse) pair — it folds into delta (see
-    :func:`_p_and_ds`)."""
+    :func:`_p_and_ds`).
+
+    ``staged=True`` selects the dS-staging variant: the dKV sweep writes
+    its dS blocks to an [B*H, Tq, Tkv] HBM buffer and the dQ sweep
+    consumes them instead of rebuilding S/P — removing 2 of the
+    backward's 7 matmuls and ~all of the dQ sweep's VPU field work, at
+    the cost of O(T²) transient HBM (which surrenders flash's O(T·block)
+    memory — hence opt-in, for shapes where HBM is plentiful; see
+    experiments/FLASH_BWD_r4.md).  dQ is bitwise identical either way:
+    the staged buffer holds exactly the ds.astype(matmul dtype) blocks
+    the pair kernel would feed its MXU."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -729,13 +792,34 @@ def _flash_backward(
     dkv_kernel = functools.partial(
         _flash_dkv_kernel,
         scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
-        window=window,
+        window=window, stage_ds=staged,
     )
+    # dS stage buffer: blocked (1, block_q, block_kv) at index (b, i, j)
+    # — written by the dKV sweep (grid (b, j, i); index maps may permute
+    # grid axes freely), read back by the staged dQ sweep in its own
+    # (b, i, j) order.
+    dsspec = lambda im: pl.BlockSpec(
+        (1, block_q, block_kv), im, memory_space=pltpu.VMEM
+    )
+    dkv_out_specs = [
+        kvspec(lambda b, j, i: (b, j, 0)),
+        kvspec(lambda b, j, i: (b, j, 0)),
+    ]
+    dkv_out_shape = [
+        jax.ShapeDtypeStruct((B * H, Tkv, D), k.dtype),
+        jax.ShapeDtypeStruct((B * H, Tkv, D), v.dtype),
+    ]
+    if staged:
+        dkv_out_specs.append(dsspec(lambda b, j, i: (b, i, j)))
+        # K's dtype: what the pair dQ kernel would cast dS to at its MXU.
+        dkv_out_shape.append(
+            jax.ShapeDtypeStruct((B * H, Tq, Tkv), k.dtype)
+        )
     # GQA note: the kernel computes PER-QUERY-HEAD dK/dV ([B*H, Tkv, D])
     # — each query head reads its group's KV row but writes its own
     # gradient row, keeping grid dim 0 parallel (no cross-head output
     # revisiting); the group-sum down to H_kv heads happens outside.
-    dk, dv = pl.pallas_call(
+    dkv_out = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, Tkv // block_kv, Tq // block_q),
         in_specs=[
@@ -748,14 +832,8 @@ def _flash_backward(
             rowspec(lambda b, j, i: (b, i, 0)),
             rowspec(lambda b, j, i: (b, i, 0)),
         ],
-        out_specs=[
-            kvspec(lambda b, j, i: (b, j, 0)),
-            kvspec(lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tkv, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Tkv, D), v.dtype),
-        ],
+        out_specs=dkv_out_specs,
+        out_shape=dkv_out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_kv, D), jnp.float32),
             pltpu.VMEM((block_kv, D), jnp.float32),
@@ -765,33 +843,58 @@ def _flash_backward(
         ),
         interpret=interpret,
     )(qoff, kvoff, qh, kh, vh, doh, lse, delta)
-
-    dq_kernel = functools.partial(
-        _flash_dq_kernel,
-        scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
-        window=window,
-    )
-    dq = pl.pallas_call(
-        dq_kernel,
-        grid=(B * H, Tq // block_q, Tkv // block_kv),
-        in_specs=[
-            _smem_scalar_spec(pl, pltpu),
-            _smem_scalar_spec(pl, pltpu),
-            qspec(lambda b, i, j: (b, i, 0)),
-            kvspec(lambda b, i, j: (kv_row(b), j, 0)),
-            kvspec(lambda b, i, j: (kv_row(b), j, 0)),
-            qspec(lambda b, i, j: (b, i, 0)),
-            rowspec(lambda b, i, j: (b, i, 0)),
-            rowspec(lambda b, i, j: (b, i, 0)),
-        ],
-        out_specs=qspec(lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        ),
-        interpret=interpret,
-    )(qoff, kvoff, qh, kh, vh, doh, lse, delta)
+    if staged:
+        dk, dv, ds_buf = dkv_out
+        dq_kernel = functools.partial(
+            _flash_dq_staged_kernel,
+            scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
+            window=window,
+        )
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(B * H, Tq // block_q, Tkv // block_kv),
+            in_specs=[
+                _smem_scalar_spec(pl, pltpu),
+                _smem_scalar_spec(pl, pltpu),
+                dsspec(lambda b, i, j: (b, i, j)),
+                kvspec(lambda b, i, j: (kv_row(b), j, 0)),
+            ],
+            out_specs=qspec(lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            ),
+            interpret=interpret,
+        )(qoff, kvoff, ds_buf, kh)
+    else:
+        dk, dv = dkv_out
+        dq_kernel = functools.partial(
+            _flash_dq_kernel,
+            scale=s, causal=causal, block_q=block_q, block_kv=block_kv,
+            window=window,
+        )
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(B * H, Tq // block_q, Tkv // block_kv),
+            in_specs=[
+                _smem_scalar_spec(pl, pltpu),
+                _smem_scalar_spec(pl, pltpu),
+                qspec(lambda b, i, j: (b, i, 0)),
+                kvspec(lambda b, i, j: (kv_row(b), j, 0)),
+                kvspec(lambda b, i, j: (kv_row(b), j, 0)),
+                qspec(lambda b, i, j: (b, i, 0)),
+                rowspec(lambda b, i, j: (b, i, 0)),
+                rowspec(lambda b, i, j: (b, i, 0)),
+            ],
+            out_specs=qspec(lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            ),
+            interpret=interpret,
+        )(qoff, kvoff, qh, kh, vh, doh, lse, delta)
 
     unflat = lambda x, nh, T: jnp.swapaxes(
         x.reshape(B, nh, T, D), 1, 2
@@ -813,7 +916,7 @@ def _flash_backward(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
 )
 def flash_attention(
     q: jax.Array,
@@ -825,6 +928,7 @@ def flash_attention(
     block_kv: Optional[int] = None,
     interpret: bool = False,
     window: Optional[int] = None,
+    bwd_staged: bool = False,
 ) -> jax.Array:
     """Pallas TPU flash attention, BTHD in/out.
 
@@ -841,7 +945,10 @@ def flash_attention(
     is the FlashAttention-2 kernel pair (:func:`_flash_dkv_kernel` /
     :func:`_flash_dq_kernel`) rebuilding P from the saved LSE — the O(T²)
     score matrix is never materialized in either pass.  ``interpret=True``
-    runs the same kernels on CPU for tests.
+    runs the same kernels on CPU for tests.  ``bwd_staged=True`` opts the
+    backward into the dS-staging variant (O(T²) transient HBM for fewer
+    rebuild passes — see :func:`_flash_backward`); dQ/dK/dV values are
+    bitwise identical either way.
     """
     return _flash_forward(
         q, k, v, causal=causal, scale=scale,
@@ -857,7 +964,8 @@ def _lse_rows(lse):
 
 
 def _flash_fwd(
-    q, k, v, causal, scale, block_q, block_kv, interpret, window
+    q, k, v, causal, scale, block_q, block_kv, interpret, window,
+    bwd_staged,
 ):
     out, lse = _flash_forward(
         q, k, v, causal=causal, scale=scale,
@@ -868,7 +976,8 @@ def _flash_fwd(
 
 
 def _flash_bwd(
-    causal, scale, block_q, block_kv, interpret, window, res, g
+    causal, scale, block_q, block_kv, interpret, window, bwd_staged,
+    res, g,
 ):
     q, k, v, out, lse = res
     bq = block_q if block_q is not None else _auto_block_bwd(q.shape[1])
@@ -878,7 +987,7 @@ def _flash_bwd(
     return _flash_backward(
         q, k, v, out, _lse_rows(lse), g, causal=causal, scale=scale,
         block_q=bq, block_kv=bkv, interpret=interpret,
-        window=window,
+        window=window, staged=bwd_staged,
     )
 
 
@@ -1022,7 +1131,17 @@ def attention(
                         f"DTM_FLASH_TILE={tile} does not divide the "
                         f"{which} length {L}"
                     )
+        # DTM_FLASH_BWD=staged opts the backward into the dS-staging
+        # variant; unset defaults to the O(T·block) kernel pair, and any
+        # other value is rejected loudly (trace-time knob, same
+        # fail-naming-the-knob contract as DTM_FLASH_TILE).
+        bwd = os.environ.get("DTM_FLASH_BWD", "pair")
+        if bwd not in ("pair", "staged"):
+            raise ValueError(
+                f"DTM_FLASH_BWD must be 'pair' or 'staged', got {bwd!r}"
+            )
         return flash_attention(
-            q, k, v, causal, scale, bq, bkv, False, window
+            q, k, v, causal, scale, bq, bkv, False, window,
+            bwd == "staged",
         )
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -675,6 +675,39 @@ def test_flash_bwd_none_tiles_resolve_independently():
         assert jnp.max(jnp.abs(a.astype(jnp.float32) - b)) < 0.15
 
 
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize(
+    "causal,window,hkv",
+    [(True, None, None), (True, 64, None), (True, None, 2)],
+    ids=["causal", "window", "gqa"],
+)
+def test_flash_bwd_staged_matches_pair(causal, window, hkv, dtype):
+    """The dS-staging backward must produce BITWISE the pair backward's
+    gradients: the staged buffer holds exactly the ds.astype(matmul
+    dtype) blocks the pair's dQ kernel would rebuild, and dK/dV come
+    from the identical dKV sweep.  bf16 covers the production path where
+    the staging cast actually rounds."""
+    q, k, v = _qkv(T=256)
+    q, k, v = (x.astype(dtype) for x in (q, k, v))
+    if hkv is not None:
+        k, v = k[:, :, :hkv, :], v[:, :, :hkv, :]
+
+    def loss(staged):
+        return lambda q, k, v: jnp.sum(
+            attnlib.flash_attention(
+                q, k, v, causal, None, 128, 128, True, window, staged
+            ).astype(jnp.float32)
+            ** 2
+        )
+
+    gp = jax.grad(loss(False), (0, 1, 2))(q, k, v)
+    gs = jax.grad(loss(True), (0, 1, 2))(q, k, v)
+    for name, a, b in zip("q k v".split(), gs, gp):
+        assert jnp.array_equal(
+            jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32)
+        ), name
+
+
 def test_auto_impl_is_blockwise():
     """auto == blockwise bit-for-bit (the measured end-to-end training
     winner on every banked hardware shape — TPU_BENCH_r3.md); flash
