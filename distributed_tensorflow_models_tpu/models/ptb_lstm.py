@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_tensorflow_models_tpu.models import register
+from distributed_tensorflow_models_tpu.ops.embed import TokenEmbed
 
 # Per-layer carry: (c, h) tuples, batch-major.
 Carry = Sequence[tuple[jax.Array, jax.Array]]
@@ -103,7 +104,9 @@ class PTBLSTM(nn.Module):
                  train: bool = False, return_hidden: bool = False):
         if carry is None:
             carry = self.initial_carry(tokens.shape[0])
-        x = nn.Embed(
+        # TokenEmbed == nn.Embed plus the DTM_EMBED_GRAD backward A/B
+        # knob (ops/embed.py).
+        x = TokenEmbed(
             self.vocab_size, self.hidden_size, dtype=self.dtype,
             name="embedding",
         )(tokens)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from distributed_tensorflow_models_tpu.models import register
 from distributed_tensorflow_models_tpu.ops import attention as attnlib
+from distributed_tensorflow_models_tpu.ops.embed import TokenEmbed
 
 
 class SelfAttention(nn.Module):
@@ -496,7 +497,12 @@ class TransformerLM(nn.Module):
         still exist (init uses the default path) and the loss consumes
         them directly from ``params``."""
         B, T = tokens.shape
-        x = nn.Embed(
+        # TokenEmbed == nn.Embed (same param path/init/dtype promotion)
+        # plus the selectable backward lowering: DTM_EMBED_GRAD=matmul
+        # swaps the gather's scatter-add gradient for the chunked
+        # one-hot matmul (ops/embed.py) — the A/B the transformer_parts
+        # frozen_embed ablation motivates.
+        x = TokenEmbed(
             self.vocab_size,
             self.d_model,
             dtype=self.dtype,

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Chained embed-grad A/B: waits for the main r4 queue to finish (its
+# done-marker), then banks the DTM_EMBED_GRAD=matmul arms against the
+# queue's scatter-default transformer/LSTM rows.  Separate script
+# because the main queue was already running when the knob landed
+# (editing a live bash script corrupts its lazy read).
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+R=r4-embed-ab
+. experiments/tpu_gate_lib.sh
+
+while [ ! -f /tmp/tpu_r4_next_done ]; do
+    sleep 300
+done
+echo "$(date) [$R] main queue done; embed A/B start" >> "$LOG"
+
+DTM_EMBED_GRAD=matmul \
+    bench_one transformer_lm "tpu_r4_tune_blockwise_b16_embedmm.json"
+DTM_EMBED_GRAD=matmul \
+    bench_one ptb_lstm "tpu_r4_ptb_b512_embedmm.json" --batch 512
+DTM_EMBED_GRAD=matmul \
+    bench_one transformer_parts "tpu_r4_parts_embedmm.json"
+
+echo "$(date) [$R] embed A/B DONE" >> "$LOG"

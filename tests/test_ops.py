@@ -169,3 +169,108 @@ class TestEMA:
         value = {"w": jnp.array([0.0])}
         out = emalib.update_ema(shadow, value, decay=0.9)
         np.testing.assert_allclose(out["w"], [0.9], rtol=1e-6)
+
+
+class TestEmbedGrad:
+    """ops/embed.py: the selectable embedding-gradient lowering.  The
+    matmul path exists for the TPU scatter cost (transformer_parts'
+    frozen_embed ablation); both paths accumulate f32 and must agree up
+    to summation order."""
+
+    def _grads(self, impl, gdtype):
+        from distributed_tensorflow_models_tpu.ops.embed import (
+            embed_lookup,
+        )
+
+        rng = np.random.RandomState(0)
+        table = jnp.asarray(rng.randn(50, 16), jnp.float32)
+        # Repeated tokens: the scatter must ACCUMULATE, and so must the
+        # one-hot matmul.
+        tokens = jnp.asarray(
+            rng.randint(0, 50, (4, 33)), jnp.int32
+        )
+        target = jnp.asarray(rng.randn(4, 33, 16), gdtype)
+
+        def loss(t):
+            out = embed_lookup(t, tokens, impl, 16).astype(gdtype)
+            return jnp.sum((out - target).astype(jnp.float32) ** 2)
+
+        return jax.grad(loss)(table)
+
+    @pytest.mark.parametrize("gdtype", ["float32", "bfloat16"])
+    def test_matmul_grad_matches_scatter(self, gdtype):
+        gs = self._grads("scatter", gdtype)
+        gm = self._grads("matmul", gdtype)
+        np.testing.assert_allclose(gs, gm, rtol=2e-5, atol=2e-5)
+
+    def test_forward_is_take(self):
+        from distributed_tensorflow_models_tpu.ops.embed import (
+            embed_lookup,
+        )
+
+        table = jnp.arange(12.0).reshape(6, 2)
+        tokens = jnp.asarray([[5, 0], [3, 3]], jnp.int32)
+        np.testing.assert_array_equal(
+            embed_lookup(table, tokens), jnp.take(table, tokens, axis=0)
+        )
+
+    def test_token_embed_matches_nn_embed(self):
+        """Checkpoint/init compat: TokenEmbed must produce the identical
+        param tree (path, shape, values under the same rng) and forward
+        as the nn.Embed it replaces in the model zoo."""
+        import flax.linen as nn
+
+        from distributed_tensorflow_models_tpu.ops.embed import (
+            TokenEmbed,
+        )
+
+        tokens = jnp.asarray([[1, 2, 3]], jnp.int32)
+        old = nn.Embed(20, 8, dtype=jnp.bfloat16, name="embedding")
+        new = TokenEmbed(20, 8, dtype=jnp.bfloat16, name="embedding")
+        po = old.init(jax.random.key(7), tokens)
+        pn = new.init(jax.random.key(7), tokens)
+        assert jax.tree_util.tree_structure(po) == (
+            jax.tree_util.tree_structure(pn)
+        )
+        np.testing.assert_array_equal(
+            po["params"]["embedding"], pn["params"]["embedding"]
+        )
+        np.testing.assert_array_equal(
+            old.apply(po, tokens), new.apply(pn, tokens)
+        )
+
+    def test_negative_and_empty_tokens_match_scatter(self):
+        """Negative ids wrap numpy-style in the forward gather and the
+        scatter grad; the one-hot path must wrap identically.  Empty
+        token arrays must not divide-by-zero the chunking."""
+        from distributed_tensorflow_models_tpu.ops.embed import (
+            embed_lookup,
+        )
+
+        table = jnp.asarray(
+            np.random.RandomState(1).randn(6, 4), jnp.float32
+        )
+        tokens = jnp.asarray([[-1, 2]], jnp.int32)
+
+        def loss(impl):
+            return lambda t: jnp.sum(
+                embed_lookup(t, tokens, impl, 16) ** 2
+            )
+
+        gs = jax.grad(loss("scatter"))(table)
+        gm = jax.grad(loss("matmul"))(table)
+        np.testing.assert_allclose(gs, gm, rtol=1e-6)
+        assert float(jnp.abs(gm[5]).sum()) > 0  # -1 wrapped to row V-1
+        empty = jnp.zeros((0,), jnp.int32)
+        ge = jax.grad(
+            lambda t: jnp.sum(embed_lookup(t, empty, "matmul", 16))
+        )(table)
+        np.testing.assert_array_equal(ge, jnp.zeros_like(table))
+
+    def test_bad_impl_raises_naming_knob(self):
+        from distributed_tensorflow_models_tpu.ops.embed import (
+            resolve_embed_grad_impl,
+        )
+
+        with pytest.raises(ValueError, match="DTM_EMBED_GRAD"):
+            resolve_embed_grad_impl("sctter")
