@@ -18,13 +18,64 @@ R=r4-next
 
 echo "$(date) [$R] queue start" >> "$LOG"
 
+# 0. mxu canary: the Pallas conv is a NEW compile class on this relay,
+#    and unproven compiles are the known wedge triggers (conv HLO r1-2,
+#    flash@4096 r3 — each cost a whole healthy window).  One tiny
+#    tightly-capped kernel compile+run decides whether the ladder is
+#    safe; on failure the ladder is skipped (not retried blind) and the
+#    proven-class queue still banks the window.  Success marker doubles
+#    as the skip-if-banked key.
+mxu_ok=0
+if [ -s experiments/tpu_r4_mxu_canary.json ] \
+        && grep -q '"ok": true' experiments/tpu_r4_mxu_canary.json; then
+    mxu_ok=1
+    echo "$(date) [$R] mxu canary already banked ok" >> "$LOG"
+else
+    wait_healthy
+    echo "$(date) [$R] mxu canary" >> "$LOG"
+    timeout 240 python - > experiments/tpu_r4_mxu_canary.json 2>> "$LOG" <<'EOF'
+import json
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributed_tensorflow_models_tpu.ops.conv_mxu import conv2d_mxu
+
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(8, 56, 56, 64), jnp.bfloat16)
+k = jnp.asarray(rng.randn(3, 3, 64, 64) * 0.05, jnp.bfloat16)
+y = jax.jit(conv2d_mxu)(x, k)
+y.block_until_ready()
+ref = lax.conv_general_dilated(
+    x.astype(jnp.float32), k.astype(jnp.float32), (1, 1), "SAME",
+    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+)
+err = float(jnp.max(jnp.abs(y.astype(jnp.float32) - ref)))
+print(json.dumps({
+    "ok": bool(err < 0.5),
+    "max_err_vs_xla_f32": err,
+    "platform": jax.devices()[0].platform,
+}))
+EOF
+    rc=$?
+    echo "$(date) [$R] mxu canary rc=$rc $(head -c 200 experiments/tpu_r4_mxu_canary.json)" >> "$LOG"
+    grep -q '"ok": true' experiments/tpu_r4_mxu_canary.json && mxu_ok=1
+fi
+
 # 1. mxu (Pallas implicit-GEMM) conv ladder — the headline metric.
-for b in 128 256 64; do
-    DTM_CONV_IMPL=mxu bench_one resnet50 "tpu_r4_mxu_resnet50_b${b}.json" --batch "$b"
-done
-for b in 64 128; do
-    DTM_CONV_IMPL=mxu bench_one inception_v3 "tpu_r4_mxu_inception_b${b}.json" --batch "$b"
-done
+#    Gated on the canary: a wedging Mosaic compile must not eat the
+#    window the rest of the queue needs.
+if [ "$mxu_ok" = 1 ]; then
+    for b in 128 256 64; do
+        DTM_CONV_IMPL=mxu bench_one resnet50 "tpu_r4_mxu_resnet50_b${b}.json" --batch "$b"
+    done
+    for b in 64 128; do
+        DTM_CONV_IMPL=mxu bench_one inception_v3 "tpu_r4_mxu_inception_b${b}.json" --batch "$b"
+    done
+else
+    echo "$(date) [$R] mxu canary FAILED - ladder skipped this pass" >> "$LOG"
+fi
 
 # 1b. Settle the non-monotonic patches ladder rows (VERDICT r3 Weak #2:
 #     resnet50 b256 < b128, inception b16 > b32 — compile variance or
