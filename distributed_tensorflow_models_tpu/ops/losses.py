@@ -8,12 +8,36 @@ regularizer added to every conv/fc kernel.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import os
+import sys
+from typing import Any, Callable, Union
 
 import jax
 import jax.numpy as jnp
 
 PyTree = Any
+
+
+def resolve_unembed_chunk(default: int = 2048) -> int:
+    """Trace-time DTM_UNEMBED_CHUNK resolution (the DTM_CONV_IMPL
+    contract: invalid values fail loudly naming the knob).  The knob
+    exists for the r3 TPU surprise — the two-stage head beat the fused
+    path ~3% at b16, and one hypothesis is per-chunk checkpoint
+    boundaries (4 segments at the 2048 default); chunk_rows >= B*T
+    collapses the fused head to a single remat'd segment, isolating
+    chunking cost from fusion benefit."""
+    env = os.environ.get("DTM_UNEMBED_CHUNK")
+    if not env:
+        return default
+    try:
+        v = int(env)
+    except ValueError:
+        raise ValueError(
+            f"DTM_UNEMBED_CHUNK must be an integer, got {env!r}"
+        ) from None
+    if v < 1:
+        raise ValueError(f"DTM_UNEMBED_CHUNK must be >= 1, got {env!r}")
+    return v
 
 
 def softmax_cross_entropy(
@@ -87,7 +111,7 @@ def chunked_unembed_xent(
     bias: jax.Array | None,
     targets: jax.Array,
     *,
-    chunk_rows: int = 2048,
+    chunk_rows: Union[int, str] = "auto",
     compute_dtype: jnp.dtype = jnp.bfloat16,
 ) -> jax.Array:
     """Per-token NLL of ``Dense(hidden) -> softmax xent`` WITHOUT ever
@@ -123,7 +147,21 @@ def chunked_unembed_xent(
     n = B * T
     x = hidden.reshape(n, d)
     t = targets.reshape(n)
+    if chunk_rows == "auto":
+        # Resolved AT THE OP so every caller honors DTM_UNEMBED_CHUNK
+        # through one validation path (same placement as DTM_CONV_IMPL
+        # in ops/conv.py, DTM_FLASH_TILE in ops/attention.py).
+        chunk_rows = resolve_unembed_chunk()
     c = min(chunk_rows, n)
+    if c != chunk_rows and os.environ.get("DTM_UNEMBED_CHUNK"):
+        # The knob asked for a bigger chunk than this shape has rows:
+        # clamping is correct math but would silently mislabel an A/B
+        # artifact, so say what was actually measured (trace-time).
+        print(
+            f"[losses] DTM_UNEMBED_CHUNK={chunk_rows} clamped to {c} "
+            f"(B*T={n})",
+            file=sys.stderr,
+        )
     pad = (-n) % c
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)))

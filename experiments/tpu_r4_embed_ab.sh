@@ -23,3 +23,13 @@ DTM_EMBED_GRAD=matmul \
     bench_one transformer_parts "tpu_r4_parts_embedmm.json"
 
 echo "$(date) [$R] embed A/B DONE" >> "$LOG"
+
+# Unembed-chunk isolation arms (r3 surprise: two-stage beat fused at
+# b16; DTM_UNEMBED_CHUNK=8192 collapses the fused head to ONE remat'd
+# segment at the flagship config, isolating chunk-boundary cost).
+DTM_UNEMBED_CHUNK=8192 \
+    bench_one transformer_lm "tpu_r4_tune_blockwise_b16_chunk8192.json"
+DTM_UNEMBED_CHUNK=4096 \
+    bench_one transformer_lm "tpu_r4_tune_blockwise_b16_chunk4096.json"
+
+echo "$(date) [$R] chunk A/B DONE" >> "$LOG"

@@ -4,6 +4,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_tensorflow_models_tpu.core import (
     sharding as shardlib,
@@ -108,6 +109,57 @@ def test_chunked_unembed_xent_exact_in_f32():
     g_fus = jax.grad(fused, argnums=(0, 1, 2))(hidden, kernel, bias)
     for a, b_ in zip(g_ref, g_fus):
         np.testing.assert_allclose(a, b_, rtol=1e-5, atol=1e-6)
+
+
+def test_unembed_chunk_env_knob(monkeypatch):
+    """DTM_UNEMBED_CHUNK reroutes the fused head's chunk size at trace
+    time; the loss is chunk-size-invariant, and bad values fail loudly
+    naming the knob (the DTM_CONV_IMPL contract)."""
+    import optax
+
+    from distributed_tensorflow_models_tpu.core import (
+        mesh as meshlib,
+        train_loop,
+    )
+    from distributed_tensorflow_models_tpu.core.train_state import (
+        TrainState,
+    )
+    from distributed_tensorflow_models_tpu.models import get_model
+
+    T = 16
+    model = get_model(
+        "transformer_lm", num_layers=1, num_heads=2, d_model=32,
+        d_ff=64, max_len=T, dropout_rate=0.0, vocab_size=50,
+    )
+    mesh = meshlib.data_parallel_mesh()
+    tx = optax.sgd(0.1)
+    state = TrainState.create(
+        model, tx, jax.random.key(0), jnp.zeros((2, T), jnp.int32)
+    )
+    state = train_loop.place_state(state, mesh)
+    tok = jnp.asarray(
+        np.random.RandomState(0).randint(0, 50, (8, T + 1)), jnp.int32
+    )
+    batch = {"inputs": tok[:, :-1], "targets": tok[:, 1:]}
+    loss_fn = train_loop.lm_loss_fn(model.apply, fused_unembed=True)
+
+    def loss_at(chunk_env):
+        if chunk_env is None:
+            monkeypatch.delenv("DTM_UNEMBED_CHUNK", raising=False)
+        else:
+            monkeypatch.setenv("DTM_UNEMBED_CHUNK", chunk_env)
+        l, _ = loss_fn(
+            state.params, state, batch, {"dropout": jax.random.key(1)}
+        )
+        return float(l)
+
+    base = loss_at(None)
+    np.testing.assert_allclose(loss_at("128"), base, rtol=1e-6)
+    np.testing.assert_allclose(loss_at("7"), base, rtol=1e-6)
+    with pytest.raises(ValueError, match="DTM_UNEMBED_CHUNK"):
+        loss_at("big")
+    with pytest.raises(ValueError, match="DTM_UNEMBED_CHUNK"):
+        loss_at("0")
 
 
 def test_chunked_unembed_xent_no_bias():
