@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Collate every round-4 TPU artifact into one markdown table.
+
+Reads ``experiments/tpu_r4_*.json`` (the one-line bench outputs) and
+prints | artifact | metric | value | unit | MFU | platform | — errors
+and empty files are listed separately so a partially-banked queue is
+visible at a glance.  Used to refresh TPU_BENCH_r4.md after the gated
+runners drain; writes nothing itself.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    rows, errors, empty = [], [], []
+    for path in sorted(glob.glob(os.path.join(here, "tpu_r4_*.json"))):
+        name = os.path.basename(path)
+        if name.endswith("_detail.json"):
+            continue
+        try:
+            with open(path) as f:
+                text = f.read().strip()
+        except OSError as e:
+            errors.append((name, f"unreadable: {e}"))
+            continue
+        if not text:
+            empty.append(name)
+            continue
+        try:
+            d = json.loads(text.splitlines()[-1])
+        except json.JSONDecodeError as e:
+            errors.append((name, f"bad json: {e}"))
+            continue
+        if "error" in d:
+            errors.append((name, str(d["error"])[:100]))
+            continue
+        mfu = d.get("mfu")
+        metric = d.get("metric", "?")
+        if d.get("config_errors"):
+            # A partial (e.g. watchdog-truncated) run still carries a
+            # headline; flag it so the table can't pass it off as a
+            # clean full-queue result.
+            bad = ", ".join(sorted(d["config_errors"]))
+            metric += f" (PARTIAL: {bad} errored)"
+        rows.append(
+            (
+                name,
+                metric,
+                d.get("value"),
+                d.get("unit", ""),
+                f"{mfu:.1%}" if isinstance(mfu, float) else "—",
+                d.get("platform", "?"),
+            )
+        )
+
+    print("| artifact | metric | value | unit | MFU | platform |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print("| " + " | ".join(str(x) for x in r) + " |")
+    if errors:
+        print("\nErrored artifacts:\n")
+        for name, err in errors:
+            print(f"- `{name}` — {err}")
+    if empty:
+        print("\nEmpty (in-flight or killed):\n")
+        for name in empty:
+            print(f"- `{name}`")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,3 +33,14 @@ DTM_UNEMBED_CHUNK=4096 \
     bench_one transformer_lm "tpu_r4_tune_blockwise_b16_chunk4096.json"
 
 echo "$(date) [$R] chunk A/B DONE" >> "$LOG"
+
+# DEAD LAST, deliberately wedge-risking: flash at T=4096 was poison
+# trigger #2 in r3, but the round-4 kernels compile differently (mask
+# elision branches, independent bwd tiles) and this runs only after
+# every other artifact is banked — a re-wedge here costs nothing the
+# queue still needs.  If it lands, it is the first long-context flash
+# number and the 4096-auto-flip evidence.
+echo "$(date) [$R] WEDGE-RISK tail: flash @ T=4096" >> "$LOG"
+DTM_BENCH_ATTN_IMPL=flash \
+    bench_one transformer_lm_long "tpu_r4_tune_long_flash.json"
+echo "$(date) [$R] chained runner fully DONE" >> "$LOG"
