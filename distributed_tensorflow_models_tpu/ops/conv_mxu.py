@@ -42,6 +42,7 @@ tests/test_conv_mxu.py (fwd + grads, every shape class in the model zoo).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Sequence, Union
 
 import jax
@@ -68,24 +69,28 @@ def _divisors_desc(n: int):
     return out
 
 
-def _pick_tiles(b, oh, ow, wp, cin, cout, kh, itemsize):
+def _pick_tiles(b, oh, ow, wp, cin, cout, kh, itemsize,
+                slab_budget=_SLAB_BUDGET):
     """(bb, boh, bco): batch-fold, output-row tile, out-channel tile.
 
-    boh: largest divisor of OH whose halo slab fits the VMEM budget with
+    boh: largest divisor of OH whose halo slab fits ``slab_budget`` with
     M = boh*OW not far past the target.  bb: fold batch images into the
     GEMM M dim when one image's rows leave the MXU starved (deep 7x7
-    feature maps).  bco: largest divisor of Cout <= 256.
+    feature maps).  bco: largest divisor of Cout <= 256.  The pipelined
+    kernel passes a HALVED budget: it allocates two slabs, and the 4 MiB
+    default is already conservative because the auto-pipelined
+    kernel/output blocks and the f32 accumulator share VMEM with it.
     """
     boh = 1
     for d in _divisors_desc(oh):
         slab = (d + kh - 1) * wp * cin * itemsize
-        if slab <= _SLAB_BUDGET and d * ow <= 2 * _M_TARGET:
+        if slab <= slab_budget and d * ow <= 2 * _M_TARGET:
             boh = d
             break
     bb = 1
     for d in _divisors_desc(b):
         slab = d * (boh + kh - 1) * wp * cin * itemsize
-        if slab <= _SLAB_BUDGET and d * boh * ow <= 2 * _M_TARGET:
+        if slab <= slab_budget and d * boh * ow <= 2 * _M_TARGET:
             bb = d
             break
     # Mosaic block rule: the block's last dim must be a multiple of 128
@@ -98,6 +103,23 @@ def _pick_tiles(b, oh, ow, wp, cin, cout, kh, itemsize):
         cout,
     )
     return bb, boh, bco
+
+
+def _accumulate_taps(xs, k_ref, y_ref, *, kh, kw, bb, boh, ow, cin, bco):
+    """The kh*kw implicit-GEMM contraction + output write, shared by the
+    synchronous and pipelined kernels (one definition so the A/B arms
+    cannot diverge in the math they compare)."""
+    acc = jnp.zeros((bb * boh * ow, bco), jnp.float32)
+    for dy in range(kh):
+        for dx in range(kw):
+            win = lax.slice(
+                xs, (0, dy, dx, 0), (bb, dy + boh, dx + ow, cin)
+            ).reshape(bb * boh * ow, cin)
+            acc += lax.dot_general(
+                win, k_ref[dy, dx], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+    y_ref[...] = acc.reshape(bb, boh, ow, bco).astype(y_ref.dtype)
 
 
 def _core_kernel(x_hbm, k_ref, y_ref, slab, sem, *, kh, kw, bb, boh, ow,
@@ -124,18 +146,90 @@ def _core_kernel(x_hbm, k_ref, y_ref, slab, sem, *, kh, kw, bb, boh, ow,
         cp.start()
         cp.wait()
 
-    xs = slab[...]  # [bb, rows, Wp, Cin]
-    acc = jnp.zeros((bb * boh * ow, bco), jnp.float32)
-    for dy in range(kh):
-        for dx in range(kw):
-            win = lax.slice(
-                xs, (0, dy, dx, 0), (bb, dy + boh, dx + ow, cin)
-            ).reshape(bb * boh * ow, cin)
-            acc += lax.dot_general(
-                win, k_ref[dy, dx], (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-    y_ref[...] = acc.reshape(bb, boh, ow, bco).astype(y_ref.dtype)
+    _accumulate_taps(
+        slab[...], k_ref, y_ref,
+        kh=kh, kw=kw, bb=bb, boh=boh, ow=ow, cin=cin, bco=bco,
+    )
+
+
+def _core_kernel_pipelined(
+    x_hbm, k_ref, y_ref, slab2, sem2, *, kh, kw, bb, boh, ow, cin, bco,
+    n_b, n_i, interpreted,
+):
+    """Double-buffered variant of :func:`_core_kernel` (opt-in via
+    DTM_CONV_MXU_PIPELINE): the halo-slab DMA for block N+1 is started
+    right after block N's slab arrives, so the copy overlaps block N's
+    n_j compute steps instead of stalling block N+1's first step.  The
+    plain kernel's copy is synchronous (start+wait inline), which for
+    small-Cout stages (n_j == 1, e.g. every ResNet stage-1 conv) puts a
+    full slab DMA on the critical path of EVERY grid step.
+
+    Costs/constraints: 2x slab VMEM; ALL grid dims must be "arbitrary"
+    (cross-block prefetch assumes strict sequential order — fine on
+    single-TensorCore v5e, surrenders Megacore splitting elsewhere).
+    ``slab2``/``sem2`` carry a leading parity dim of 2; blocks alternate
+    slots by linear block index.  Under the interpreter scratch does not
+    persist across grid points, so interpreted mode degrades to the
+    synchronous copy-every-step scheme — numerics identical, pipelining
+    itself is Mosaic-only behavior (validated by the hardware canary
+    before the A/B arm runs).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bq = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    rows = boh + kh - 1
+    blk = bq * n_i + i  # linear (b, i) block index; j cycles inside it
+    parity = jax.lax.rem(blk, 2)
+
+    def copy_for(tblk, slot):
+        tb = tblk // n_i
+        ti = jax.lax.rem(tblk, n_i)
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(tb * bb, bb), pl.ds(ti * boh, rows)],
+            slab2.at[slot],
+            sem2.at[slot],
+        )
+
+    if interpreted:
+        # Degraded interpreter scheme: synchronous copy every step into
+        # this block's slot (scratch does not persist across steps).
+        cp = copy_for(blk, parity)
+        cp.start()
+        cp.wait()
+    else:
+        # First block of the whole grid: nothing prefetched it.
+        @pl.when(jnp.logical_and(blk == 0, j == 0))
+        def _prime():
+            copy_for(0, 0).start()
+
+        @pl.when(j == 0)
+        def _arrive_and_prefetch():
+            copy_for(blk, parity).wait()
+
+            @pl.when(blk + 1 < n_b * n_i)
+            def _prefetch_next():
+                copy_for(blk + 1, 1 - parity).start()
+
+    _accumulate_taps(
+        slab2[parity], k_ref, y_ref,
+        kh=kh, kw=kw, bb=bb, boh=boh, ow=ow, cin=cin, bco=bco,
+    )
+
+
+def _pipeline_enabled() -> bool:
+    """DTM_CONV_MXU_PIPELINE resolves at trace time (the DTM_CONV_IMPL
+    contract: invalid values fail loudly naming the knob).  Default off
+    — the synchronous kernel is the hardware-validated baseline; flip
+    only with a banked A/B artifact (measured-defaults principle)."""
+    env = os.environ.get("DTM_CONV_MXU_PIPELINE", "0")
+    if env not in ("0", "1"):
+        raise ValueError(
+            f"DTM_CONV_MXU_PIPELINE must be '0' or '1', got {env!r}"
+        )
+    return env == "1"
 
 
 def _core_fwd_impl(xpad, kernel, interpret):
@@ -154,14 +248,40 @@ def _core_fwd_impl(xpad, kernel, interpret):
     if wp8 != wp:
         xpad = jnp.pad(xpad, ((0, 0), (0, 0), (0, wp8 - wp), (0, 0)))
         wp = wp8
+    pipelined = _pipeline_enabled()
     bb, boh, bco = _pick_tiles(
-        b, oh, ow, wp, cin, cout, kh, xpad.dtype.itemsize
+        b, oh, ow, wp, cin, cout, kh, xpad.dtype.itemsize,
+        # Two slabs must fit where one did.
+        slab_budget=_SLAB_BUDGET // 2 if pipelined else _SLAB_BUDGET,
     )
     rows = boh + kh - 1
-    body = functools.partial(
-        _core_kernel, kh=kh, kw=kw, bb=bb, boh=boh, ow=ow, cin=cin, bco=bco,
-        interpreted=bool(interpret),
-    )
+    if pipelined:
+        body = functools.partial(
+            _core_kernel_pipelined, kh=kh, kw=kw, bb=bb, boh=boh, ow=ow,
+            cin=cin, bco=bco, n_b=b // bb, n_i=oh // boh,
+            interpreted=bool(interpret),
+        )
+        scratch = [
+            pltpu.VMEM((2, bb, rows, wp, cin), xpad.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+        # Cross-block prefetch assumes strict sequential grid order: ALL
+        # dims arbitrary (see _core_kernel_pipelined docstring).
+        semantics = ("arbitrary", "arbitrary", "arbitrary")
+    else:
+        body = functools.partial(
+            _core_kernel, kh=kh, kw=kw, bb=bb, boh=boh, ow=ow, cin=cin,
+            bco=bco, interpreted=bool(interpret),
+        )
+        scratch = [
+            pltpu.VMEM((bb, rows, wp, cin), xpad.dtype),
+            pltpu.SemaphoreType.DMA,
+        ]
+        # j must be "arbitrary": the j==0 slab copy feeds later j steps
+        # through persistent scratch, so the channel-tile dim can be
+        # neither reordered nor split across Megacore cores.  bq/i stay
+        # parallel — a core slice along them always opens at j==0.
+        semantics = ("parallel", "parallel", "arbitrary")
     if interpret:
         # The generic interpreter doesn't model ANY-space refs, DMA or
         # semaphores; the TPU-flavored interpreter does.
@@ -181,16 +301,9 @@ def _core_fwd_impl(xpad, kernel, interpret):
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct((b, oh, ow, cout), xpad.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((bb, rows, wp, cin), xpad.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
-        # j must be "arbitrary": the j==0 slab copy feeds later j steps
-        # through persistent scratch, so the channel-tile dim can be
-        # neither reordered nor split across Megacore cores.  bq/i stay
-        # parallel — a core slice along them always opens at j==0.
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
+            dimension_semantics=semantics
         ),
         interpret=interpret,
     )(xpad, kernel)

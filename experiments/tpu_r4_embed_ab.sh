@@ -34,6 +34,55 @@ DTM_UNEMBED_CHUNK=4096 \
 
 echo "$(date) [$R] chunk A/B DONE" >> "$LOG"
 
+# Double-buffered mxu conv (DTM_CONV_MXU_PIPELINE): its overlap path
+# is Mosaic-only (the interpreter cannot model cross-step scratch
+# persistence), so its own tiny canary gates the ladder arm — a hang
+# here must not eat slots the safe arms above still need.
+if [ -s experiments/tpu_r4_mxu_pipe_canary.json ] \
+        && grep -q '"ok": true' experiments/tpu_r4_mxu_pipe_canary.json; then
+    pipe_ok=1
+else
+    wait_healthy
+    echo "$(date) [$R] mxu pipeline canary" >> "$LOG"
+    DTM_CONV_MXU_PIPELINE=1 timeout 240 python - \
+        > experiments/tpu_r4_mxu_pipe_canary.json 2>> "$LOG" <<'EOF'
+import json
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributed_tensorflow_models_tpu.ops.conv_mxu import conv2d_mxu
+
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(8, 56, 56, 64), jnp.bfloat16)
+k = jnp.asarray(rng.randn(3, 3, 64, 64) * 0.05, jnp.bfloat16)
+y = jax.jit(conv2d_mxu)(x, k)
+y.block_until_ready()
+ref = lax.conv_general_dilated(
+    x.astype(jnp.float32), k.astype(jnp.float32), (1, 1), "SAME",
+    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+)
+err = float(jnp.max(jnp.abs(y.astype(jnp.float32) - ref)))
+plat = jax.devices()[0].platform
+print(json.dumps({
+    "ok": bool(err < 0.5 and plat == "tpu"),
+    "max_err_vs_xla_f32": err,
+    "platform": plat,
+}))
+EOF
+    rc=$?
+    echo "$(date) [$R] pipe canary rc=$rc $(head -c 200 experiments/tpu_r4_mxu_pipe_canary.json)" >> "$LOG"
+    pipe_ok=0
+    grep -q '"ok": true' experiments/tpu_r4_mxu_pipe_canary.json && pipe_ok=1
+fi
+if [ "$pipe_ok" = 1 ]; then
+    DTM_CONV_IMPL=mxu DTM_CONV_MXU_PIPELINE=1 \
+        bench_one resnet50 "tpu_r4_mxu_pipe_resnet50_b128.json" --batch 128
+else
+    echo "$(date) [$R] pipe canary failed - pipelined arm skipped" >> "$LOG"
+fi
+
 # DEAD LAST, deliberately wedge-risking: flash at T=4096 was poison
 # trigger #2 in r3, but the round-4 kernels compile differently (mask
 # elision branches, independent bwd tiles) and this runs only after

@@ -165,6 +165,61 @@ class TestPickTiles:
         assert 224 % boh == 0
 
 
+class TestPipelinedKernel:
+    """DTM_CONV_MXU_PIPELINE=1 routes through the double-buffered
+    kernel.  The interpreter cannot model cross-step scratch persistence
+    (the overlap itself is Mosaic-only, gated by the hardware canary),
+    but these tests execute the pipelined kernel's real code path —
+    parity slots, dynamic leading-index slab reads, per-slot semaphores
+    — in its degraded synchronous scheme, pinning numerics."""
+
+    @pytest.mark.parametrize(
+        "xshape,kshape,strides",
+        [
+            ((2, 16, 16, 32), (3, 3, 32, 48), (1, 1)),
+            ((4, 8, 8, 64), (3, 3, 64, 512), (1, 1)),  # n_j > 1
+            ((2, 17, 15, 32), (3, 3, 32, 48), (2, 2)),  # phase decomp
+        ],
+        ids=["basic", "cout_tiled", "strided"],
+    )
+    def test_matches_plain_kernel(self, monkeypatch, xshape, kshape,
+                                  strides):
+        rng = np.random.RandomState(11)
+        x = _rand(rng, *xshape)
+        k = _rand(rng, *kshape) * 0.1
+        monkeypatch.delenv("DTM_CONV_MXU_PIPELINE", raising=False)
+        y_plain = conv2d_mxu(x, k, strides, "SAME", interpret=True)
+        monkeypatch.setenv("DTM_CONV_MXU_PIPELINE", "1")
+        y_pipe = conv2d_mxu(x, k, strides, "SAME", interpret=True)
+        np.testing.assert_array_equal(y_pipe, y_plain)
+
+    def test_grads_match_plain(self, monkeypatch):
+        rng = np.random.RandomState(12)
+        x = _rand(rng, 2, 10, 10, 32)
+        k = _rand(rng, 3, 3, 32, 48) * 0.1
+
+        def loss(x, k):
+            return jnp.sum(
+                jnp.sin(conv2d_mxu(x, k, (1, 1), "SAME", interpret=True))
+            )
+
+        monkeypatch.delenv("DTM_CONV_MXU_PIPELINE", raising=False)
+        g_plain = jax.grad(loss, (0, 1))(x, k)
+        monkeypatch.setenv("DTM_CONV_MXU_PIPELINE", "1")
+        g_pipe = jax.grad(loss, (0, 1))(x, k)
+        for a, b in zip(g_pipe, g_plain):
+            np.testing.assert_array_equal(a, b)
+
+    def test_bad_env_raises_naming_knob(self, monkeypatch):
+        from distributed_tensorflow_models_tpu.ops.conv_mxu import (
+            _pipeline_enabled,
+        )
+
+        monkeypatch.setenv("DTM_CONV_MXU_PIPELINE", "yes")
+        with pytest.raises(ValueError, match="DTM_CONV_MXU_PIPELINE"):
+            _pipeline_enabled()
+
+
 def test_pick_tiles_inception_channel_fallbacks():
     """Inception channel counts with no 128-multiple divisor <= 256 must
     fall back to channel-full out blocks (always Mosaic-legal: the
