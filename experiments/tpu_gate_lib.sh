@@ -65,3 +65,26 @@ bench_one() {  # name outfile [extra bench args...]
     echo "$(date) [$R] bench $name rc=$rc $(tail -c 300 "experiments/$out" 2>/dev/null)" >> "$LOG"
     return $rc
 }
+
+run_gated() {  # label outfile success_marker timeout_s cmd...
+    # Generalized gated artifact runner for non-bench_one commands
+    # (pytest smokes, canaries): skip when the artifact already carries
+    # the success marker error-free, else health-gate, run under
+    # timeout with output to the LOG (the COMMAND is responsible for
+    # writing experiments/<outfile> only on success), and record the
+    # true rc.  Exists so runners stop hand-rolling this sequence and
+    # re-introducing the weak-grep / clobbered-rc bugs.
+    local label="$1" out="$2" marker="$3" tmo="$4"
+    shift 4
+    if [ -s "experiments/$out" ] && grep -q "$marker" "experiments/$out" \
+            && ! grep -q '"error"' "experiments/$out"; then
+        echo "$(date) [$R] skip $label (already banked)" >> "$LOG"
+        return 0
+    fi
+    wait_healthy
+    echo "$(date) [$R] $label" >> "$LOG"
+    timeout "$tmo" "$@" >> "$LOG" 2>&1
+    local rc=$?
+    echo "$(date) [$R] $label rc=$rc $(tail -c 200 "experiments/$out" 2>/dev/null)" >> "$LOG"
+    return $rc
+}

@@ -83,6 +83,14 @@ else
     echo "$(date) [$R] pipe canary failed - pipelined arm skipped" >> "$LOG"
 fi
 
+# TPU smoke as a banked pytest artifact (SURVEY §4 item 4): proven
+# matmul compile class, safe before the wedge-risking tail.  The test
+# writes the artifact itself (DTM_SMOKE_OUT) only after every assert
+# passed, so a banked file is a success marker by construction.
+DTM_TPU_SMOKE=1 DTM_SMOKE_OUT=experiments/tpu_r4_smoke.json \
+    run_gated "tpu smoke pytest" tpu_r4_smoke.json '"steps_per_sec"' 900 \
+    python -m pytest tests/test_tpu_smoke.py -q -s
+
 # DEAD LAST, deliberately wedge-risking: flash at T=4096 was poison
 # trigger #2 in r3, but the round-4 kernels compile differently (mask
 # elision branches, independent bwd tiles) and this runs only after
