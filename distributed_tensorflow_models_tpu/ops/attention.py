@@ -33,6 +33,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 NEG_INF = -1e30  # finite "-inf": keeps exp(s - m) well-defined in masked rows
 
@@ -147,6 +148,44 @@ def _block_update(carry, s_block, v_block):
     return m_new, l_new, acc_new
 
 
+def _resolve_qblock(block_q: Optional[int], Tq: int) -> Optional[int]:
+    """DTM_BLOCKWISE_QBLOCK / explicit ``block_q`` (trace-time,
+    fail-loudly naming the knob): opt-in static q-chunking for
+    :func:`blockwise_attention`.  None (and no env) keeps the single
+    full-Tq scan — the hardware-measured baseline; flip only with a
+    banked artifact.  Validation is shared by both entry paths: a chunk
+    size the length doesn't divide would SILENTLY bank a baseline
+    number labeled as chunked, and a tiny chunk python-unrolls
+    Tq/block_q scans — a multi-million-op HLO whose remote compile is
+    exactly the wedge class this machine's relay punishes."""
+    src = "block_q"
+    if block_q is None:
+        env = os.environ.get("DTM_BLOCKWISE_QBLOCK")
+        if not env:
+            return None
+        src = "DTM_BLOCKWISE_QBLOCK"
+        try:
+            block_q = int(env)
+        except ValueError:
+            raise ValueError(
+                f"DTM_BLOCKWISE_QBLOCK must be an integer, got {env!r}"
+            ) from None
+    if block_q < 1:
+        raise ValueError(f"{src} must be >= 1, got {block_q}")
+    v = min(block_q, Tq)
+    if Tq % v:
+        raise ValueError(
+            f"{src}={block_q} does not divide the query length {Tq} — "
+            "a silent fallback would mislabel an A/B artifact"
+        )
+    if Tq // v > 64:
+        raise ValueError(
+            f"{src}={block_q} would unroll {Tq // v} q chunks "
+            "(cap 64): the trace blow-up risks a wedged remote compile"
+        )
+    return v
+
+
 def blockwise_attention(
     q: jax.Array,
     k: jax.Array,
@@ -158,6 +197,7 @@ def blockwise_attention(
     q_offset: int | jax.Array = 0,
     kv_offset: int | jax.Array = 0,
     window: Optional[int] = None,
+    block_q: Optional[int] = None,
 ) -> jax.Array:
     """Memory-efficient attention: scan over KV blocks, BTHD in/out.
 
@@ -166,6 +206,23 @@ def blockwise_attention(
     scores instead of storing them); exact same math as
     :func:`reference_attention` (tested to fp32 tolerance).  KV lengths
     that don't divide ``block_kv`` are padded and masked.
+
+    ``block_q`` (or DTM_BLOCKWISE_QBLOCK) opts into STATIC q-chunking
+    for causal/window masks with static offsets: the single scan
+    computes every (query, kv-block) pair — at T=4096/512 blocks, 44%
+    of the causal pairs are fully masked and still cost a full matmul +
+    mask field — whereas each q chunk statically needs only kv blocks
+    [window start .. causal diagonal], with the per-element mask applied
+    ONLY on its boundary blocks.  Computes the exact unchunked
+    masked-softmax math: skipped leading blocks contribute garbage the
+    renorm zeroes exactly (alpha = exp(NEG_INF - m) == 0), and skipped
+    trailing blocks are exact no-ops (p == 0) — differences vs the
+    unchunked scan are ulp-level backend matmul reassociation (pinned in
+    tests/test_attention.py).  Chunk sizes the length doesn't divide or
+    that would unroll >64 chunks fail loudly; traced offsets (the ring
+    path) and configs with fully-masked rows (whose documented-garbage
+    output depends on visit count — _check_window) fall back to the
+    unchunked scan unchanged.
     """
     B, Tq, H, D = q.shape
     window = _check_window(window)
@@ -191,6 +248,36 @@ def blockwise_attention(
         vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
     kb = kf.reshape(B, H, nblocks, block_kv, D).transpose(2, 0, 1, 3, 4)
     vb = vf.reshape(B, H, nblocks, block_kv, D).transpose(2, 0, 1, 3, 4)
+
+    block_q = _resolve_qblock(block_q, Tq)
+    # Gate includes a no-fully-masked-rows guarantee: causal needs
+    # q_offset >= kv_offset (every row reaches at least the first key)
+    # and a window must reach the KV tail from the last query.  Rows
+    # with zero valid positions produce DOCUMENTED garbage
+    # (_check_window) whose exact bits depend on how many masked blocks
+    # were visited — the chunked path visits fewer, so equivalence only
+    # holds when no such rows exist.
+    no_dead_rows = (
+        isinstance(q_offset, int)
+        and isinstance(kv_offset, int)
+        and (not causal or q_offset >= kv_offset)
+        and (
+            window is None
+            or (q_offset + Tq - 1) - (kv_offset + Tkv - 1) < window
+        )
+    )
+    if (
+        block_q is not None
+        and (causal or window is not None)
+        and no_dead_rows
+    ):
+        return _blockwise_q_chunked(
+            qf, kb, vb, q.dtype,
+            causal=causal, scale=s, block_kv=block_kv,
+            block_q=block_q, q_offset=q_offset,
+            kv_offset=kv_offset, window=window, Tkv=Tkv,
+            nblocks=nblocks,
+        )
 
     qi = q_offset + jnp.arange(Tq)[:, None]  # [Tq, 1]
 
@@ -226,6 +313,107 @@ def blockwise_attention(
     )
     out = acc / jnp.maximum(l, 1e-30)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def _blockwise_q_chunked(
+    qf, kb, vb, out_dtype, *, causal, scale, block_kv, block_q, q_offset,
+    kv_offset, window, Tkv, nblocks,
+):
+    """The static-triangle half of :func:`blockwise_attention` (see its
+    docstring): python-unrolled q chunks, each visiting only the kv
+    blocks its mask can reach, with the per-element mask applied only on
+    boundary blocks.  All trip counts and mask decisions are static —
+    offsets are python ints by the caller's gate."""
+    B, H, Tq, D = qf.shape
+
+    def mask_needed(b, q_min_g, q_max_g):
+        # Boundary iff the block contains KV padding, straddles the
+        # causal diagonal for some chunk row, or straddles the window
+        # start for some chunk row — the static complement of the
+        # per-element mask below.
+        if (b + 1) * block_kv > Tkv:
+            return True
+        k_min = kv_offset + b * block_kv
+        k_max = kv_offset + (b + 1) * block_kv - 1
+        if causal and q_min_g < k_max:
+            return True
+        if window is not None and q_max_g - k_min >= window:
+            return True
+        return False
+
+    outs = []
+    for c in range(Tq // block_q):
+        q0 = c * block_q
+        qc = lax.slice_in_dim(qf, q0, q0 + block_q, axis=2)
+
+        @jax.checkpoint
+        def interior_body(carry, inp, qc=qc):
+            k_j, v_j = inp
+            s_block = jnp.einsum(
+                "bhqd,bhkd->bhqk", qc, k_j,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            return _block_update(carry, s_block, v_j), None
+        q_min_g = q_offset + q0
+        q_max_g = q_offset + q0 + block_q - 1
+        if causal:
+            # Last kv block holding any key <= the chunk's max query.
+            end = min(nblocks, (q_max_g - kv_offset) // block_kv + 1)
+        else:
+            end = nblocks
+        if window is not None:
+            start = max(
+                0, (q_min_g - window + 1 - kv_offset) // block_kv
+            )
+        else:
+            start = 0
+        m = jnp.zeros_like(qc[..., :1], dtype=jnp.float32) + NEG_INF
+        l = jnp.zeros_like(qc[..., :1], dtype=jnp.float32)
+        a = jnp.zeros_like(qc, dtype=jnp.float32)
+        carry = (m, l, a)
+
+        def masked_step(carry, b):
+            k_j = kb[b]
+            v_j = vb[b]
+            s_block = jnp.einsum(
+                "bhqd,bhkd->bhqk", qc, k_j,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            qi_c = q_offset + q0 + jnp.arange(block_q)[:, None]
+            lk = b * block_kv + jnp.arange(block_kv)[None, :]
+            valid = lk < Tkv
+            if causal:
+                valid = valid & (qi_c >= kv_offset + lk)
+            if window is not None:
+                valid = valid & (qi_c - (kv_offset + lk) < window)
+            s_block = jnp.where(valid, s_block, NEG_INF)
+            return _block_update(carry, s_block, v_j)
+
+        # Ascending block order, exactly like the unchunked scan:
+        # leading boundary blocks (window start / pad), one interior
+        # scan over the contiguous fully-valid run, trailing boundary
+        # blocks (causal diagonal / pad).
+        b = start
+        while b < end and mask_needed(b, q_min_g, q_max_g):
+            carry = jax.checkpoint(masked_step)(carry, b)
+            b += 1
+        run_end = b
+        while run_end < end and not mask_needed(
+            run_end, q_min_g, q_max_g
+        ):
+            run_end += 1
+        if run_end > b:
+            kslab = lax.slice_in_dim(kb, b, run_end, axis=0)
+            vslab = lax.slice_in_dim(vb, b, run_end, axis=0)
+            carry, _ = jax.lax.scan(
+                interior_body, carry, (kslab, vslab)
+            )
+        for b2 in range(run_end, end):
+            carry = jax.checkpoint(masked_step)(carry, b2)
+        m, l, a = carry
+        outs.append(a / jnp.maximum(l, 1e-30))
+    out = jnp.concatenate(outs, axis=2)
+    return jnp.swapaxes(out, 1, 2).astype(out_dtype)
 
 
 # ------------------------------------------------------------ pallas flash

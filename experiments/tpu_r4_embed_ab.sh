@@ -83,6 +83,14 @@ else
     echo "$(date) [$R] pipe canary failed - pipelined arm skipped" >> "$LOG"
 fi
 
+# Static q-chunked blockwise at T=4096 (DTM_BLOCKWISE_QBLOCK): 44% of
+# the causal (query, kv-block) pairs in the unchunked scan are fully
+# masked and still cost a matmul + mask field; the chunked path visits
+# only reachable blocks.  A/B against the main queue's
+# tpu_r4_tune_long_blockwise.json baseline.
+DTM_BLOCKWISE_QBLOCK=512 \
+    bench_one transformer_lm_long "tpu_r4_tune_long_qchunk.json"
+
 # TPU smoke as a banked pytest artifact (SURVEY §4 item 4): proven
 # matmul compile class, safe before the wedge-risking tail.  The test
 # writes the artifact itself (DTM_SMOKE_OUT) only after every assert

@@ -708,6 +708,131 @@ def test_flash_bwd_staged_matches_pair(causal, window, hkv, dtype):
         ), name
 
 
+class TestBlockwiseQChunked:
+    """Static q-chunking (block_q / DTM_BLOCKWISE_QBLOCK) computes the
+    exact unchunked masked-softmax math: skipped leading blocks are
+    zeroed exactly by the renorm (alpha = exp(NEG_INF - m) == 0) and
+    skipped trailing blocks are exact no-ops (p == 0).  Tolerances are
+    ulp-level: the backend may reassociate the score matmul's K-loop
+    differently for chunked vs full-Tq shapes."""
+
+    @pytest.mark.parametrize(
+        "T,Tkv,bkv,bq,causal,window,qoff,kvoff",
+        [
+            (512, 512, 128, 128, True, None, 0, 0),
+            (512, 512, 128, 256, True, 96, 0, 0),
+            (256, 384, 100, 64, True, None, 128, 0),  # pad + offset
+            (256, 256, 128, 64, False, 64, 0, 0),  # window only
+        ],
+        ids=["causal", "causal_window", "pad_offset", "window_only"],
+    )
+    def test_bitwise_matches_unchunked(
+        self, T, Tkv, bkv, bq, causal, window, qoff, kvoff
+    ):
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(2, T, 2, 16), jnp.float32)
+        k = jnp.asarray(rng.randn(2, Tkv, 2, 16), jnp.float32)
+        v = jnp.asarray(rng.randn(2, Tkv, 2, 16), jnp.float32)
+        base = attnlib.blockwise_attention(
+            q, k, v, causal=causal, block_kv=bkv,
+            q_offset=qoff, kv_offset=kvoff, window=window,
+        )
+        chunked = attnlib.blockwise_attention(
+            q, k, v, causal=causal, block_kv=bkv,
+            q_offset=qoff, kv_offset=kvoff, window=window, block_q=bq,
+        )
+        np.testing.assert_allclose(chunked, base, rtol=3e-5, atol=1e-6)
+
+    def test_grads_match_unchunked(self):
+        rng = np.random.RandomState(4)
+        q = jnp.asarray(rng.randn(1, 256, 2, 16), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 256, 2, 16), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 256, 2, 16), jnp.float32)
+
+        def loss(bq):
+            return lambda q, k, v: jnp.sum(
+                attnlib.blockwise_attention(
+                    q, k, v, causal=True, block_kv=64, block_q=bq
+                )
+                ** 2
+            )
+
+        g0 = jax.grad(loss(None), (0, 1, 2))(q, k, v)
+        g1 = jax.grad(loss(64), (0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g0):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+    def test_traced_offsets_fall_back(self):
+        """The ring path passes traced offsets; chunking must quietly
+        fall back to the unchunked scan rather than fail to unroll."""
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(1, 128, 2, 16), jnp.float32)
+        k, v = q, q
+
+        @jax.jit
+        def f(q, k, v, off):
+            return attnlib.blockwise_attention(
+                q, k, v, causal=True, block_kv=64, block_q=64,
+                q_offset=off, kv_offset=0,
+            )
+
+        base = attnlib.blockwise_attention(
+            q, k, v, causal=True, block_kv=64, q_offset=128, kv_offset=0
+        )
+        np.testing.assert_allclose(
+            f(q, k, v, jnp.int32(128)), base, rtol=1e-6
+        )
+
+    def test_env_knob(self, monkeypatch):
+        rng = np.random.RandomState(6)
+        q = jnp.asarray(rng.randn(1, 256, 2, 16), jnp.float32)
+        base = attnlib.blockwise_attention(
+            q, q, q, causal=True, block_kv=64
+        )
+        monkeypatch.setenv("DTM_BLOCKWISE_QBLOCK", "64")
+        chunked = attnlib.blockwise_attention(
+            q, q, q, causal=True, block_kv=64
+        )
+        np.testing.assert_allclose(chunked, base, rtol=3e-5, atol=1e-6)
+        monkeypatch.setenv("DTM_BLOCKWISE_QBLOCK", "soon")
+        with pytest.raises(ValueError, match="DTM_BLOCKWISE_QBLOCK"):
+            attnlib.blockwise_attention(q, q, q, causal=True)
+
+    def test_validation_fails_loudly(self):
+        rng = np.random.RandomState(7)
+        q = jnp.asarray(rng.randn(1, 96, 2, 16), jnp.float32)
+        # Non-dividing chunk: a silent fallback would mislabel an A/B.
+        with pytest.raises(ValueError, match="does not divide"):
+            attnlib.blockwise_attention(
+                q, q, q, causal=True, block_q=64
+            )
+        with pytest.raises(ValueError, match=">= 1"):
+            attnlib.blockwise_attention(
+                q, q, q, causal=True, block_q=0
+            )
+        # Unroll cap: tiny chunks blow up the trace (wedge class).
+        with pytest.raises(ValueError, match="cap 64"):
+            attnlib.blockwise_attention(
+                q, q, q, causal=True, block_q=1
+            )
+
+    def test_dead_rows_fall_back_to_unchunked(self):
+        """kv_offset > q_offset leaves fully-masked rows whose
+        documented-garbage output depends on visit count; the chunked
+        gate must decline so numerics stay identical."""
+        rng = np.random.RandomState(8)
+        q = jnp.asarray(rng.randn(1, 128, 2, 16), jnp.float32)
+        base = attnlib.blockwise_attention(
+            q, q, q, causal=True, block_kv=64,
+            q_offset=0, kv_offset=64,
+        )
+        chunked = attnlib.blockwise_attention(
+            q, q, q, causal=True, block_kv=64,
+            q_offset=0, kv_offset=64, block_q=32,
+        )
+        np.testing.assert_array_equal(chunked, base)
+
+
 def test_auto_impl_is_blockwise():
     """auto == blockwise bit-for-bit (the measured end-to-end training
     winner on every banked hardware shape — TPU_BENCH_r3.md); flash
