@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 from typing import Optional
 
 import jax
@@ -173,6 +174,17 @@ def _resolve_qblock(block_q: Optional[int], Tq: int) -> Optional[int]:
     if block_q < 1:
         raise ValueError(f"{src} must be >= 1, got {block_q}")
     v = min(block_q, Tq)
+    if v != block_q:
+        # The knob asked for a chunk longer than the query length:
+        # clamping to one full-length chunk is correct math but is the
+        # unchunked computation in all but name — say what was actually
+        # measured (same contract as the DTM_UNEMBED_CHUNK clamp notice
+        # in ops/losses.py).
+        print(
+            f"[attention] {src}={block_q} clamped to {v} "
+            f"(query length {Tq}) — one full-length chunk",
+            file=sys.stderr,
+        )
     if Tq % v:
         raise ValueError(
             f"{src}={block_q} does not divide the query length {Tq} — "
@@ -250,6 +262,18 @@ def blockwise_attention(
     vb = vf.reshape(B, H, nblocks, block_kv, D).transpose(2, 0, 1, 3, 4)
 
     block_q = _resolve_qblock(block_q, Tq)
+    if block_q is not None and not (causal or window is not None):
+        # q-chunking only skips blocks a causal/window mask rules out;
+        # with neither mask there is nothing to skip and the unchunked
+        # scan runs.  Say so loudly: an A/B artifact labeled 'qchunk'
+        # on a non-masked config would actually measure the baseline —
+        # the exact mislabeling the knob's validation exists to prevent.
+        print(
+            f"[attention] block_q={block_q} ignored: neither causal nor "
+            "window is set, so the unchunked scan runs (a 'qchunk' A/B "
+            "label on this config would measure the baseline)",
+            file=sys.stderr,
+        )
     # Gate includes a no-fully-masked-rows guarantee: causal needs
     # q_offset >= kv_offset (every row reaches at least the first key)
     # and a window must reach the KV tail from the last query.  Rows
@@ -266,6 +290,21 @@ def blockwise_attention(
             or (q_offset + Tq - 1) - (kv_offset + Tkv - 1) < window
         )
     )
+    if (
+        block_q is not None
+        and (causal or window is not None)
+        and not no_dead_rows
+    ):
+        # The documented fallbacks (traced offsets — the ring path — and
+        # dead-row configs) still deserve the same loud trace-time
+        # notice: an artifact labeled 'qchunk' on such a config measures
+        # the unchunked baseline.
+        print(
+            f"[attention] block_q={block_q} ignored: traced offsets or "
+            "possible fully-masked rows (q_offset/kv_offset/window gate) "
+            "— running the unchunked scan",
+            file=sys.stderr,
+        )
     if (
         block_q is not None
         and (causal or window is not None)

@@ -62,6 +62,12 @@ _MIN_CIN = 16
 _SLAB_BUDGET = 4 * 1024 * 1024
 # Target rows for the GEMM M dimension per grid step.
 _M_TARGET = 1024
+# Whole-kernel VMEM budget for the tile search (bytes).  v5e has 16 MiB;
+# leave headroom for Mosaic's own spills.  Calibrated empirically with
+# the chipless r5 compile sweep: estimates ≤10.1 MiB all compile, the
+# 11.4 MiB dx class (128,11,16,512)x(3,3,512,512) still OOMs — the
+# budget sits between those observations.
+_VMEM_BUDGET = int(10.5 * 1024 * 1024)
 
 
 def _divisors_desc(n: int):
@@ -69,8 +75,28 @@ def _divisors_desc(n: int):
     return out
 
 
+def _vmem_estimate(bb, boh, bco, ow, wp, cin, kh, kw, itemsize, pipelined):
+    """Upper-bound VMEM footprint of one grid step: the slab scratch
+    (doubled when pipelined), the auto-pipelined kernel/output blocks
+    (double-buffered by Pallas), and the stack transients the unrolled
+    tap loop keeps live (the whole-slab load, one window, the f32
+    accumulator plus one dot result).  Heuristic, but it separated the
+    compiling from the OOMing shape classes exactly on hardware."""
+    rows = boh + kh - 1
+    slab = (2 if pipelined else 1) * bb * rows * wp * cin * itemsize
+    kblk = 2 * kh * kw * cin * bco * itemsize
+    oblk = 2 * bb * boh * ow * bco * itemsize
+    m = bb * boh * ow
+    transients = (
+        bb * rows * wp * cin * itemsize  # xs: the slab loaded as a value
+        + m * cin * itemsize             # one shifted window
+        + 2 * m * bco * 4                # f32 accumulator + dot output
+    )
+    return slab + kblk + oblk + transients
+
+
 def _pick_tiles(b, oh, ow, wp, cin, cout, kh, itemsize,
-                slab_budget=_SLAB_BUDGET):
+                slab_budget=_SLAB_BUDGET, kw=None, pipelined=False):
     """(bb, boh, bco): batch-fold, output-row tile, out-channel tile.
 
     boh: largest divisor of OH whose halo slab fits ``slab_budget`` with
@@ -97,12 +123,24 @@ def _pick_tiles(b, oh, ow, wp, cin, cout, kh, itemsize,
     # or equal the full array dim.  Inception-style channel counts (384,
     # 320, 448...) have divisors ≤256 that satisfy neither, so restrict
     # the search and fall back to channel-full blocks (always legal).
-    bco = next(
-        (d for d in _divisors_desc(cout)
-         if d <= 256 and (d % 128 == 0 or d == cout)),
-        cout,
-    )
-    return bb, boh, bco
+    bcos = [d for d in _divisors_desc(cout)
+            if d <= 256 and (d % 128 == 0 or d == cout)] or [cout]
+    bco = bcos[0]
+    # Whole-step VMEM check: the slab/M caps alone let the cin=512
+    # classes (ResNet-50 c5) assemble a 12.6 MiB step that OOMs VMEM on
+    # hardware.  Shrink in cheapness order — bco first (same total HBM
+    # traffic, just more j steps over the persistent slab), then bb,
+    # then boh (both cut the GEMM M) — and take the first combo that
+    # fits.
+    kw_eff = kw if kw is not None else kh
+    for cboh in [d for d in _divisors_desc(oh) if d <= boh]:
+        for cbb in [d for d in _divisors_desc(b) if d <= bb]:
+            for cbco in bcos:
+                if _vmem_estimate(cbb, cboh, cbco, ow, wp, cin, kh,
+                                  kw_eff, itemsize,
+                                  pipelined) <= _VMEM_BUDGET:
+                    return cbb, cboh, cbco
+    return 1, 1, bcos[-1]
 
 
 def _accumulate_taps(xs, k_ref, y_ref, *, kh, kw, bb, boh, ow, cin, bco):
@@ -248,11 +286,27 @@ def _core_fwd_impl(xpad, kernel, interpret):
     if wp8 != wp:
         xpad = jnp.pad(xpad, ((0, 0), (0, 0), (0, wp8 - wp), (0, 0)))
         wp = wp8
+    # Mosaic tiles the (W, C) minor dims as (8, 128) and physically pads
+    # the lane dim, for HBM and VMEM memrefs alike — so the halo DMA's
+    # memref_slice is rejected whenever cin % 128 != 0, even though the
+    # slice only cuts batch/row dims (first hardware canary, r5: "Slice
+    # shape along dimension 3 must be aligned to tiling (128), but is
+    # 64").  Pad cin explicitly: HBM traffic is unchanged (the tiled
+    # buffer already stores those lanes), only the MXU contraction pays
+    # zero-column MACs, and only for sub-multiple channel counts.
+    cin128 = -(-cin // 128) * 128
+    if cin128 != cin:
+        xpad = jnp.pad(xpad, ((0, 0), (0, 0), (0, 0), (0, cin128 - cin)))
+        kernel = jnp.pad(
+            kernel, ((0, 0), (0, 0), (0, cin128 - cin), (0, 0))
+        )
+        cin = cin128
     pipelined = _pipeline_enabled()
     bb, boh, bco = _pick_tiles(
         b, oh, ow, wp, cin, cout, kh, xpad.dtype.itemsize,
         # Two slabs must fit where one did.
         slab_budget=_SLAB_BUDGET // 2 if pipelined else _SLAB_BUDGET,
+        kw=kw, pipelined=pipelined,
     )
     rows = boh + kh - 1
     if pipelined:
@@ -290,7 +344,14 @@ def _core_fwd_impl(xpad, kernel, interpret):
         body,
         grid=(b // bb, oh // boh, cout // bco),
         in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
+            # HBM, not ANY: with ANY, a small-enough x gets placed in
+            # VMEM with lane-padded tiling (cin 64 -> 128), and the halo
+            # DMA's memref_slice then violates Mosaic's 128-alignment
+            # rule even though the slice only cuts batch/row dims (first
+            # hardware canary, r5: "Slice shape along dimension 3 must
+            # be aligned to tiling (128), but is 64").  The kernel's
+            # whole design assumes x streams from HBM anyway.
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
             pl.BlockSpec(
                 (kh, kw, cin, bco), lambda bq, i, j: (0, 0, 0, j),
                 memory_space=pltpu.VMEM,

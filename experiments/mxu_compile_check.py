@@ -1,0 +1,92 @@
+"""Chipless Mosaic compile check for the mxu conv ladder.
+
+Lowers and AOT-compiles the EXACT ladder train-step programs
+(DTM_CONV_IMPL=mxu ResNet-50 / Inception-v3 at the ladder batch sizes)
+via the relay's chipless compile helper, with abstract inputs only — no
+chip time, no device arrays.  Exists because the first hardware contact
+of the Pallas conv (r5 canary) died in Mosaic on a layout rule the
+interpreter does not model; this check walks every conv shape class in
+the real models through Mosaic BEFORE the benches spend chip minutes,
+and measures wall compile time so bench_one's timeout can be sized to
+never kill a compile mid-flight (the relay's known wedge trigger).
+
+Usage: python experiments/mxu_compile_check.py [model ...]
+Writes one JSON line per model to stdout.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("DTM_CONV_IMPL", "mxu")
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_tensorflow_models_tpu.core import train_loop
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.ops import optim
+
+CONFIGS = {
+    # name -> (model_name, image_size, batch, loss kwargs, rmsprop)
+    "resnet50_b128": ("resnet50", 224, 128,
+                      dict(weight_decay=1e-4), False),
+    "resnet50_b256": ("resnet50", 224, 256,
+                      dict(weight_decay=1e-4), False),
+    "resnet50_b64": ("resnet50", 224, 64,
+                     dict(weight_decay=1e-4), False),
+    "inception_b64": ("inception_v3", 299, 64,
+                      dict(weight_decay=4e-5, label_smoothing=0.1,
+                           aux_loss_weight=0.4), True),
+    "inception_b128": ("inception_v3", 299, 128,
+                       dict(weight_decay=4e-5, label_smoothing=0.1,
+                            aux_loss_weight=0.4), True),
+}
+
+
+def check(tag):
+    model_name, size, batch, loss_kw, rmsprop = CONFIGS[tag]
+    model = get_model(model_name, conv_impl="mxu")
+    if rmsprop:
+        tx = optim.tf_rmsprop(0.045, decay=0.9, momentum=0.9, epsilon=1.0)
+    else:
+        tx = optim.tf_momentum(
+            optim.exponential_decay(0.1 * batch / 256, 2000, 0.9), 0.9
+        )
+    state_shape = jax.eval_shape(
+        lambda: TrainState.create(
+            model, tx, jax.random.key(0),
+            jnp.zeros((8, size, size, 3), jnp.float32),
+        )
+    )
+    step_fn = train_loop.make_train_step_fn(
+        train_loop.classification_loss_fn(model.apply, **loss_kw)
+    )
+    batch_shape = {
+        "image": jax.ShapeDtypeStruct((batch, size, size, 3), jnp.float32),
+        "label": jax.ShapeDtypeStruct((batch,), jnp.int32),
+    }
+    key_shape = jax.eval_shape(lambda: jax.random.key(1))
+    t0 = time.time()
+    lowered = jax.jit(step_fn).lower(state_shape, batch_shape, key_shape)
+    t1 = time.time()
+    lowered.compile()
+    t2 = time.time()
+    return {"config": tag, "compile_ok": True,
+            "lower_s": round(t1 - t0, 1), "compile_s": round(t2 - t1, 1),
+            "platform": jax.devices()[0].platform}
+
+
+if __name__ == "__main__":
+    tags = sys.argv[1:] or list(CONFIGS)
+    for tag in tags:
+        try:
+            print(json.dumps(check(tag)), flush=True)
+        except Exception as e:  # noqa: BLE001 — the error IS the result
+            print(json.dumps({"config": tag, "compile_ok": False,
+                              "error": f"{type(e).__name__}: {e}"[:2000]}),
+                  flush=True)

@@ -1,0 +1,83 @@
+"""Per-shape-class chipless Mosaic compile sweep for conv2d_mxu.
+
+The full-model compile check failed after the canary passed, so some
+non-canary conv shape class violates a Mosaic rule the interpreter does
+not model.  This sweep compiles fwd and fwd+bwd for every mxu-routed
+shape class in ResNet-50 and Inception-v3 (batch as in the ladder),
+one pallas program per class, and prints the first Mosaic error line —
+turning an opaque full-model HTTP 500 into a named (shape, direction).
+
+Chipless: .lower().compile() with abstract inputs only.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from distributed_tensorflow_models_tpu.ops.conv_mxu import conv2d_mxu
+
+# (tag, batch, H, cin, cout, k, stride) — distinct mxu-routed classes.
+RESNET50 = [
+    ("r50 c2 3x3", 128, 56, 64, 64, 3, 1),
+    ("r50 c3 3x3", 128, 28, 128, 128, 3, 1),
+    ("r50 c3 3x3 s2", 128, 56, 128, 128, 3, 2),
+    ("r50 c4 3x3", 128, 14, 256, 256, 3, 1),
+    ("r50 c4 3x3 s2", 128, 28, 256, 256, 3, 2),
+    ("r50 c5 3x3", 128, 7, 512, 512, 3, 1),
+    ("r50 c5 3x3 s2", 128, 14, 512, 512, 3, 2),
+]
+INCEPTION = [
+    ("inc stem 3x3 s2", 64, 299, 32, 32, 3, 2),
+    ("inc stem 3x3", 64, 147, 32, 64, 3, 1),
+    ("inc 3x3 192", 64, 71, 80, 192, 3, 1),
+    ("inc 5x5", 64, 35, 48, 64, 5, 1),
+    ("inc dbl3x3 a", 64, 35, 64, 96, 3, 1),
+    ("inc dbl3x3 b", 64, 35, 96, 96, 3, 1),
+    ("inc red 3x3 s2", 64, 35, 288, 384, 3, 2),
+    ("inc red dbl s2", 64, 35, 96, 96, 3, 2),
+    ("inc red2 3x3 s2", 64, 17, 192, 320, 3, 2),
+]
+
+
+def compile_one(tag, b, h, cin, cout, k, s, direction):
+    x = jax.ShapeDtypeStruct((b, h, h, cin), jnp.bfloat16)
+    w = jax.ShapeDtypeStruct((k, k, cin, cout), jnp.bfloat16)
+
+    if direction == "fwd":
+        f = lambda a, kk: conv2d_mxu(a, kk, (s, s), "SAME", interpret=False)
+    else:
+        def f(a, kk):
+            y = conv2d_mxu(a, kk, (s, s), "SAME", interpret=False)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+        f = jax.grad(f, argnums=(0, 1))
+    t0 = time.time()
+    jax.jit(f).lower(x, w).compile()
+    return time.time() - t0
+
+
+if __name__ == "__main__":
+    classes = RESNET50 + INCEPTION
+    if len(sys.argv) > 1 and sys.argv[1] == "resnet":
+        classes = RESNET50
+    fails = 0
+    for tag, b, h, cin, cout, k, s in classes:
+        for direction in ("fwd", "bwd"):
+            try:
+                dt = compile_one(tag, b, h, cin, cout, k, s, direction)
+                print(json.dumps({"class": tag, "dir": direction,
+                                  "ok": True, "compile_s": round(dt, 1)}),
+                      flush=True)
+            except Exception as e:  # noqa: BLE001
+                fails += 1
+                msg = str(e)
+                key = next((ln for ln in msg.splitlines()
+                            if "Mosaic" in ln or "INTERNAL" in ln), msg[:200])
+                print(json.dumps({"class": tag, "dir": direction,
+                                  "ok": False, "error": key[:500]}),
+                      flush=True)
+    print(json.dumps({"sweep_fails": fails}), flush=True)
