@@ -1473,7 +1473,7 @@ def _emit_final(results, errors, attempts, force_cpu=False, partial=False):
         # A CPU-fallback run must not read as "this framework has no TPU
         # numbers": point the consumer at the committed hardware
         # artifacts from the last healthy relay window.
-        line["tpu_artifacts"] = "experiments/TPU_BENCH_r4.md"
+        line["tpu_artifacts"] = "experiments/TPU_BENCH_r5.md"
     emit(line)
 
 

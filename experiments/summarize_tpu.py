@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Collate TPU bench artifacts into one markdown table (any round).
+
+Usage: python experiments/summarize_tpu.py [glob ...]
+Defaults to every ``tpu_r*_*.json`` plus ``precompile_*.json`` under
+experiments/.  Replaces the per-round summarize_r4.py copies (ADVICE:
+shared parsing logic must live once).
+
+Three artifact schemas are understood:
+- one-line bench outputs (metric/value/unit[/mfu/platform]); a
+  ``partial: true`` flag (bench.py's last-line-wins re-emit after an
+  external kill) or ``config_errors`` marks the row PARTIAL so a
+  truncated queue cannot read as a clean one,
+- canary/precompile artifacts (``ok``/``compile_ok`` booleans): listed
+  with their boolean so a failed gate is visible, not a '? None' row,
+- errors / empty files: listed separately (a partially-banked queue is
+  visible at a glance).
+
+Writes nothing itself.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def main(argv: list[str]) -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    patterns = argv or ["tpu_r*_*.json", "precompile_*.json"]
+    paths: list[str] = []
+    for pat in patterns:
+        paths.extend(glob.glob(os.path.join(here, pat)))
+    rows, errors, empty = [], [], []
+    for path in sorted(set(paths)):
+        name = os.path.basename(path)
+        if name.endswith("_detail.json"):
+            continue
+        try:
+            with open(path) as f:
+                text = f.read().strip()
+        except OSError as e:
+            errors.append((name, f"unreadable: {e}"))
+            continue
+        if not text:
+            empty.append(name)
+            continue
+        try:
+            d = json.loads(text.splitlines()[-1])
+        except json.JSONDecodeError as e:
+            errors.append((name, f"bad json: {e}"))
+            continue
+        if "error" in d:
+            errors.append((name, str(d["error"])[:100]))
+            continue
+        ok = d.get("ok", d.get("compile_ok"))
+        if ok is not None and "metric" not in d:
+            # Canary / precompile gate artifact.
+            if not ok:
+                errors.append((name, f"gate FAILED: {text[:100]}"))
+            else:
+                detail = d.get("compile_s", d.get("max_err_vs_xla_f32"))
+                rows.append((name, "gate ok", detail, "",
+                             "—", d.get("platform", "?")))
+            continue
+        mfu = d.get("mfu")
+        metric = d.get("metric", "?")
+        flags = []
+        if d.get("partial"):
+            # Last-line-wins re-emit: the run was killed externally
+            # after these configs completed.
+            flags.append("killed mid-queue")
+        if d.get("config_errors"):
+            flags.append(
+                ", ".join(sorted(d["config_errors"])) + " errored"
+            )
+        if flags:
+            metric += f" (PARTIAL: {'; '.join(flags)})"
+        rows.append(
+            (
+                name,
+                metric,
+                d.get("value"),
+                d.get("unit", ""),
+                f"{mfu:.1%}" if isinstance(mfu, float) else "—",
+                d.get("platform", "?"),
+            )
+        )
+
+    print("| artifact | metric | value | unit | MFU | platform |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print("| " + " | ".join(str(x) for x in r) + " |")
+    if errors:
+        print("\nErrored artifacts:\n")
+        for name, err in errors:
+            print(f"- `{name}` — {err}")
+    if empty:
+        print("\nEmpty (in-flight or killed):\n")
+        for name in empty:
+            print(f"- `{name}`")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
