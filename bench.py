@@ -572,6 +572,13 @@ def build_transformer_lm(n_chips, batch_override, steps):
 FLAGSHIP_TRANSFORMER = dict(
     num_layers=8, num_heads=8, d_model=512, d_ff=2048
 )
+# Shared by every DTM_*_SMOKE mode so the smoke shapes cannot drift
+# apart.  num_heads=4 (not 2): the decode smoke's GQA arm pins
+# num_kv_heads=2, which must stay < num_heads or Hkv == H degrades the
+# arm to plain MHA and the grouped-KV path goes unvalidated.
+SMOKE_TRANSFORMER = dict(
+    num_layers=2, num_heads=4, d_model=64, d_ff=128
+)
 
 
 def _build_transformer(
@@ -665,8 +672,14 @@ def run_decode(args):
     from distributed_tensorflow_models_tpu.harness.generate import generate
     from distributed_tensorflow_models_tpu.models import get_model
 
-    B = args.batch or 8
-    T_prompt, T_new = 64, 192
+    # DTM_DECODE_SMOKE=1 shrinks model/lengths so the full decode path
+    # (generate, KV cache, MHA + GQA arms, the scan-amortized timing
+    # protocol) can be validated on a CPU host in seconds — this runner
+    # was rewritten in r4 and its first hardware slot must not be spent
+    # discovering a crash.  Measurement config is the flagship one.
+    smoke = os.environ.get("DTM_DECODE_SMOKE") == "1"
+    B = args.batch or (2 if smoke else 8)
+    T_prompt, T_new = (8, 24) if smoke else (64, 192)
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(rng.randint(0, 10000, (B, T_prompt)), jnp.int32)
 
@@ -679,14 +692,15 @@ def run_decode(args):
     # prefill subtraction.  The scan body takes a carry dependence
     # (prompt + carry%2) so XLA cannot hoist the loop-invariant body out
     # of the while loop.
-    repeats = 3
-    scan_gens = 8
+    repeats = 1 if smoke else 3
+    scan_gens = 2 if smoke else 8
     steps = T_new - 1  # tokens produced by the scan, prefill excluded
+    dims = SMOKE_TRANSFORMER if smoke else FLAGSHIP_TRANSFORMER
 
     def measure(num_kv_heads):
         model = get_model(
             "transformer_lm",
-            **FLAGSHIP_TRANSFORMER,
+            **dims,
             max_len=T_prompt + T_new,
             dropout_rate=0.0,
             num_kv_heads=num_kv_heads,
@@ -1041,11 +1055,7 @@ def run_transformer_parts(args):
     per_chip_batch = args.batch or 16
     mesh = meshlib.data_parallel_mesh()
     batch_size = per_chip_batch * n_chips
-    dims = (
-        dict(num_layers=2, num_heads=2, d_model=64, d_ff=128)
-        if smoke
-        else FLAGSHIP_TRANSFORMER
-    )
+    dims = SMOKE_TRANSFORMER if smoke else FLAGSHIP_TRANSFORMER
     model = get_model(
         "transformer_lm",
         **dims,
