@@ -197,7 +197,7 @@ def _peak_flops(device_kind):
     return None
 
 
-def run_one(name, builder, steps, batch_override):
+def run_one(name, builder, steps, batch_override, compile_only=False):
     """Time `steps` train steps fused into one compiled scan program: a
     single host dispatch for the measured region (amortises the
     host<->device round-trip through this machine's TPU relay, whose
@@ -238,7 +238,21 @@ def run_one(name, builder, steps, batch_override):
     rng = jax.random.key(42)
     t0 = time.time()
     compiled = jax.jit(fn).lower(state, batches, rng).compile()
-    log(f"{name}: compiled in {time.time()-t0:.1f}s")
+    compile_s = time.time() - t0
+    log(f"{name}: compiled in {compile_s:.1f}s")
+    if compile_only:
+        # Precompile gate (--compile-only): the EXACT timed program was
+        # just built and compiled, populating the persistent compilation
+        # cache, so the real bench's compile is a cache hit and its
+        # kill-risky on-chip compile window shrinks to ~nothing (killed
+        # on-chip compiles wedge this machine's relay).  No steps run.
+        return {
+            "metric": f"{name}_compile_only",
+            "compile_ok": True,
+            "value": round(compile_s, 1),
+            "unit": "compile_seconds",
+            "steps": steps,
+        }
     # FLOPs from a single-step lowering (trace-only; see helper docstring).
     # The lowering sees the global-batch program: divide by chip count.
     # Builders running a remat'd model supply a no-remat twin under
@@ -1183,6 +1197,11 @@ def run_mode(name, args):
         return run_decode(args)
     if name == "transformer_parts":
         return run_transformer_parts(args)
+    if getattr(args, "compile_only", False):
+        return run_one(
+            name, BUILDERS[name], args.steps, args.batch or None,
+            compile_only=True,
+        )
     return run_one(name, BUILDERS[name], args.steps, args.batch or None)
 
 
@@ -1250,7 +1269,25 @@ def main():
         help="run configs in this process (no per-config isolation)",
     )
     p.add_argument("--child", choices=CHILD_MODES, help=argparse.SUPPRESS)
+    p.add_argument(
+        "--compile-only",
+        action="store_true",
+        help="build and compile the exact timed program, run no steps "
+        "(precompile gate: populates the persistent compilation cache "
+        "so the real bench's compile is a cache hit; builder configs "
+        "only)",
+    )
     args = p.parse_args()
+    if args.compile_only and (args.child or args.config) in (
+        "flash_check", "decode", "transformer_parts", "all",
+    ):
+        p.error("--compile-only supports a single builder config only")
+    if args.compile_only and not (args.child or args.in_process):
+        # The orchestrated path does not forward the flag to its child
+        # subprocess; silently running the full kill-risky bench where
+        # the operator asked for a compile gate is the worst failure
+        # mode this flag exists to avoid.
+        p.error("--compile-only requires --child or --in-process")
 
     if args.child:
         return run_child(args)
@@ -1460,6 +1497,7 @@ def _emit_final(results, errors, attempts, force_cpu=False, partial=False):
         "vs_baseline": (
             round(head["value"] / BASELINE_IMAGES_PER_SEC_PER_CHIP, 4)
             if head_name == "resnet50"
+            and head["metric"] == "resnet50_synthetic_train_throughput"
             else 0.0
         ),
         "mfu": head.get("mfu"),

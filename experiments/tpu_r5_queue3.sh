@@ -1,0 +1,298 @@
+#!/bin/bash
+# Round-5 queue, v3 (v2 + exact-program warm-compile stage).  Supersedes tpu_r5_queue.sh after the 09:00 UTC
+# re-wedge taught two lessons the first hour of hardware contact:
+#
+#   1. KILLED ON-CHIP COMPILES STILL POISON THE RELAY (b256 mxu arm:
+#      bench.py's internal 900 s config timeout killed a slow compile;
+#      the next probe hung).  Chipless AOT compiles via the relay's
+#      compile helper, by contrast, were killed repeatedly today with
+#      no wedge.  So every unproven-compile bench arm is now gated on a
+#      chipless PRECOMPILE of the exact train-step program
+#      (experiments/mxu_compile_check.py) — the kill-risky part happens
+#      where kills are safe.
+#   2. COMPILE-HELPER CONTENTION IS REAL: four concurrent chipless jobs
+#      starved the b256 bench's compile past its timeout.  All compile
+#      work now lives in THIS one serialized script.
+#
+# The JAX persistent compilation cache is enabled for every python
+# below: the precompile populates it, so the bench's own jit compile
+# can be a cache hit instead of a second 5-10 min on-path compile.
+set -u
+cd "$(dirname "$0")/.."
+LOG=experiments/tpu_recovery.log
+R=r5-queue3
+. experiments/tpu_gate_lib.sh
+export JAX_COMPILATION_CACHE_DIR="$PWD/experiments/.jax_cache"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+echo "$(date) [$R] queue start" >> "$LOG"
+
+# --- A. mxu canary + precompile-gated ladder --------------------------------
+mxu_ok=0
+if [ -s experiments/tpu_r4_mxu_canary.json ] \
+        && grep -q '"ok": true' experiments/tpu_r4_mxu_canary.json; then
+    mxu_ok=1
+    echo "$(date) [$R] mxu canary already banked ok" >> "$LOG"
+else
+    wait_healthy
+    echo "$(date) [$R] mxu canary" >> "$LOG"
+    timeout 240 python - > experiments/tpu_r4_mxu_canary.json 2>> "$LOG" <<'EOF'
+import json
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributed_tensorflow_models_tpu.ops.conv_mxu import conv2d_mxu
+
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(8, 56, 56, 64), jnp.bfloat16)
+k = jnp.asarray(rng.randn(3, 3, 64, 64) * 0.05, jnp.bfloat16)
+y = jax.jit(conv2d_mxu)(x, k)
+y.block_until_ready()
+ref = lax.conv_general_dilated(
+    x.astype(jnp.float32), k.astype(jnp.float32), (1, 1), "SAME",
+    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+)
+err = float(jnp.max(jnp.abs(y.astype(jnp.float32) - ref)))
+plat = jax.devices()[0].platform
+print(json.dumps({
+    "ok": bool(err < 0.5 and plat == "tpu"),
+    "max_err_vs_xla_f32": err,
+    "platform": plat,
+}))
+EOF
+    rc=$?
+    echo "$(date) [$R] mxu canary rc=$rc $(head -c 200 experiments/tpu_r4_mxu_canary.json)" >> "$LOG"
+    grep -q '"ok": true' experiments/tpu_r4_mxu_canary.json && mxu_ok=1
+fi
+
+precompile_ok() {  # cfg -> 0/1 via experiments/precompile_<cfg>.json
+    local cfg="$1" out="experiments/precompile_${cfg}.json"
+    if [ -s "$out" ] && grep -q '"compile_ok": true' "$out"; then
+        echo "$(date) [$R] precompile $cfg already ok" >> "$LOG"
+        return 0
+    fi
+    # The compile itself is chipless, but jax backend init inside the
+    # checker still touches the relay — on a wedged relay it would hang
+    # to the timeout and wrongly mark the arm precompile-failed.
+    wait_healthy
+    echo "$(date) [$R] precompile $cfg (chipless)" >> "$LOG"
+    timeout 2400 python experiments/mxu_compile_check.py "$cfg" \
+        > "$out" 2>> "$LOG"
+    echo "$(date) [$R] precompile $cfg: $(head -c 200 "$out")" >> "$LOG"
+    grep -q '"compile_ok": true' "$out"
+}
+
+warm_ok() {  # bench_name batch outfile -> compile the EXACT timed
+    # program via bench.py --compile-only, populating the persistent
+    # compilation cache so the bench's own compile is a cache hit and
+    # its kill-risky on-chip compile window shrinks to ~nothing.
+    local name="$1" batch="$2" out="experiments/warm_$3"
+    if [ -s "$out" ] && grep -q '"compile_ok": true' "$out"; then
+        echo "$(date) [$R] warm-compile $name b$batch already ok" >> "$LOG"
+        return 0
+    fi
+    wait_healthy
+    echo "$(date) [$R] warm-compile $name b$batch (exact program)" >> "$LOG"
+    DTM_CONV_IMPL=mxu timeout 2400 python bench.py --child "$name" \
+        --steps 30 --batch "$batch" --compile-only > "$out" 2>> "$LOG"
+    echo "$(date) [$R] warm-compile $name: $(head -c 200 "$out")" >> "$LOG"
+    grep -q '"compile_ok": true' "$out"
+}
+
+mxu_arm() {  # cfg bench_name outfile batch
+    local cfg="$1" name="$2" out="$3" batch="$4"
+    if [ -s "experiments/$out" ] && ! grep -q '"error"' "experiments/$out" \
+            && grep -q '"metric"' "experiments/$out"; then
+        echo "$(date) [$R] skip $name -> $out (already banked)" >> "$LOG"
+        return 0
+    fi
+    if ! precompile_ok "$cfg"; then
+        echo "$(date) [$R] $out SKIPPED: chipless precompile failed" >> "$LOG"
+        return 1
+    fi
+    if ! warm_ok "$name" "$batch" "$out"; then
+        echo "$(date) [$R] $out SKIPPED: warm-compile failed" >> "$LOG"
+        return 1
+    fi
+    DTM_CONV_IMPL=mxu bench_one "$name" "$out" --batch "$batch"
+}
+
+if [ "$mxu_ok" = 1 ]; then
+    mxu_arm resnet50_b128 resnet50 tpu_r4_mxu_resnet50_b128.json 128
+    mxu_arm resnet50_b256 resnet50 tpu_r4_mxu_resnet50_b256.json 256
+    mxu_arm resnet50_b64 resnet50 tpu_r4_mxu_resnet50_b64.json 64
+    mxu_arm inception_b64 inception_v3 tpu_r4_mxu_inception_b64.json 64
+    mxu_arm inception_b128 inception_v3 tpu_r4_mxu_inception_b128.json 128
+else
+    echo "$(date) [$R] mxu canary FAILED - ladder skipped this pass" >> "$LOG"
+fi
+
+# --- B. MFU attribution -----------------------------------------------------
+bench_one transformer_parts "tpu_r4_parts_blockwise.json"
+DTM_BENCH_ATTN_IMPL=flash \
+    bench_one transformer_parts "tpu_r4_parts_flash.json"
+
+# --- C. flagship baseline + embed-grad arms ---------------------------------
+DTM_BENCH_ATTN_IMPL=blockwise \
+    bench_one transformer_lm "tpu_r4_tune_blockwise_b16.json" --batch 16
+DTM_EMBED_GRAD=matmul \
+    bench_one transformer_lm "tpu_r4_tune_blockwise_b16_embedmm.json"
+DTM_EMBED_GRAD=matmul \
+    bench_one transformer_parts "tpu_r4_parts_embedmm.json"
+DTM_EMBED_GRAD=matmul \
+    bench_one ptb_lstm "tpu_r4_ptb_b512_embedmm.json" --batch 512
+
+# --- D. unembed-chunk arms --------------------------------------------------
+DTM_UNEMBED_CHUNK=8192 \
+    bench_one transformer_lm "tpu_r4_tune_blockwise_b16_chunk8192.json"
+DTM_UNEMBED_CHUNK=4096 \
+    bench_one transformer_lm "tpu_r4_tune_blockwise_b16_chunk4096.json"
+
+# --- E. flash_check2: pair vs staged vs blockwise + tile sweeps -------------
+bench_one flash_check "tpu_r4_flash_check2.json"
+
+# --- F. decode --------------------------------------------------------------
+bench_one decode "tpu_r4_decode.json"
+
+# --- G. patches-ladder re-runs ----------------------------------------------
+bench_one resnet50 "tpu_r4_resnet50_b256_rerun.json" --batch 256
+bench_one inception_v3 "tpu_r4_inception_b16_rerun.json" --batch 16
+bench_one inception_v3 "tpu_r4_inception_b32_rerun.json" --batch 32
+
+# --- H. tuning matrix remainder + LSTM + R7 + flash e2e ---------------------
+for attn in blockwise reference; do
+    for b in 16 32 64; do
+        DTM_BENCH_ATTN_IMPL=$attn \
+            bench_one transformer_lm "tpu_r4_tune_${attn}_b${b}.json" --batch "$b"
+    done
+done
+DTM_BENCH_ATTN_IMPL=blockwise DTM_FUSED_UNEMBED=0 \
+    bench_one transformer_lm "tpu_r4_tune_blockwise_b16_twostage.json"
+bench_one ptb_lstm "tpu_r4_tune_ptb_b1024.json" --batch 1024
+DTM_FUSED_UNEMBED=0 bench_one ptb_lstm "tpu_r4_ptb_b512_twostage.json" --batch 512
+bench_one vgg16 "tpu_r4_vgg16.json"
+bench_one alexnet "tpu_r4_alexnet.json"
+DTM_BENCH_ATTN_IMPL=flash DTM_FLASH_TILE=512 \
+    bench_one transformer_lm "tpu_r4_flash_e2e_t512.json"
+DTM_BENCH_ATTN_IMPL=flash DTM_FLASH_TILE=256 \
+    bench_one transformer_lm "tpu_r4_flash_e2e_t256.json"
+
+# --- I. long-context: blockwise baseline + q-chunked arm --------------------
+bench_one transformer_lm_long "tpu_r4_tune_long_blockwise.json"
+DTM_BLOCKWISE_QBLOCK=512 \
+    bench_one transformer_lm_long "tpu_r4_tune_long_qchunk.json"
+
+# --- J. donation probe, TPU smoke, pipelined-mxu ----------------------------
+if [ -s experiments/tpu_r4_donate_probe.json ] \
+        && grep -q '"donation"' experiments/tpu_r4_donate_probe.json; then
+    echo "$(date) [$R] skip donate probe (already banked)" >> "$LOG"
+else
+    wait_healthy
+    echo "$(date) [$R] donation probe" >> "$LOG"
+    timeout 600 python - > experiments/tpu_r4_donate_probe.json 2>> "$LOG" <<'EOF'
+import json
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_tensorflow_models_tpu.core import mesh as meshlib
+from distributed_tensorflow_models_tpu.core import train_loop
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.ops import optim
+
+mesh = meshlib.data_parallel_mesh()
+model = get_model("transformer_lm", num_layers=2, num_heads=2, d_model=64,
+                  d_ff=128, max_len=32, dropout_rate=0.0)
+tx = optax.chain(optim.clip_by_global_norm(1.0), optim.adam(1e-3))
+state = TrainState.create(model, tx, jax.random.key(0),
+                          jnp.zeros((2, 32), jnp.int32))
+state = train_loop.place_state(state, mesh)
+loss_fn = train_loop.lm_loss_fn(model.apply, fused_unembed=True)
+step = jax.jit(train_loop.make_train_step_fn(loss_fn),
+               donate_argnums=(0,))
+tok = jnp.zeros((4, 32), jnp.int32)
+batch = {"inputs": tok, "targets": tok}
+out = {"platform": jax.devices()[0].platform,
+       "device": jax.devices()[0].device_kind}
+try:
+    state, m = step(state, batch, jax.random.key(1))
+    state, m = step(state, batch, jax.random.key(1))
+    jax.block_until_ready(state.params)
+    out.update(donation="works",
+               loss=float(m["loss"]),
+               step=int(state.step))
+except Exception as e:  # noqa: BLE001 — the error IS the result
+    out.update(donation="rejected", error=f"{type(e).__name__}: {e}"[:300])
+print(json.dumps(out))
+EOF
+    echo "$(date) [$R] donate rc=$? $(head -c 300 experiments/tpu_r4_donate_probe.json)" >> "$LOG"
+fi
+
+DTM_TPU_SMOKE=1 DTM_SMOKE_OUT=experiments/tpu_r4_smoke.json \
+    run_gated "tpu smoke pytest" tpu_r4_smoke.json '"steps_per_sec"' 900 \
+    python -m pytest tests/test_tpu_smoke.py -q -s
+
+pipe_ok=0
+if [ -s experiments/tpu_r4_mxu_pipe_canary.json ] \
+        && grep -q '"ok": true' experiments/tpu_r4_mxu_pipe_canary.json; then
+    pipe_ok=1
+else
+    wait_healthy
+    echo "$(date) [$R] mxu pipeline canary" >> "$LOG"
+    DTM_CONV_MXU_PIPELINE=1 timeout 240 python - \
+        > experiments/tpu_r4_mxu_pipe_canary.json 2>> "$LOG" <<'EOF'
+import json
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributed_tensorflow_models_tpu.ops.conv_mxu import conv2d_mxu
+
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(8, 56, 56, 64), jnp.bfloat16)
+k = jnp.asarray(rng.randn(3, 3, 64, 64) * 0.05, jnp.bfloat16)
+y = jax.jit(conv2d_mxu)(x, k)
+y.block_until_ready()
+ref = lax.conv_general_dilated(
+    x.astype(jnp.float32), k.astype(jnp.float32), (1, 1), "SAME",
+    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+)
+err = float(jnp.max(jnp.abs(y.astype(jnp.float32) - ref)))
+plat = jax.devices()[0].platform
+print(json.dumps({
+    "ok": bool(err < 0.5 and plat == "tpu"),
+    "max_err_vs_xla_f32": err,
+    "platform": plat,
+}))
+EOF
+    rc=$?
+    echo "$(date) [$R] pipe canary rc=$rc $(head -c 200 experiments/tpu_r4_mxu_pipe_canary.json)" >> "$LOG"
+    grep -q '"ok": true' experiments/tpu_r4_mxu_pipe_canary.json && pipe_ok=1
+fi
+if [ "$pipe_ok" = 1 ]; then
+    DTM_CONV_IMPL=mxu DTM_CONV_MXU_PIPELINE=1 \
+        bench_one resnet50 "tpu_r4_mxu_pipe_resnet50_b128.json" --batch 128
+else
+    echo "$(date) [$R] pipe canary failed - pipelined arm skipped" >> "$LOG"
+fi
+
+# --- K. WEDGE-RISK tail (only after everything above is banked) -------------
+if [ ! -s experiments/conv_ladder_r4.json ]; then
+    wait_healthy
+    echo "$(date) [$R] native conv ladder" >> "$LOG"
+    rm -f /tmp/dtm_defer_native_ladder
+    DTM_CONV_IMPL=xla python experiments/conv_ladder.py --timeout 420 \
+        --out experiments/conv_ladder_r4.json >> "$LOG" 2>&1
+    echo "$(date) [$R] native conv ladder rc=$?" >> "$LOG"
+fi
+
+echo "$(date) [$R] WEDGE-RISK tail: flash @ T=4096" >> "$LOG"
+DTM_BENCH_ATTN_IMPL=flash \
+    bench_one transformer_lm_long "tpu_r4_tune_long_flash.json"
+
+echo "$(date) [$R] queue DONE" >> "$LOG"
+touch /tmp/tpu_r5_queue_done
