@@ -767,7 +767,7 @@ def run_decode(args):
         log(f"decode kv{num_kv_heads} result: {json.dumps(out)}")
         return dt_decode, out
 
-    mha_dt, mha = measure(num_kv_heads=0)  # 0 = MHA (8 KV heads)
+    mha_dt, mha = measure(num_kv_heads=0)  # 0 = MHA (num_kv_heads == num_heads)
     gqa_dt, gqa = measure(num_kv_heads=2)  # 4x smaller cache
     return {
         "metric": "transformer_lm_decode_throughput",
