@@ -17,18 +17,23 @@ program, and the barrier is implicit in the collective's semantics.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Mapping, Sequence
+import logging
+import time
+from typing import Any, Callable, Mapping, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
+from distributed_tensorflow_models_tpu import telemetry
 from distributed_tensorflow_models_tpu.core import sharding as shardlib
 from distributed_tensorflow_models_tpu.core.train_state import TrainState
 from distributed_tensorflow_models_tpu.ops import ema as emalib
 from distributed_tensorflow_models_tpu.ops import losses as losslib
 from distributed_tensorflow_models_tpu.ops import metrics as metriclib
+
+log = logging.getLogger("dtm")
 
 PyTree = Any
 Batch = Mapping[str, jax.Array]
@@ -220,6 +225,116 @@ def make_train_step(
             )
     step_fn = make_train_step_fn(loss_fn, rng_names)
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+
+class InstrumentedStep:
+    """Wrap a jitted train step with compile + dispatch telemetry.
+
+    jit compiles silently inside the first call (and again on every new
+    input signature), which makes two production failure classes
+    invisible: a recompile storm (shape or sharding instability re-paying
+    the compile cost every few steps) and compile time masquerading as
+    slow steps.  This wrapper surfaces both without changing execution
+    semantics — every call still goes through the wrapped jit, keeping
+    its implicit-resharding tolerance (an AOT ``lower().compile()``
+    executable is stricter: it *rejects* inputs whose sharding drifted,
+    e.g. a checkpoint-restored TP state, where jit just recompiles).
+
+    - **Compile events**: the jit's compilation-cache size is read before
+      and after each call (~0.05 µs); a growth means that call compiled,
+      and its wall time is recorded into the ``train/compile`` timer
+      (count = compile events, total = seconds — compile-dominated, one
+      dispatch's enqueue time included).  Works for *every* recompile
+      trigger, including sharding changes a batch-shape key would miss.
+    - **FLOPs**: per new batch signature (leaf shapes/dtypes), a
+      trace-only ``lower()`` + XLA cost analysis feeds the
+      ``train/flops_per_step`` gauge (the *current* program's cost) and,
+      per executed step, the per-signature FLOPs accumulate into the
+      ``train/flops_total`` counter — the MFU numerator.  The counter,
+      not ``gauge × steps``, is what MFU readers use, so a ragged final
+      batch (smaller program, new signature) scales the accounting for
+      *its* steps only instead of silently re-pricing the whole run
+      (bench.py's single-step convention; Pallas custom-calls count zero
+      FLOPs, so MFU is conservative, never inflated).  Tracing happens
+      *before* the call, while input buffers are still valid under
+      donation.
+    - **Dispatch**: non-compiling calls are timed into ``train/dispatch``
+      (host-side enqueue under async dispatch — the data-wait vs
+      dispatch split is the diagnostic, not a device profile).
+    """
+
+    def __init__(
+        self,
+        step_fn: Callable,
+        registry: Optional[telemetry.MetricsRegistry] = None,
+    ):
+        self._fn = step_fn
+        self._registry = (
+            registry if registry is not None else telemetry.get_registry()
+        )
+        self._flops_by_sig: dict = {}
+        self.flops_per_step: Optional[float] = None
+
+    @staticmethod
+    def _signature(batch) -> tuple:
+        return tuple(
+            (tuple(leaf.shape), str(leaf.dtype))
+            for leaf in jax.tree_util.tree_leaves(batch)
+        )
+
+    def _cache_size(self) -> Optional[int]:
+        try:
+            return self._fn._cache_size()
+        except Exception:  # noqa: BLE001 — non-jitted callable
+            return None
+
+    def _record_flops(self, state, batch, rng) -> float:
+        """Trace-only lowering -> unoptimized-HLO FLOPs (no backend
+        compile; matches compiled FLOPs for matmul/conv-dominated graphs
+        — see bench.py's verification).  Best-effort: telemetry must
+        never be the thing that fails training."""
+        flops = 0.0
+        try:
+            cost = self._fn.lower(state, batch, rng).cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            flops = max(float(cost["flops"]), 0.0)
+        except Exception as e:  # noqa: BLE001 — per-platform availability
+            log.debug("step FLOPs unavailable: %s", e)
+        if flops > 0:
+            self.flops_per_step = flops
+            self._registry.gauge(telemetry.FLOPS_PER_STEP).set(flops)
+        return flops
+
+    def __call__(self, state, batch, rng):
+        reg = self._registry
+        sig = self._signature(batch)
+        flops = self._flops_by_sig.get(sig)
+        if flops is None:
+            if self._flops_by_sig:
+                log.warning(
+                    "train step saw a new batch signature %s (%d prior) "
+                    "— recompile storms show up as a growing compile "
+                    "count in telemetry",
+                    sig,
+                    len(self._flops_by_sig),
+                )
+            flops = self._flops_by_sig[sig] = self._record_flops(
+                state, batch, rng
+            )
+        before = self._cache_size()
+        t0 = time.perf_counter()
+        out = self._fn(state, batch, rng)
+        dt = time.perf_counter() - t0
+        compiled = (
+            before is not None and self._cache_size() != before
+        )
+        reg.timer(
+            telemetry.COMPILE if compiled else telemetry.DISPATCH
+        ).record(dt)
+        if flops:
+            reg.counter(telemetry.FLOPS_TOTAL).inc(flops)
+        return out
 
 
 def per_step_rngs(

@@ -12,13 +12,23 @@ the next step's transfer overlaps the current step's compute.
 Unlike the reference's queues, the pipeline is *checkpointable*: each batch
 carries the producer state that follows it, so `state` after consuming
 batch k resumes at batch k+1 exactly (SURVEY.md §5.4 gap).
+
+Telemetry: both stages record into an injectable
+:class:`...telemetry.MetricsRegistry` (default: the process-global one) —
+``pipeline/host_queue_depth`` + ``pipeline/producer_wait`` from the host
+producer, ``pipeline/prefetch_fill`` + ``pipeline/prefetch_depth`` from
+the device stage.  High producer wait = consumer-bound (healthy); high
+prefetch-fill p95 = the host stream is the bottleneck.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Iterator, Optional
+
+from distributed_tensorflow_models_tpu import telemetry
 
 PyTree = Any
 
@@ -37,8 +47,17 @@ class HostPipeline:
     ``get_state()/set_state()`` for resume.
     """
 
-    def __init__(self, dataset, *, prefetch: int = 4):
+    def __init__(
+        self,
+        dataset,
+        *,
+        prefetch: int = 4,
+        registry: Optional[telemetry.MetricsRegistry] = None,
+    ):
         self._dataset = dataset
+        self._registry = (
+            registry if registry is not None else telemetry.get_registry()
+        )
         self._buffer: queue.Queue = queue.Queue(maxsize=prefetch)
         self._error: Optional[BaseException] = None
         self._stop_event = threading.Event()
@@ -51,6 +70,7 @@ class HostPipeline:
         self._thread.start()
 
     def _run(self) -> None:
+        reg = self._registry
         try:
             for batch in self._dataset:
                 state = (
@@ -58,12 +78,21 @@ class HostPipeline:
                     if hasattr(self._dataset, "get_state")
                     else None
                 )
+                # Time blocked on a full buffer: high producer wait means
+                # the consumer is the bottleneck — the healthy state.
+                t0 = time.perf_counter()
                 while not self._stop_event.is_set():
                     try:
                         self._buffer.put((batch, state), timeout=0.1)
                         break
                     except queue.Full:
                         continue
+                reg.timer(telemetry.PRODUCER_WAIT).record(
+                    time.perf_counter() - t0
+                )
+                reg.gauge(telemetry.HOST_QUEUE_DEPTH).set(
+                    self._buffer.qsize()
+                )
                 if self._stop_event.is_set():
                     return
         except BaseException as e:  # propagate like Coordinator.join
@@ -123,7 +152,8 @@ class DevicePrefetcher:
     """
 
     def __init__(self, iterator, mesh, *, depth: int = 2,
-                 seq_dim: Optional[int] = None):
+                 seq_dim: Optional[int] = None,
+                 registry: Optional[telemetry.MetricsRegistry] = None):
         import functools
 
         from distributed_tensorflow_models_tpu.core import sharding
@@ -131,6 +161,9 @@ class DevicePrefetcher:
         self._it = iter(iterator)
         self._source = iterator
         self._mesh = mesh
+        self._registry = (
+            registry if registry is not None else telemetry.get_registry()
+        )
         self._shard = functools.partial(
             sharding.shard_batch, seq_dim=seq_dim
         )
@@ -142,17 +175,26 @@ class DevicePrefetcher:
         self._fill()
 
     def _fill(self) -> None:
+        reg = self._registry
         while len(self._buf) < self._depth:
+            # Fill stall: time blocked on the upstream (host) stream.  A
+            # fat p95 here is the data-stall smoking gun — the host
+            # pipeline cannot keep the prefetch buffer full.
+            t0 = time.perf_counter()
             try:
                 batch = next(self._it)
             except StopIteration:
                 return
+            reg.timer(telemetry.PREFETCH_FILL).record(
+                time.perf_counter() - t0
+            )
             state = (
                 self._source.get_state()
                 if hasattr(self._source, "get_state")
                 else None
             )
             self._buf.append((self._shard(self._mesh, batch), state))
+            reg.gauge(telemetry.PREFETCH_DEPTH).set(len(self._buf))
 
     def __iter__(self) -> Iterator[PyTree]:
         return self

@@ -35,6 +35,7 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from distributed_tensorflow_models_tpu import telemetry
 from distributed_tensorflow_models_tpu.core.train_state import TrainState
 
 log = logging.getLogger("dtm")
@@ -68,7 +69,11 @@ class CheckpointManager:
         *,
         process_index: Optional[int] = None,
         process_count: Optional[int] = None,
+        registry: Optional[telemetry.MetricsRegistry] = None,
     ):
+        self._registry = (
+            registry if registry is not None else telemetry.get_registry()
+        )
         self._dir = f"{workdir}/checkpoints"
         self._mgr = ocp.CheckpointManager(
             self._dir,
@@ -97,16 +102,20 @@ class CheckpointManager:
         force: bool = False,
     ) -> bool:
         step = int(state.step)
-        saved = self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(_array_tree(state)),
-                data=ocp.args.JsonSave(dataset_state or {}),
-            ),
-            force=force,
-        )
-        if saved and self._nproc > 1 and dataset_state is not None:
-            self._write_sidecar(step, dataset_state)
+        # The span covers the *blocking* portion only — orbax finishes the
+        # write async; the remainder lands in checkpoint/wait when
+        # wait()/close() blocks on durability.  Goodput sums both.
+        with self._registry.span(telemetry.CKPT_SAVE):
+            saved = self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(_array_tree(state)),
+                    data=ocp.args.JsonSave(dataset_state or {}),
+                ),
+                force=force,
+            )
+            if saved and self._nproc > 1 and dataset_state is not None:
+                self._write_sidecar(step, dataset_state)
         if saved:
             log.info("saved checkpoint at step %d", step)
         return saved
@@ -144,13 +153,14 @@ class CheckpointManager:
         abstract = jax.tree.map(
             ocp.utils.to_shape_dtype_struct, _array_tree(template)
         )
-        out = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                data=ocp.args.JsonRestore(),
-            ),
-        )
+        with self._registry.span(telemetry.CKPT_RESTORE):
+            out = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract),
+                    data=ocp.args.JsonRestore(),
+                ),
+            )
         tree = out.state
         state = template.replace(
             step=tree["step"],
@@ -191,10 +201,12 @@ class CheckpointManager:
 
     def wait(self) -> None:
         """Block until pending async saves are durable."""
-        self._mgr.wait_until_finished()
+        with self._registry.span(telemetry.CKPT_WAIT):
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
+        with self._registry.span(telemetry.CKPT_WAIT):
+            self._mgr.wait_until_finished()
         self._mgr.close()
 
 

@@ -24,6 +24,8 @@ from typing import Any, Mapping, Optional, Sequence
 import jax
 import numpy as np
 
+from distributed_tensorflow_models_tpu import telemetry
+
 log = logging.getLogger("dtm")
 
 Metrics = Mapping[str, Any]
@@ -124,20 +126,43 @@ class LoggingHook(Hook):
         parts = []
         for k in keys:
             v = metrics.get(k)
-            if v is not None:
+            if v is None:
+                continue
+            try:
                 parts.append(f"{k}={float(v):.4f}")
+            except (TypeError, ValueError):
+                # Array-valued metric (e.g. a per-class histogram): skip —
+                # the same guard SummaryWriter.scalars applies.  Logging
+                # must never be the thing that kills training.
+                continue
         log.info("step %d: %s", step, ", ".join(parts))
 
 
 class MetricWriterHook(Hook):
     """Append scalar metrics to ``<workdir>/metrics.jsonl`` every N steps —
     the SummarySaverHook role (TF monitored_session.py:585-590) with a
-    dependency-free format (one JSON object per line, TensorBoard-convertible)."""
+    dependency-free format (one JSON object per line, TensorBoard-convertible;
+    schema documented in README "Observability" and linted by
+    ``scripts/check_metrics_schema.py``).
+
+    The file handle stays open across steps (line-buffered append) —
+    reopening per write cost a path resolution + fd churn every cadence —
+    and each row goes down as ONE ``write`` of the full line, so a
+    concurrent ``tail -f`` never sees a torn line."""
 
     def __init__(self, workdir: str, every_steps: int = 100):
         self._path = os.path.join(workdir, "metrics.jsonl")
         self._every = every_steps
         os.makedirs(workdir, exist_ok=True)
+        # buffering=1: text-mode line buffering — flushed to the OS at
+        # each newline, i.e. exactly once per row.
+        self._f = open(self._path, "a", buffering=1)
+
+    def write_row(self, row: Mapping[str, Any]) -> None:
+        """Append one row (atomic single write of the full line)."""
+        if self._f.closed:  # post-end() stragglers must not crash
+            self._f = open(self._path, "a", buffering=1)
+        self._f.write(json.dumps(row) + "\n")
 
     def after_step(self, state, metrics, step):
         if step % self._every:
@@ -148,8 +173,11 @@ class MetricWriterHook(Hook):
                 row[k] = float(v)
             except (TypeError, ValueError):
                 continue
-        with open(self._path, "a") as f:
-            f.write(json.dumps(row) + "\n")
+        self.write_row(row)
+
+    def end(self, state):
+        if not self._f.closed:
+            self._f.close()
 
 
 class TensorBoardHook(Hook):
@@ -185,6 +213,120 @@ class TensorBoardHook(Hook):
     def end(self, state):
         if self._writer is not None:
             self._writer.close()
+
+
+class TelemetryHook(Hook):
+    """Snapshot the telemetry registry every ``every_steps`` and inject the
+    derived scalars into the per-step ``metrics`` dict, where the
+    downstream writer hooks (MetricWriterHook → ``metrics.jsonl``,
+    TensorBoardHook → event files) pick them up on the same cadence.
+    **Must be ordered before the writer hooks** (``fit`` does this).
+
+    Injected keys (interval = since the previous cadence firing):
+
+    - ``step_time_s``    — mean full-iteration wall time over the interval
+    - ``data_wait_s``    — mean per-step time blocked on the input pipeline
+    - ``dispatch_s``     — mean per-step host dispatch time
+    - ``steps_per_sec``  — interval throughput
+    - ``stall_fraction`` — data-wait share of interval wall time
+    - ``mfu``            — FLOPs retired / (interval wall × peak);
+      0.0 when the device has no known peak (CPU) or FLOPs are unknown
+    - ``compile_count`` / ``compile_s`` — cumulative compile events
+    - ``checkpoint_s``   — cumulative blocking checkpoint time
+    - ``host_queue_depth`` — producer buffer depth right now
+
+    Multi-host: steps/sec and stall fraction are allgathered
+    (``multihost_utils.process_allgather`` — a collective, so the hook
+    must run on EVERY process at the same steps; cadence is
+    deterministic in ``step``) and the chief's writers record
+    ``hosts/steps_per_sec_{min,mean}`` and ``hosts/stall_fraction_max``
+    — one slow or input-bound host is visible without ssh'ing into it.
+    """
+
+    def __init__(
+        self,
+        registry: telemetry.MetricsRegistry,
+        every_steps: int = 100,
+        process_count: Optional[int] = None,
+    ):
+        self._reg = registry
+        self._every = every_steps
+        self._nproc = (
+            jax.process_count() if process_count is None else process_count
+        )
+        try:
+            # Whole-mesh peak: the FLOPs numerator is the global SPMD
+            # program's cost, so the denominator is per-chip peak x all
+            # participating devices (bench.py's global/per-chip split).
+            peak = telemetry.peak_flops(jax.devices()[0].device_kind)
+            self._peak = peak and peak * len(jax.devices())
+        except Exception:  # noqa: BLE001 — telemetry must never crash
+            self._peak = None
+        self._last: Optional[tuple[float, int, dict]] = None
+        self.last_emitted: Optional[dict] = None
+
+    def begin(self, state):
+        self._last = (
+            time.perf_counter(), int(state.step), self._reg.snapshot()
+        )
+
+    def after_step(self, state, metrics, step):
+        if step % self._every:
+            return
+        now = time.perf_counter()
+        snap = self._reg.snapshot()
+        t0, s0, prev = self._last or (now, step, {})
+        self._last = (now, step, snap)
+        d_wall = max(now - t0, 1e-9)
+        d_steps = max(step - s0, 0)
+
+        def delta(key: str) -> float:
+            return snap.get(key, 0.0) - prev.get(key, 0.0)
+
+        def mean(name: str) -> float:
+            n = delta(f"{name}/count")
+            return delta(f"{name}/total_s") / n if n else 0.0
+
+        data_wait = delta(f"{telemetry.DATA_WAIT}/total_s")
+        sps = d_steps / d_wall
+        stall_frac = data_wait / d_wall
+        # FLOPs actually retired this interval (signature-exact — mixed
+        # batch shapes are each priced at their own program's cost).
+        flops_done = delta(telemetry.FLOPS_TOTAL)
+        out = {
+            "step_time_s": mean(telemetry.STEP_TIME),
+            "data_wait_s": data_wait / max(d_steps, 1),
+            "dispatch_s": mean(telemetry.DISPATCH),
+            "steps_per_sec": sps,
+            "stall_fraction": stall_frac,
+            "mfu": (
+                flops_done / (d_wall * self._peak)
+                if self._peak and flops_done > 0
+                else 0.0
+            ),
+            "compile_count": snap.get(f"{telemetry.COMPILE}/count", 0.0),
+            "compile_s": snap.get(f"{telemetry.COMPILE}/total_s", 0.0),
+            "checkpoint_s": (
+                snap.get(f"{telemetry.CKPT_SAVE}/total_s", 0.0)
+                + snap.get(f"{telemetry.CKPT_RESTORE}/total_s", 0.0)
+                + snap.get(f"{telemetry.CKPT_WAIT}/total_s", 0.0)
+            ),
+            "host_queue_depth": snap.get(telemetry.HOST_QUEUE_DEPTH, 0.0),
+        }
+        if self._nproc > 1:
+            from jax.experimental import multihost_utils
+
+            gathered = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([sps, stall_frac], np.float32)
+                )
+            ).reshape(-1, 2)
+            out["hosts/steps_per_sec_min"] = float(gathered[:, 0].min())
+            out["hosts/steps_per_sec_mean"] = float(gathered[:, 0].mean())
+            out["hosts/stall_fraction_max"] = float(gathered[:, 1].max())
+        self.last_emitted = out
+        if isinstance(metrics, dict):
+            metrics.update(out)
 
 
 class CheckpointHook(Hook):

@@ -20,11 +20,14 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
+import time
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 
+from distributed_tensorflow_models_tpu import telemetry
 from distributed_tensorflow_models_tpu.core import mesh as meshlib
 from distributed_tensorflow_models_tpu.core import train_loop
 from distributed_tensorflow_models_tpu.core.train_state import TrainState
@@ -282,11 +285,22 @@ def fit(
     mesh: Optional[object] = None,
 ) -> FitResult:
     """Train ``cfg`` to ``cfg.train_steps``, resuming from ``workdir`` if a
-    checkpoint exists.  Returns the final (host-fetched) state."""
+    checkpoint exists.  Returns the final (host-fetched) state.
+
+    Telemetry: the run owns a fresh ``MetricsRegistry`` threaded through
+    the pipeline, the instrumented step, the checkpoint manager, and a
+    ``TelemetryHook``; on exit (success *and* failure) the chief writes
+    ``<workdir>/telemetry.json`` — the goodput report splitting total wall
+    time into compute / data-stall / checkpoint / compile.
+    """
+    t_run0 = time.perf_counter()
+    registry = telemetry.MetricsRegistry()
     if mesh is None:
         mesh = mesh_from_config(cfg)
     state = build_state(cfg, mesh)
-    manager = ckptlib.CheckpointManager(workdir, keep=cfg.keep_checkpoints)
+    manager = ckptlib.CheckpointManager(
+        workdir, keep=cfg.keep_checkpoints, registry=registry
+    )
     state, data_state, restored = ckptlib.restore_or_init(manager, state)
     if restored:
         # Restored arrays arrive with default placement; re-lay them out on
@@ -305,14 +319,18 @@ def fit(
     if restored and data_state.get("dataset") and hasattr(dataset, "set_state"):
         dataset.set_state(data_state["dataset"])
 
-    host = pipelib.HostPipeline(dataset, prefetch=4)
+    host = pipelib.HostPipeline(dataset, prefetch=4, registry=registry)
     seq_dim = (
         1
         if cfg.task == "lm" and mesh.shape[meshlib.AxisNames.SEQ] > 1
         else None
     )
-    device_it = pipelib.DevicePrefetcher(host, mesh, depth=2, seq_dim=seq_dim)
-    step_fn = build_step(cfg, state)
+    device_it = pipelib.DevicePrefetcher(
+        host, mesh, depth=2, seq_dim=seq_dim, registry=registry
+    )
+    step_fn = train_loop.InstrumentedStep(
+        build_step(cfg, state), registry=registry
+    )
 
     def save_fn(s, _step):
         # Use the *device prefetcher's* view of the dataset position — it
@@ -340,6 +358,11 @@ def fit(
     )
     all_hooks: list[hooklib.Hook] = [
         hooklib.StopAtStepHook(cfg.train_steps),
+        # Before the chief writer hooks: TelemetryHook injects its derived
+        # scalars (data_wait_s, step_time_s, mfu, ...) into the metrics
+        # dict for the writers to record.  Runs on every process — its
+        # multi-host aggregation is a collective.
+        hooklib.TelemetryHook(registry, cfg.log_every_steps),
         *chief_hooks,
         hooklib.NanGuardHook(cfg.log_every_steps),
         hooklib.CheckpointHook(
@@ -357,8 +380,13 @@ def fit(
     step = int(state.step)
     try:
         while step < cfg.train_steps:
-            batch = next(device_it)
+            t_iter = time.perf_counter()
+            with registry.span(telemetry.DATA_WAIT):
+                batch = next(device_it)
             state, metrics = step_fn(state, batch, rng)
+            registry.timer(telemetry.STEP_TIME).record(
+                time.perf_counter() - t_iter
+            )
             step += 1
             steps_run += 1
             if not hooklib.run_hooks_after_step(all_hooks, state, metrics, step):
@@ -376,16 +404,61 @@ def fit(
             except Exception:
                 log.exception("hook %r abort() failed during error cleanup", h)
         _close_quietly(host, manager)
+        # A goodput report from a crashed run is exactly what the
+        # post-mortem wants (was it stalling before it died?).
+        _write_telemetry_report(workdir, registry, t_run0, steps_run)
         raise
     else:
+        # One hook's end() failing (e.g. a writer's close hitting ENOSPC)
+        # must not starve later hooks — CheckpointHook.end's final save
+        # runs last — nor the telemetry report.  The first error still
+        # propagates after cleanup: a failed final save is not a success.
+        end_error: Optional[BaseException] = None
         try:
             for h in all_hooks:
-                h.end(state)
+                try:
+                    h.end(state)
+                except BaseException as e:  # noqa: BLE001
+                    log.exception("hook %r end() failed", h)
+                    if end_error is None:
+                        end_error = e
         finally:
             _close_quietly(host, manager)
+        # After close: the report's checkpoint split includes the final
+        # save's wait-until-durable time.
+        _write_telemetry_report(workdir, registry, t_run0, steps_run)
+        if end_error is not None:
+            raise end_error
 
     host_metrics = {k: float(v) for k, v in metrics.items()}
     return FitResult(state=state, final_metrics=host_metrics, steps_run=steps_run)
+
+
+def _write_telemetry_report(
+    workdir: str, registry: telemetry.MetricsRegistry,
+    t_run0: float, steps_run: int,
+) -> None:
+    """Chief-only, best-effort ``telemetry.json`` goodput report."""
+    if jax.process_index() != 0:
+        return
+    try:
+        report = telemetry.goodput_report(
+            registry, total_s=time.perf_counter() - t_run0, steps=steps_run
+        )
+        telemetry.write_report(
+            os.path.join(workdir, "telemetry.json"), report
+        )
+        frac = report["fractions"]
+        log.info(
+            "goodput: compute %.1f%%, data stall %.1f%%, checkpoint "
+            "%.1f%%, compile %.1f%% over %.1fs (%d compile events, "
+            "mfu %.4f)",
+            100 * frac["compute"], 100 * frac["data_stall"],
+            100 * frac["checkpoint"], 100 * frac["compile"],
+            report["total_s"], report["compile_events"], report["mfu"],
+        )
+    except Exception:  # noqa: BLE001 — reporting must never mask training
+        log.exception("failed to write telemetry.json")
 
 
 def _close_quietly(host, manager) -> None:

@@ -1,0 +1,52 @@
+"""Unified telemetry: dependency-free metrics registry + goodput accounting.
+
+The reference's observability is ``tf.summary`` scalars plus a steps/sec
+hook (SURVEY.md §5.1, §5.5) — enough to plot a loss curve, not enough to
+answer the production question "where did the wall time go?".  This package
+is the layer every perf PR proves its claims against:
+
+- :mod:`registry` — counters, gauges, timers (p50/p95/max over a bounded
+  reservoir) and a ``span(name)`` context manager.  Stdlib only, safe to
+  import from any layer (it imports nothing from this repo).
+- :mod:`goodput` — turns a registry snapshot into the end-of-run
+  ``telemetry.json`` goodput report: compute / data-stall / checkpoint /
+  compile fractions of total wall time (summing to exactly 1.0), live MFU
+  from XLA-cost-analysis FLOPs, and compile-event counts so recompile
+  storms are diagnosable.
+
+Wiring (all via an injectable registry, defaulting to the process-global
+one): ``data/pipeline.py`` records queue depth / producer wait / prefetch
+fill stalls, ``core/train_loop.py::InstrumentedStep`` records compile
+events + FLOPs, ``harness/checkpoint.py`` records save/restore/wait
+durations, ``harness/hooks.py::TelemetryHook`` snapshots everything into
+``metrics.jsonl`` + TensorBoard at the logging cadence, and
+``harness/train.py::fit`` writes the final ``telemetry.json``.
+"""
+
+from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
+    CKPT_RESTORE,
+    CKPT_SAVE,
+    CKPT_WAIT,
+    COMPILE,
+    DATA_WAIT,
+    DISPATCH,
+    FLOPS_PER_STEP,
+    FLOPS_TOTAL,
+    HOST_QUEUE_DEPTH,
+    PREFETCH_DEPTH,
+    PREFETCH_FILL,
+    PRODUCER_WAIT,
+    STEP_TIME,
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    Timer,
+    get_registry,
+)
+from distributed_tensorflow_models_tpu.telemetry.goodput import (  # noqa: F401
+    device_count,
+    device_kind,
+    goodput_report,
+    peak_flops,
+    write_report,
+)

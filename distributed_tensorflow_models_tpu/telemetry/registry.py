@@ -1,0 +1,184 @@
+"""Dependency-free metrics registry: counters, gauges, timers, spans.
+
+Design constraints, in priority order:
+
+1. **Hot-path cost.**  ``Timer.record`` / ``Counter.inc`` / ``Gauge.set``
+   sit inside the train loop and the pipeline threads; they are a handful
+   of attribute writes each (< 1 µs — pinned by
+   ``tests/test_telemetry.py``'s 5 µs/step guard).  Percentile sorting is
+   deferred to :meth:`MetricsRegistry.snapshot`, which runs only at the
+   logging cadence.
+2. **No dependencies.**  Stdlib only, importable from every layer (data,
+   core, harness) without cycles.
+3. **Thread-tolerant.**  Metric *creation* is locked (pipeline threads and
+   the train loop race on first touch); recording is lock-free.  Each
+   metric has a single writer in this repo's wiring (one thread owns one
+   name), and under CPython's GIL a lost update on a cross-thread counter
+   costs one increment of telemetry, never a crash.
+
+Canonical metric names are module constants so the recorder (pipeline /
+train loop / checkpoint) and the reader (TelemetryHook, goodput report)
+can never drift apart on spelling.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator
+
+# Canonical names.  Timers flatten in snapshots as
+# ``<name>/{total_s,count,mean_s,p50_s,p95_s,max_s}``.
+DATA_WAIT = "train/data_wait"  # timer: loop blocked in next(batch)
+DISPATCH = "train/dispatch"  # timer: step-fn call (async dispatch)
+STEP_TIME = "train/step_time"  # timer: full iteration wall time
+COMPILE = "train/compile"  # timer: one record per XLA compile event
+FLOPS_PER_STEP = "train/flops_per_step"  # gauge: XLA cost-analysis FLOPs
+FLOPS_TOTAL = "train/flops_total"  # counter: FLOPs retired across all steps
+HOST_QUEUE_DEPTH = "pipeline/host_queue_depth"  # gauge
+PRODUCER_WAIT = "pipeline/producer_wait"  # timer: producer blocked on full buffer
+PREFETCH_FILL = "pipeline/prefetch_fill"  # timer: DevicePrefetcher upstream fetch
+PREFETCH_DEPTH = "pipeline/prefetch_depth"  # gauge
+CKPT_SAVE = "checkpoint/save"  # timer
+CKPT_RESTORE = "checkpoint/restore"  # timer
+CKPT_WAIT = "checkpoint/wait"  # timer: blocking on async save completion
+
+
+class Counter:
+    """Monotonic accumulator (events, seconds-of-X)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-value-wins instantaneous reading."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Timer:
+    """Duration accumulator with count/total/max and reservoir percentiles.
+
+    The reservoir keeps the last ``RESERVOIR`` samples (ring overwrite), so
+    p50/p95 reflect *recent* behaviour — a warmup-era outlier ages out
+    instead of pinning p95 forever.  ``max`` stays all-time: the single
+    worst stall is exactly the thing a post-mortem wants.
+    """
+
+    RESERVOIR = 512
+
+    __slots__ = ("count", "total", "max", "_samples", "_idx")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self._samples: list[float] = []
+        self._idx = 0
+
+    def record(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        if seconds > self.max:
+            self.max = seconds
+        if len(self._samples) < self.RESERVOIR:
+            self._samples.append(seconds)
+        else:
+            self._samples[self._idx] = seconds
+            self._idx = (self._idx + 1) % self.RESERVOIR
+
+    def percentiles(self, *qs: float) -> tuple[float, ...]:
+        """Nearest-rank percentiles over the reservoir (0.0 when empty)."""
+        if not self._samples:
+            return tuple(0.0 for _ in qs)
+        ordered = sorted(self._samples)
+        n = len(ordered)
+        return tuple(
+            ordered[min(n - 1, int(q * n))] for q in qs
+        )
+
+
+class MetricsRegistry:
+    """Create-or-get metric store with a flat-dict snapshot.
+
+    One registry per training run (``fit`` makes its own so concurrent or
+    back-to-back runs in one process never cross-contaminate); the
+    process-global default from :func:`get_registry` serves standalone
+    component use.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._timers: dict[str, Timer] = {}
+
+    def _get(self, table: dict, name: str, cls):
+        m = table.get(name)
+        if m is None:
+            with self._lock:
+                m = table.setdefault(name, cls())
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name, Gauge)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(self._timers, name, Timer)
+
+    @contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        """Time a ``with`` block into ``timer(name)`` (errors included —
+        a save that dies after 30 s still burned the 30 s)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timer(name).record(time.perf_counter() - t0)
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat ``{name: float}`` view of everything recorded so far.
+
+        Cumulative, not interval: readers wanting rates diff two
+        snapshots (TelemetryHook does).  Timer percentiles are computed
+        here — the one deliberately non-cheap operation, amortized over
+        the snapshot cadence, never paid per step.
+        """
+        out: dict[str, float] = {}
+        for name, c in sorted(self._counters.items()):
+            out[name] = c.value
+        for name, g in sorted(self._gauges.items()):
+            out[name] = g.value
+        for name, t in sorted(self._timers.items()):
+            p50, p95 = t.percentiles(0.50, 0.95)
+            out[f"{name}/count"] = float(t.count)
+            out[f"{name}/total_s"] = t.total
+            out[f"{name}/mean_s"] = t.total / t.count if t.count else 0.0
+            out[f"{name}/p50_s"] = p50
+            out[f"{name}/p95_s"] = p95
+            out[f"{name}/max_s"] = t.max
+        return out
+
+
+_default = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global default registry (standalone component use)."""
+    return _default

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Lint a ``metrics.jsonl`` against the documented schema (README
+"Observability").
+
+Checks, per line:
+
+- parses as a JSON object (``NaN``/``Infinity`` literals allowed — a
+  diverging loss is data, not corruption);
+- carries the required keys: ``step`` (non-negative int) and ``time``
+  (unix seconds, float);
+- every other value is a finite-or-not *number* (the writer coerces via
+  ``float()`` and skips everything it can't), never a string/list/object;
+- with ``--strict-monotonic``: ``step`` is non-decreasing across rows.
+  Off by default because a ``recoverable_fit`` restart legitimately
+  appends rows from the restored (earlier) step after the crash-era
+  rows — a healthy recovered run is not a lint failure;
+
+and, across the file with ``--require-telemetry``: at least one row
+carries the full telemetry key set (``data_wait_s``, ``step_time_s``,
+``mfu``) — the TelemetryHook injects them together, so a partial set on
+any row is always an error.
+
+Exit 0 on a clean file, 1 with one line per violation on stderr.
+Wired into tier-1 via ``tests/test_telemetry.py``'s smoke run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Iterable
+
+REQUIRED_KEYS = ("step", "time")
+TELEMETRY_KEYS = ("data_wait_s", "step_time_s", "mfu")
+
+
+def _is_number(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def check_lines(
+    lines: Iterable[str], *, strict_monotonic: bool = False
+) -> tuple[list[str], int, int]:
+    """Returns ``(errors, row_count, telemetry_row_count)``."""
+    errors: list[str] = []
+    prev_step = None
+    rows = 0
+    telemetry_rows = 0
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            errors.append(f"line {i}: blank line")
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"line {i}: unparseable JSON ({e})")
+            continue
+        if not isinstance(row, dict):
+            errors.append(f"line {i}: not a JSON object")
+            continue
+        rows += 1
+        for key in REQUIRED_KEYS:
+            if key not in row:
+                errors.append(f"line {i}: missing required key {key!r}")
+        step = row.get("step")
+        if step is not None:
+            if not isinstance(step, int) or isinstance(step, bool) or step < 0:
+                errors.append(
+                    f"line {i}: 'step' must be a non-negative int, "
+                    f"got {step!r}"
+                )
+            else:
+                if (
+                    strict_monotonic
+                    and prev_step is not None
+                    and step < prev_step
+                ):
+                    errors.append(
+                        f"line {i}: step went backwards "
+                        f"({prev_step} -> {step})"
+                    )
+                prev_step = step
+        for key, value in row.items():
+            if key == "step":
+                continue
+            if not _is_number(value):
+                errors.append(
+                    f"line {i}: value for {key!r} is not a number: "
+                    f"{value!r}"
+                )
+        present = [k for k in TELEMETRY_KEYS if k in row]
+        if len(present) == len(TELEMETRY_KEYS):
+            telemetry_rows += 1
+        elif present:
+            errors.append(
+                f"line {i}: partial telemetry key set {present} "
+                f"(expected all of {list(TELEMETRY_KEYS)} together)"
+            )
+    return errors, rows, telemetry_rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="path to metrics.jsonl")
+    p.add_argument(
+        "--require-telemetry",
+        action="store_true",
+        help="additionally require >= 1 row with the full telemetry key "
+        "set (data_wait_s, step_time_s, mfu)",
+    )
+    p.add_argument(
+        "--strict-monotonic",
+        action="store_true",
+        help="flag step regressions as errors (off by default: a "
+        "recoverable_fit restart legitimately rewinds the step)",
+    )
+    args = p.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
+    errors, rows, telemetry_rows = check_lines(
+        lines, strict_monotonic=args.strict_monotonic
+    )
+    if rows == 0:
+        errors.append("no metric rows found")
+    if args.require_telemetry and telemetry_rows == 0 and rows:
+        errors.append(
+            "no row carries the full telemetry key set "
+            f"{list(TELEMETRY_KEYS)}"
+        )
+    if errors:
+        for e in errors:
+            print(f"{args.path}: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"{args.path}: OK ({rows} rows, {telemetry_rows} with telemetry)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

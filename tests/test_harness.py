@@ -118,6 +118,107 @@ def test_step_counter_hook():
     assert h.last_steps_per_sec is not None and h.last_steps_per_sec > 0
 
 
+def test_logging_hook_skips_array_valued_metrics(caplog):
+    """float() on an array metric raises TypeError; the logging path must
+    skip it (mirroring SummaryWriter.scalars) instead of killing training."""
+    import logging as _logging
+
+    h = hooklib.LoggingHook(every_steps=1)
+    metrics = {
+        "loss": jnp.asarray(1.5),
+        "per_class": jnp.ones((4,)),  # non-scalar: must be skipped
+        "junk": object(),
+    }
+    with caplog.at_level(_logging.INFO, logger="dtm"):
+        h.after_step(_FakeState(), metrics, 1)
+    assert "loss=1.5000" in caplog.text
+    assert "per_class" not in caplog.text
+
+
+def test_metric_writer_keeps_handle_open_and_appends(tmp_path):
+    """The satellite fix: one persistent line-buffered handle, one write
+    per row — rows are on disk immediately (no reopen per write), and a
+    reopened hook appends rather than truncates."""
+    h = hooklib.MetricWriterHook(str(tmp_path), every_steps=1)
+    h.after_step(_FakeState(), {"loss": jnp.asarray(1.0)}, 1)
+    # Visible to a concurrent tail before any close/flush call.
+    assert len((tmp_path / "metrics.jsonl").read_text().splitlines()) == 1
+    f_first = h._f
+    h.after_step(_FakeState(), {"loss": jnp.asarray(0.5)}, 2)
+    assert h._f is f_first  # no reopen between writes
+    h.end(_FakeState())
+    assert h._f.closed
+
+    h2 = hooklib.MetricWriterHook(str(tmp_path), every_steps=1)
+    h2.after_step(_FakeState(), {"loss": jnp.asarray(0.25)}, 3)
+    h2.end(_FakeState())
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert [r["step"] for r in rows] == [1, 2, 3]
+
+
+def test_run_hooks_after_step_runs_all_despite_stop():
+    """Ordering + StopRequested semantics: every hook sees the stop step's
+    metrics; later hooks are not starved by an earlier hook's stop."""
+    calls = []
+
+    class Recorder(hooklib.Hook):
+        def __init__(self, name, stop=False):
+            self._name, self._stop = name, stop
+
+        def after_step(self, state, metrics, step):
+            calls.append(self._name)
+            if self._stop:
+                raise hooklib.StopRequested
+
+    hooks = [Recorder("a", stop=True), Recorder("b"), Recorder("c", stop=True)]
+    assert hooklib.run_hooks_after_step(hooks, _FakeState(), {}, 1) is False
+    assert calls == ["a", "b", "c"]
+
+
+def test_hook_abort_dispatch():
+    """Hook.abort defaults to end(); an override severs that link — the
+    failure path must call abort, never end, on overriding hooks."""
+    events = []
+
+    class EndOnly(hooklib.Hook):
+        def end(self, state):
+            events.append("end_only.end")
+
+    class Overridden(hooklib.Hook):
+        def end(self, state):
+            events.append("overridden.end")
+
+        def abort(self, state):
+            events.append("overridden.abort")
+
+    EndOnly().abort(None)
+    Overridden().abort(None)
+    assert events == ["end_only.end", "overridden.abort"]
+
+
+def test_checkpoint_hook_abort_skips_collective_save_multihost(monkeypatch):
+    """With process_count > 1 a crash-time save is a collective this lone
+    failing process must NOT enter (peers are blocked in the next step's
+    all-reduce); single-process the crash save preserves progress."""
+    saves = []
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    h = hooklib.CheckpointHook(
+        lambda s, step: saves.append(step), every_secs=None
+    )
+    h.abort(_FakeState())
+    assert saves == []  # skipped: no one-process collective entry
+
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    h1 = hooklib.CheckpointHook(
+        lambda s, step: saves.append(step), every_secs=None
+    )
+    h1.abort(_FakeState())
+    assert saves == [0]  # single-process crash-time save runs
+
+
 # --------------------------------------------------------------------------
 # Checkpointing
 # --------------------------------------------------------------------------

@@ -1,13 +1,97 @@
 """Summary writer: our hand-encoded event files must be readable by
 TensorFlow's own summary_iterator — the strongest available oracle that
-TensorBoard will load them (SURVEY.md §5.1/§5.5)."""
+TensorBoard will load them (SURVEY.md §5.1/§5.5) — and by the pure-Python
+decoder below, which needs no TF and so runs in every environment
+(framing + masked CRC32C via the repo's own TFRecord *reader*, i.e. the
+writer is cross-checked against independent code, plus a minimal
+Event/Summary proto walk)."""
 
 import glob
+import math
 import os
+import struct
 
 import pytest
 
+from distributed_tensorflow_models_tpu.data.example_proto import _read_varint
+from distributed_tensorflow_models_tpu.data.tfrecord import read_records
 from distributed_tensorflow_models_tpu.harness.summary import SummaryWriter
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) over one proto message.
+    Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            value, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            value = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            n, pos = _read_varint(buf, pos)
+            value = buf[pos:pos + n]
+            pos += n
+        elif wire == 5:
+            value = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unexpected wire type {wire}")
+        yield field, wire, value
+
+
+def _decode_event(payload):
+    """Event: wall_time double=1, step int64=2, file_version string=3,
+    summary=5 { repeated Value=1 { tag string=1, simple_value float=2 } }"""
+    event = {"values": {}}
+    for field, wire, value in _fields(payload):
+        if field == 1 and wire == 1:
+            event["wall_time"] = struct.unpack("<d", value)[0]
+        elif field == 2 and wire == 0:
+            event["step"] = value
+        elif field == 3 and wire == 2:
+            event["file_version"] = value.decode("utf-8")
+        elif field == 5 and wire == 2:
+            for sf, sw, sv in _fields(value):
+                assert sf == 1 and sw == 2, "Summary carries only Value"
+                tag = simple = None
+                for vf, vw, vv in _fields(sv):
+                    if vf == 1 and vw == 2:
+                        tag = vv.decode("utf-8")
+                    elif vf == 2 and vw == 5:
+                        simple = struct.unpack("<f", vv)[0]
+                event["values"][tag] = simple
+    return event
+
+
+def test_event_file_round_trip_pure_python(tmp_path):
+    """Parse the written file back — record framing and masked CRC32C are
+    verified by read_records (independent reader code), then the proto
+    fields; tags, steps, and f32-clamped values must survive."""
+    with SummaryWriter(tmp_path) as w:
+        w.scalar("loss", 2.5, step=1)
+        w.scalars(7, {"acc": 0.1, "overflow": 1e39, "underflow": -1e39})
+        path = w.path
+
+    events = [_decode_event(r) for r in read_records(path)]  # CRC verified
+    assert len(events) == 3
+    assert events[0]["file_version"] == "brain.Event:2"
+    assert events[0]["wall_time"] > 0
+
+    assert events[1]["step"] == 1
+    assert events[1]["values"] == {"loss": 2.5}
+
+    assert events[2]["step"] == 7
+    vals = events[2]["values"]
+    # 0.1 survives as its float32 rounding, not exactly 0.1.
+    assert vals["acc"] == pytest.approx(0.1, abs=1e-7)
+    assert vals["acc"] != 0.1
+    # Finite doubles beyond f32 range clamp to ±inf instead of crashing
+    # struct.pack (a diverging-but-finite loss must not kill training).
+    assert math.isinf(vals["overflow"]) and vals["overflow"] > 0
+    assert math.isinf(vals["underflow"]) and vals["underflow"] < 0
 
 
 def test_scalars_round_trip_through_tf_reader(tmp_path):
