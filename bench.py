@@ -77,6 +77,9 @@ ANALYTIC_TRAIN_FLOPS_PER_ITEM = {
     # conv1 5x5x32 @28 (0.63M MACs) + conv2 5x5x64 @14 (10.0M) + fc
     # 3136x1024 (3.2M), x2 FLOPs/MAC ~= 27.8M fwd
     "lenet": 3 * 2.78e7,
+    # 784->64->10 MLP: ~51k MACs -> 102k FLOPs fwd, x3 (the dispatch
+    # probe — its step is so small the host round-trip IS the cost).
+    "mlp_tiny": 3 * 1.02e5,
     "resnet32": 3 * 1.4e8,  # CIFAR ResNet-32 (6n+2, n=5) @32
     # VGG-16 @224: ~15.3 GMACs fwd -> 30.5 GFLOPs (XLA cost analysis of
     # the full step measured 91.5 GFLOP/image = 3x this).
@@ -197,6 +200,74 @@ def _peak_flops(device_kind):
     return None
 
 
+# Configs that get a steps_per_loop sweep appended to their detail entry:
+# the small/fast models where host dispatch, not the chip, bounds the step
+# rate — exactly the regime the fused multi-step loop targets.  Kept off
+# the conv models: the sweep compiles one scan program per K, and their
+# compile cost would eat the CPU-fallback budget for no extra signal.
+SPL_SWEEP_CONFIGS = ("mlp_tiny", "lenet")
+SPL_SWEEP_KS = (1, 4, 16)
+
+
+def _steps_per_loop_sweep(state, batches, step_fn, rng, target_s=0.75):
+    """Measure the real chunked-dispatch loop at each K: chunks of K
+    stacked batches through the SAME scan program fit uses
+    (core/train_loop.py::_jit_multi_step), one host dispatch + one metrics
+    readback per chunk.  Unlike run_one's single-scan timing (which fuses
+    the whole measured region), this keeps the per-chunk host round-trip
+    in the measurement — the quantity steps_per_loop exists to amortise —
+    so the K=1 vs K>1 delta IS the host overhead per step.
+
+    Self-calibrating: each arm sizes its chunk count to ~``target_s`` of
+    wall time from a probe call (a fixed step count would time noise for
+    sub-ms steps and minutes for 100 ms CPU-fallback steps) and reports
+    the best of two repetitions."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_models_tpu.core import train_loop
+
+    nb = jax.tree.leaves(batches)[0].shape[0]
+    # donate=False: every arm restarts from the same state buffers.
+    multi = train_loop._jit_multi_step(step_fn, donate=False)
+    out = {}
+    for k in SPL_SWEEP_KS:
+        idx = jnp.asarray([i % nb for i in range(k)])
+        chunk = jax.tree.map(lambda x: x[idx], batches)
+        s, rows = multi(state, chunk, rng)  # compile + warm
+        jax.block_until_ready(rows["loss"])
+        t0 = time.perf_counter()
+        s, rows = multi(state, chunk, rng)
+        float(rows["loss"][-1])
+        probe_dt = time.perf_counter() - t0
+        n_chunks = max(2, min(int(target_s / max(probe_dt, 1e-6)),
+                              max(2, 2048 // k)))
+        best = float("inf")
+        final = 0.0
+        for _ in range(2):
+            s = state
+            t0 = time.perf_counter()
+            for _ in range(n_chunks):
+                s, rows = multi(s, chunk, rng)
+            final = float(rows["loss"][-1])  # readback = the real sync
+            best = min(best, time.perf_counter() - t0)
+        out[str(k)] = {
+            "steps_per_sec": round(n_chunks * k / best, 2),
+            "chunks": n_chunks,
+            "seconds": round(best, 4),
+            "final_loss": round(final, 4),
+        }
+        log(
+            f"steps_per_loop sweep K={k}: "
+            f"{out[str(k)]['steps_per_sec']} steps/sec "
+            f"({n_chunks} chunks)"
+        )
+    out["best_k"] = max(
+        SPL_SWEEP_KS, key=lambda k: out[str(k)]["steps_per_sec"]
+    )
+    return out
+
+
 def run_one(name, builder, steps, batch_override, compile_only=False):
     """Time `steps` train steps fused into one compiled scan program: a
     single host dispatch for the measured region (amortises the
@@ -308,6 +379,10 @@ def run_one(name, builder, steps, batch_override, compile_only=False):
     if peak:
         result["mfu"] = round(flops_chip * steps / dt / peak, 4)
         result["peak_bf16_flops"] = peak
+    if name in SPL_SWEEP_CONFIGS:
+        result["steps_per_loop_sweep"] = _steps_per_loop_sweep(
+            state, batches, step_fn, rng
+        )
     return result
 
 
@@ -370,6 +445,57 @@ def build_lenet(n_chips, batch_override, steps):
     return _build_classifier(
         "lenet", 28, batch_override or 512, n_chips,
         channels=1, num_classes=10,
+    )
+
+
+def build_mlp_tiny(n_chips, batch_override, steps):
+    """Dispatch probe: a 784→64→10 MLP whose step is ~0.3 MFLOP, so the
+    per-step host round-trip IS the measured cost on every platform.
+    Exists for the steps_per_loop sweep — the K=1 vs K>1 delta here is a
+    direct read of the dispatch overhead the fused multi-step loop
+    amortises; the conv/LSTM configs are compute-bound on CPU hosts and
+    show ~flat sweeps (the honest signal that K only helps when the host,
+    not the chip, is the ceiling).  Matmul-only: relay-safe."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_models_tpu.core import mesh as meshlib
+    from distributed_tensorflow_models_tpu.core import train_loop
+    from distributed_tensorflow_models_tpu.core.train_state import TrainState
+    from distributed_tensorflow_models_tpu.ops import optim
+
+    class TinyMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False, **kw):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(64)(x))
+            return nn.Dense(10)(x)
+
+    per_chip_batch = batch_override or 8
+    mesh = meshlib.data_parallel_mesh()
+    batch_size = per_chip_batch * n_chips
+    model = TinyMLP()
+    state = TrainState.create(
+        model, optim.sgd(0.1), jax.random.key(0),
+        jnp.zeros((8, 28, 28, 1), jnp.float32),
+    )
+    state = train_loop.place_state(state, mesh)
+    step_fn = train_loop.make_train_step_fn(
+        train_loop.classification_loss_fn(model.apply)
+    )
+
+    def make_batch(i):
+        rng = np.random.RandomState(i)
+        return {
+            "image": rng.rand(batch_size, 28, 28, 1).astype(np.float32),
+            "label": rng.randint(0, 10, (batch_size,)),
+        }
+
+    batches = _stack_batches(mesh, make_batch)
+    return (
+        state, batches, step_fn, per_chip_batch, "images/sec/chip", {},
     )
 
 
@@ -985,6 +1111,7 @@ BUILDERS = {
     "resnet50": build_resnet50,
     "inception_v3": build_inception_v3,
     "lenet": build_lenet,
+    "mlp_tiny": build_mlp_tiny,
     "resnet32": build_resnet32,
     "vgg16": build_vgg16,
     "alexnet": build_alexnet,
@@ -1009,6 +1136,7 @@ ORDER = [
     "transformer_lm",
     "resnet50",
     "lenet",
+    "mlp_tiny",
     "resnet32",
     "inception_v3",
     "flash_check",
@@ -1346,7 +1474,9 @@ def _orchestrate(args):
         # transformer_lm_long's remat'd T=4096 step is CPU-hopeless; the
         # 224x224 conv models and decode each burn minutes.  Their absence
         # is recorded in config_errors so the line says what was skipped.
-        cpu_fast = ["ptb_lstm", "transformer_lm", "lenet", "resnet32"]
+        cpu_fast = [
+            "ptb_lstm", "transformer_lm", "lenet", "mlp_tiny", "resnet32",
+        ]
         for name in names:
             if name not in cpu_fast:
                 errors[name] = "skipped on CPU fallback (too slow for 2-core host)"

@@ -211,20 +211,108 @@ def make_train_step(
       input-output buffer aliasing fail with ``INVALID_ARGUMENT``.
     """
     if donate is None:
-        import os
-
-        # DTM_DONATE=1/0 overrides the auto-detection — the relay's
-        # INVALID_ARGUMENT on aliasing may get fixed upstream, and a
-        # one-env retry is how we find out without a code change.
-        env = os.environ.get("DTM_DONATE")
-        if env is not None:
-            donate = env != "0"
-        else:
-            donate = jax.default_backend() != "cpu" and not os.environ.get(
-                "PALLAS_AXON_POOL_IPS"
-            )
+        donate = _default_donate()
     step_fn = make_train_step_fn(loss_fn, rng_names)
-    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+    def one_step(state: TrainState, batch: Batch, rng: jax.Array):
+        # Compiled as the K=1 instance of the fused multi-step program —
+        # the exact lax.scan body :func:`make_multi_step` runs.  XLA
+        # optimizes a while-loop body slightly differently from the same
+        # math as straight-line code (measured ~1e-7 param drift per step
+        # on the CPU fake mesh), so sharing the scan form is what makes
+        # ``steps_per_loop ∈ {1, K}`` trajectories bit-identical rather
+        # than merely close (tests/test_train_loop.py pins this; scan
+        # programs of different lengths agree exactly).  The length-1
+        # expand/squeeze is free: layout-only ops inside the jit.
+        chunk = jax.tree.map(lambda x: x[None], batch)
+
+        def body(s, b):
+            return step_fn(s, b, rng)
+
+        new_state, rows = jax.lax.scan(body, state, chunk)
+        return new_state, jax.tree.map(lambda x: x[0], rows)
+
+    return jax.jit(one_step, donate_argnums=(0,) if donate else ())
+
+
+def _default_donate() -> bool:
+    """Donation auto-detection shared by the single-step and fused
+    multi-step builders (see :func:`make_train_step`'s docstring for the
+    two environment carve-outs).  ``DTM_DONATE=1/0`` overrides — the
+    relay's INVALID_ARGUMENT on aliasing may get fixed upstream, and a
+    one-env retry is how we find out without a code change."""
+    import os
+
+    env = os.environ.get("DTM_DONATE")
+    if env is not None:
+        return env != "0"
+    return jax.default_backend() != "cpu" and not os.environ.get(
+        "PALLAS_AXON_POOL_IPS"
+    )
+
+
+def make_multi_step(
+    loss_fn: LossFn,
+    unroll: int = 1,
+    rng_names: Sequence[str] = ("dropout",),
+    donate: bool | None = None,
+) -> Callable[[TrainState, Batch, jax.Array], tuple[TrainState, dict]]:
+    """Fused K-step train program: one dispatch, one device→host metrics
+    transfer per *chunk* of K steps instead of per step.
+
+    ``lax.scan``s the raw step over batches stacked on a new leading axis
+    (``data/pipeline.py::BatchStacker`` assembles them): the returned
+    jitted callable maps ``(state, stacked_batches, rng) ->
+    (state, stacked_metrics)`` where every metrics leaf gains a leading
+    length-K axis — per-step rows, accumulated on device, fetched in one
+    transfer (or lazily, row by row, by the hook layer).
+
+    Trajectory equivalence with K dispatches of :func:`make_train_step` is
+    exact, not approximate, because every per-step dependency threads
+    through the scan carry exactly as it threads through the host loop:
+
+    - **rng**: per-step keys derive from ``fold_in(rng, state.step)`` with
+      the *in-carry* step, so step ``s`` draws identical randomness
+      whichever loop ran it;
+    - **BN/carry**: ``batch_stats`` and the recurrent ``carry`` ride the
+      ``TrainState`` carry, so step ``s+1`` sees step ``s``'s statistics;
+    - **donation**: the chunk program donates the input state into the
+      scan carry (same carve-outs as the single step), so HBM pressure
+      does not grow with K.
+
+    K is a trace-time constant (the stacked leading dim): each distinct
+    chunk length compiles its own program, so drivers should stick to one
+    K plus the few shrunken boundary tails.  ``unroll`` is forwarded to
+    ``lax.scan`` (bigger compiled program, more cross-step overlap for
+    XLA to find; 1 — the default — compiles fastest).
+    """
+    if donate is None:
+        donate = _default_donate()
+    return _jit_multi_step(
+        make_train_step_fn(loss_fn, rng_names), unroll=unroll, donate=donate
+    )
+
+
+def _jit_multi_step(
+    step_fn: Callable,
+    unroll: int = 1,
+    donate: bool | None = None,
+) -> Callable:
+    """Jit ``lax.scan`` of an already-built raw step (the
+    :func:`make_train_step_fn` contract) over stacked batches — the
+    entry point for callers that hold a step fn rather than a loss fn
+    (bench.py's steps_per_loop sweep)."""
+    if donate is None:
+        donate = _default_donate()
+
+    def multi_step_fn(state: TrainState, batches: Batch, rng: jax.Array):
+        def body(s, batch):
+            s, metrics = step_fn(s, batch, rng)
+            return s, metrics
+
+        return jax.lax.scan(body, state, batches, unroll=unroll)
+
+    return jax.jit(multi_step_fn, donate_argnums=(0,) if donate else ())
 
 
 class InstrumentedStep:
@@ -334,6 +422,98 @@ class InstrumentedStep:
         ).record(dt)
         if flops:
             reg.counter(telemetry.FLOPS_TOTAL).inc(flops)
+        return out
+
+
+class InstrumentedMultiStep(InstrumentedStep):
+    """Chunk-aware :class:`InstrumentedStep` for the fused multi-step
+    program: ``__call__(state, stacked_batches, rng)`` where the stacked
+    leading axis is the chunk length K.
+
+    Telemetry stays comparable across ``steps_per_loop`` values:
+
+    - **FLOPs per chunk = K × the per-step signature cost.**  XLA cost
+      analysis visits a scan/while body ONCE, ignoring the trip count
+      (bench.py's empirically verified trap), so analysing the chunk
+      program would under-count by exactly K.  Instead the per-step cost
+      comes from a trace-only lowering of the raw single step
+      (``flops_step_fn``) on one unstacked batch row, and the
+      ``train/flops_total`` counter advances by K× that per executed
+      chunk — so MFU readers see the same numerator either loop produces.
+    - **Dispatch/compile**: one ``train/dispatch`` (or ``train/compile``)
+      record per chunk — the per-chunk host cost IS the quantity the
+      fused loop exists to amortise, so it is recorded raw; per-step
+      comparisons divide by K (TelemetryHook's ``dispatch_s`` reads
+      per-chunk under K>1, documented in README "Performance").
+
+    ``train/step_time`` (chunk wall ÷ K) is recorded by the driver, which
+    owns the full-iteration clock.
+    """
+
+    def __init__(
+        self,
+        multi_fn: Callable,
+        flops_step_fn: Optional[Callable] = None,
+        registry: Optional[telemetry.MetricsRegistry] = None,
+    ):
+        super().__init__(multi_fn, registry)
+        self._flops_fn = (
+            jax.jit(flops_step_fn) if flops_step_fn is not None else None
+        )
+
+    def _record_flops(self, state, batches, rng) -> float:
+        """Per-STEP FLOPs from the raw single step on batch row 0 (one
+        device gather per new signature; trace-only lowering after that).
+        Best-effort, like the parent."""
+        if self._flops_fn is None:
+            return 0.0
+        flops = 0.0
+        try:
+            row = jax.tree.map(lambda x: x[0], batches)
+            cost = self._flops_fn.lower(state, row, rng).cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            flops = max(float(cost["flops"]), 0.0)
+        except Exception as e:  # noqa: BLE001 — per-platform availability
+            log.debug("multi-step FLOPs unavailable: %s", e)
+        if flops > 0:
+            self.flops_per_step = flops
+            self._registry.gauge(telemetry.FLOPS_PER_STEP).set(flops)
+        return flops
+
+    def __call__(self, state, batches, rng):
+        reg = self._registry
+        k = jax.tree_util.tree_leaves(batches)[0].shape[0]
+        sig = self._signature(batches)
+        flops = self._flops_by_sig.get(sig)
+        if flops is None:
+            # New signature == new chunk length or batch shape; each
+            # compiles its own scan program.  The driver keeps the set
+            # small (one main K plus boundary tails), so tolerate a few
+            # before raising the parent's recompile-storm diagnostic —
+            # a shape-unstable dataset must still be surfaced.
+            if len(self._flops_by_sig) >= 3:
+                log.warning(
+                    "fused train step saw a new chunk signature %s "
+                    "(%d prior — expected one main K plus a few "
+                    "boundary tails); recompile storms show up as a "
+                    "growing compile count in telemetry",
+                    sig,
+                    len(self._flops_by_sig),
+                )
+            flops = self._flops_by_sig[sig] = self._record_flops(
+                state, batches, rng
+            )
+        before = self._cache_size()
+        t0 = time.perf_counter()
+        out = self._fn(state, batches, rng)
+        dt = time.perf_counter() - t0
+        compiled = before is not None and self._cache_size() != before
+        reg.timer(
+            telemetry.COMPILE if compiled else telemetry.DISPATCH
+        ).record(dt)
+        if flops:
+            reg.counter(telemetry.FLOPS_TOTAL).inc(flops * k)
         return out
 
 

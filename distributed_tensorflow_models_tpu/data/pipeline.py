@@ -210,3 +210,99 @@ class DevicePrefetcher:
     def get_state(self) -> Optional[dict]:
         """Producer state as of the last batch the consumer received."""
         return self._state
+
+
+class BatchStacker:
+    """Assemble K consecutive batches into one stacked chunk for the fused
+    multi-step train program (``core/train_loop.py::make_multi_step``).
+
+    Sits after :class:`DevicePrefetcher` (sharded device batches in, one
+    stacked chunk out): :meth:`next_chunk` pulls up to ``k`` batches and
+    stacks every leaf on a new leading axis laid out ``P(None, <original
+    spec>)`` — replicated across the chunk axis, unchanged within a row —
+    which is exactly the layout ``lax.scan`` slices back into per-step
+    batches with zero resharding.  A non-sharded (host numpy) upstream
+    stacks plainly, so the stage is also usable host-side.
+
+    Checkpointing: :meth:`get_state` returns the producer state of the
+    *last* batch of the last chunk handed out, so a checkpoint taken at a
+    chunk boundary resumes at exactly the next unconsumed batch — the
+    same resume-exact contract as the per-batch stages above.
+
+    Ragged tail: when the upstream ends mid-chunk, the partial chunk
+    (length < k) is returned rather than dropped; the following call
+    raises ``StopIteration``.
+    """
+
+    def __init__(self, iterator):
+        self._it = iter(iterator)
+        self._source = iterator
+        self._state: Optional[dict] = (
+            iterator.get_state() if hasattr(iterator, "get_state") else None
+        )
+        self._exhausted = False
+        # jitted stack fns keyed by (chunk len, leaf signature): the jit
+        # wrapper carries explicit out_shardings, so it must be built once
+        # per shape class, not once per call (a per-call lambda would
+        # recompile every chunk).
+        self._stack_cache: dict = {}
+
+    def next_chunk(self, k: int):
+        """Return ``(stacked_chunk, n)`` with ``n = min(k, batches left)``
+        rows; raises ``StopIteration`` once the upstream is exhausted."""
+        if self._exhausted:
+            raise StopIteration
+        rows = []
+        for _ in range(max(1, int(k))):
+            try:
+                rows.append(next(self._it))
+            except StopIteration:
+                self._exhausted = True
+                break
+        if not rows:
+            raise StopIteration
+        if hasattr(self._source, "get_state"):
+            self._state = self._source.get_state()
+        return self._stack(rows), len(rows)
+
+    def _stack(self, rows):
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(rows[0])
+        sig = (
+            len(rows),
+            treedef,
+            tuple((leaf.shape, str(leaf.dtype)) for leaf in leaves),
+        )
+        fn = self._stack_cache.get(sig)
+        if fn is None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def target(leaf):
+                sh = getattr(leaf, "sharding", None)
+                if isinstance(sh, NamedSharding):
+                    return NamedSharding(
+                        sh.mesh, PartitionSpec(None, *tuple(sh.spec))
+                    )
+                return None
+
+            shardings = [target(leaf) for leaf in leaves]
+
+            def stack(*rs):
+                return jax.tree.map(lambda *xs: jnp.stack(xs), *rs)
+
+            if all(s is not None for s in shardings):
+                out_shardings = jax.tree_util.tree_unflatten(
+                    treedef, shardings
+                )
+                fn = jax.jit(stack, out_shardings=out_shardings)
+            else:
+                # Host numpy / single-device upstream: plain stack.
+                fn = stack
+            self._stack_cache[sig] = fn
+        return fn(*rows)
+
+    def get_state(self) -> Optional[dict]:
+        """Producer state as of the last batch in the last chunk."""
+        return self._state

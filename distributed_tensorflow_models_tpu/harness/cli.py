@@ -29,6 +29,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument(
+        "--steps-per-loop", type=int, default=None,
+        help="fused multi-step dispatch: train steps per jitted call "
+        "(lax.scan over stacked batches; 1 = per-step dispatch).  Raise "
+        "for small models where host dispatch, not the chip, bounds step "
+        "rate — trajectory and hook cadences are unchanged (README "
+        "'Performance')",
+    )
+    p.add_argument(
         "--mesh-model", type=int, default=None,
         help="tensor-parallel axis size (default 1)",
     )
@@ -76,6 +84,8 @@ def _overrides(args) -> dict:
         out["global_batch_size"] = args.batch_size
     if args.seed is not None:
         out["seed"] = args.seed
+    if getattr(args, "steps_per_loop", None) is not None:
+        out["steps_per_loop"] = args.steps_per_loop
     for attr, key in (
         ("mesh_model", "mesh_model"),
         ("mesh_seq", "mesh_seq"),

@@ -110,6 +110,17 @@ class ExperimentConfig:
     # Loop control (reference cadences: summaries/logs every 100 steps,
     # checkpoint every 600 s — TF monitored_session.py:517-532).
     train_steps: int = 1000
+    # Fused multi-step dispatch: lax.scan the train step over this many
+    # stacked batches per jitted call (core/train_loop.py::make_multi_step)
+    # — one host dispatch + one metrics transfer per chunk instead of per
+    # step.  1 = today's per-step loop.  Raise it for small/fast models
+    # where host dispatch + hook overhead, not the chip, bounds step rate
+    # (telemetry's dispatch_s vs step_time_s split is the diagnostic —
+    # README "Performance").  Chunks auto-shrink to end exactly at
+    # log_every_steps boundaries and train_steps, so every hook fires at
+    # precisely the same steps as the unfused loop; trajectories are
+    # bit-identical either way (tests/test_train_loop.py pins this).
+    steps_per_loop: int = 1
     log_every_steps: int = 100
     checkpoint_every_secs: float = 600.0
     keep_checkpoints: int = 5

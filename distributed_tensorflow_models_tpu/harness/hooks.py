@@ -19,7 +19,8 @@ import json
 import logging
 import os
 import time
-from typing import Any, Mapping, Optional, Sequence
+from collections.abc import MutableMapping
+from typing import Any, Iterator, Mapping, Optional, Sequence
 
 import jax
 import numpy as np
@@ -34,6 +35,20 @@ Metrics = Mapping[str, Any]
 class Hook:
     def begin(self, state) -> None: ...
 
+    def wants_step(self, step: int) -> bool:
+        """Does this hook need :meth:`after_step` called at ``step``?
+
+        The fused multi-step loop (``fit`` with ``steps_per_loop > 1``)
+        consults this to skip whole hook walks on steps where no hook
+        would act — the host-overhead amortisation the fused dispatch
+        exists for.  Returning ``True`` is always safe (the unfused loop
+        never asks); the default keeps per-step semantics for arbitrary
+        user hooks.  Must be cheap, side-effect-free, and — for hooks
+        whose ``after_step`` performs a multi-host collective —
+        deterministic in ``step`` so every process walks the same rows.
+        """
+        return True
+
     def after_step(self, state, metrics: Metrics, step: int) -> None: ...
 
     def end(self, state) -> None: ...
@@ -47,6 +62,65 @@ class Hook:
         self.end(state)
 
 
+class LazyMetricRow(MutableMapping):
+    """One step's lazy view into a fused chunk's stacked on-device metrics.
+
+    The fused multi-step program returns every metric as a ``[K]``-stacked
+    device array; materialising K host dicts per chunk would reintroduce
+    the per-step host cost the fusion removed.  This row adapter indexes a
+    leaf only when a hook actually reads the key (the result is still a
+    device scalar — only ``float()`` forces the device→host sync), so
+    hooks that fire every N steps never sync the other N−1 rows.
+
+    Writes (``TelemetryHook``'s derived-scalar injection) land in a
+    host-side overlay that shadows the stacked leaves — the same
+    dict-update contract the writer hooks rely on.
+
+    Chunk-aware consumers (``NanGuardHook``) can reach the whole chunk via
+    :meth:`stacked` plus :attr:`chunk_start_step`/:attr:`index` to
+    attribute a mid-chunk event to its exact step.
+    """
+
+    def __init__(self, stacked: Mapping, index: int, chunk_start_step: int):
+        self._stacked = stacked
+        self._index = index
+        self._start = chunk_start_step  # global step of row 0
+        self._overlay: dict = {}
+
+    @property
+    def index(self) -> int:
+        return self._index
+
+    @property
+    def chunk_start_step(self) -> int:
+        return self._start
+
+    def stacked(self, key: str):
+        """The full ``[K]`` device array behind ``key`` (raises KeyError
+        for overlay-only keys, which have no per-step history)."""
+        return self._stacked[key]
+
+    def __getitem__(self, key):
+        if key in self._overlay:
+            return self._overlay[key]
+        return self._stacked[key][self._index]
+
+    def __setitem__(self, key, value):
+        self._overlay[key] = value
+
+    def __delitem__(self, key):
+        del self._overlay[key]
+
+    def __iter__(self) -> Iterator[str]:
+        yield from self._stacked
+        for k in self._overlay:
+            if k not in self._stacked:
+                yield k
+
+    def __len__(self) -> int:
+        return len(set(self._stacked) | set(self._overlay))
+
+
 class StopRequested(Exception):
     """Raised by hooks to end training (StopAtStepHook's mechanism)."""
 
@@ -56,6 +130,9 @@ class StopAtStepHook(Hook):
 
     def __init__(self, last_step: int):
         self._last = last_step
+
+    def wants_step(self, step):
+        return step >= self._last
 
     def after_step(self, state, metrics, step):
         if step >= self._last:
@@ -76,6 +153,9 @@ class StepCounterHook(Hook):
     def begin(self, state):
         self._t0 = time.perf_counter()
         self._s0 = int(state.step)
+
+    def wants_step(self, step):
+        return step % self._every == 0
 
     def after_step(self, state, metrics, step):
         if step % self._every:
@@ -102,8 +182,27 @@ class NanGuardHook(Hook):
         self._every = every_steps
         self._key = key
 
+    def wants_step(self, step):
+        return step % self._every == 0
+
     def after_step(self, state, metrics, step):
         if step % self._every:
+            return
+        if isinstance(metrics, LazyMetricRow):
+            # Fused-chunk row: check EVERY row of the chunk up to this one
+            # (one [K]-array readback — same sync cost as the scalar) so a
+            # mid-chunk NaN is caught at the boundary walk and attributed
+            # to its exact step, not the chunk end.
+            arr = np.asarray(metrics.stacked(self._key))[
+                : metrics.index + 1
+            ]
+            bad = ~np.isfinite(arr)
+            if bad.any():
+                i = int(np.argmax(bad))
+                raise FloatingPointError(
+                    f"{self._key} is {arr[i]} at step "
+                    f"{metrics.chunk_start_step + i}"
+                )
             return
         value = float(metrics[self._key])
         if not np.isfinite(value):
@@ -118,6 +217,9 @@ class LoggingHook(Hook):
     def __init__(self, every_steps: int = 100, keys: Optional[Sequence[str]] = None):
         self._every = every_steps
         self._keys = keys
+
+    def wants_step(self, step):
+        return step % self._every == 0
 
     def after_step(self, state, metrics, step):
         if step % self._every:
@@ -164,6 +266,9 @@ class MetricWriterHook(Hook):
             self._f = open(self._path, "a", buffering=1)
         self._f.write(json.dumps(row) + "\n")
 
+    def wants_step(self, step):
+        return step % self._every == 0
+
     def after_step(self, state, metrics, step):
         if step % self._every:
             return
@@ -200,6 +305,9 @@ class TensorBoardHook(Hook):
                 os.path.join(workdir, "tensorboard")
             )
         self._every = every_steps
+
+    def wants_step(self, step):
+        return self._writer is not None and step % self._every == 0
 
     def after_step(self, state, metrics, step):
         if self._writer is None or step % self._every:
@@ -270,6 +378,12 @@ class TelemetryHook(Hook):
             time.perf_counter(), int(state.step), self._reg.snapshot()
         )
 
+    def wants_step(self, step):
+        # Deterministic in step — required: the multi-host branch of
+        # after_step is a collective, so every process must walk the
+        # same rows under the fused loop's wants_step gating.
+        return step % self._every == 0
+
     def after_step(self, state, metrics, step):
         if step % self._every:
             return
@@ -325,7 +439,10 @@ class TelemetryHook(Hook):
             out["hosts/steps_per_sec_mean"] = float(gathered[:, 0].mean())
             out["hosts/stall_fraction_max"] = float(gathered[:, 1].max())
         self.last_emitted = out
-        if isinstance(metrics, dict):
+        if isinstance(metrics, MutableMapping):
+            # dict in the unfused loop, LazyMetricRow (overlay write) in
+            # the fused loop — both take the injection for the writer
+            # hooks downstream.
             metrics.update(out)
 
 
@@ -374,6 +491,18 @@ class CheckpointHook(Hook):
             )
         )
 
+    def wants_step(self, step):
+        # Step triggers and the multi-host poll cadence are deterministic
+        # in step (required — the poll broadcast is a collective); the
+        # single-process clock check is local, so reading it here is safe.
+        if self._every_steps and step % self._every_steps == 0:
+            return True
+        if self._every_secs is None:
+            return False
+        if self._multiproc:
+            return step % self._poll == 0
+        return time.time() - self._last_time >= self._every_secs
+
     def after_step(self, state, metrics, step):
         due_step = self._every_steps and step % self._every_steps == 0
         if due_step or self._time_due(step):
@@ -413,6 +542,9 @@ class FaultInjectionHook(Hook):
             lambda: RuntimeError("injected preemption")
         )
 
+    def wants_step(self, step):
+        return step == self._step and not self._fired
+
     def after_step(self, state, metrics, step):
         if step == self._step and not self._fired:
             self._fired = True
@@ -429,6 +561,11 @@ class ProfilerHook(Hook):
         self._start = start_step
         self._stop = stop_step
         self._active = False
+
+    def wants_step(self, step):
+        return (not self._active and step == self._start) or (
+            self._active and step >= self._stop
+        )
 
     def after_step(self, state, metrics, step):
         if step == self._start and not self._active:
@@ -455,4 +592,59 @@ def run_hooks_after_step(hooks: Sequence[Hook], state, metrics, step) -> bool:
             h.after_step(state, metrics, step)
         except StopRequested:
             stop = True
+    return not stop
+
+
+def run_hooks_after_chunk(
+    hooks: Sequence[Hook],
+    state,
+    stacked_metrics: Mapping,
+    start_step: int,
+    length: int,
+    registry: Optional[telemetry.MetricsRegistry] = None,
+    final_row: Optional[LazyMetricRow] = None,
+) -> bool:
+    """Walk hooks for the ``length`` steps of one fused chunk, skipping
+    every step no hook wants (:meth:`Hook.wants_step`) — the K−1 quiet
+    steps cost one predicate sweep each, no metric sync, no hook walk.
+
+    The chunk covers steps ``start_step+1 .. start_step+length``; each
+    walked step gets a :class:`LazyMetricRow` over ``stacked_metrics``
+    (row i ↔ step ``start_step+1+i``).  ``state`` is the end-of-chunk
+    state — the only one the fused program materialises; hooks that save
+    it (CheckpointHook) therefore always persist chunk-boundary state,
+    consistent with the data-position contract of
+    ``data/pipeline.py::BatchStacker.get_state``.
+
+    Full walks are counted into ``registry``'s ``train/hook_walks``
+    (the micro-guard's numerator).  Per-walk semantics match
+    :func:`run_hooks_after_step`: every hook runs, StopRequested defers
+    to the end of the walk, and remaining walked steps still run so the
+    stop step's metrics reach the writers.
+
+    ``final_row``, when given, is used as the last row's metrics object
+    instead of a fresh :class:`LazyMetricRow`, so overlay writes
+    (TelemetryHook's injected scalars) are visible to the caller —
+    ``fit`` passes the row it returns as ``FitResult.final_metrics``.
+    """
+    stop = False
+    for i in range(length):
+        step = start_step + 1 + i
+        if not any(h.wants_step(step) for h in hooks):
+            continue
+        if registry is not None:
+            registry.counter(telemetry.HOOK_WALKS).inc()
+        if i == length - 1 and final_row is not None:
+            row = final_row
+        else:
+            row = LazyMetricRow(stacked_metrics, i, start_step + 1)
+        for h in hooks:
+            try:
+                h.after_step(state, row, step)
+            except StopRequested:
+                stop = True
+        if stop:
+            # Mirror the unfused loop: nothing fires after the stop step
+            # (its own walk completed — writers got the final metrics).
+            break
     return not stop

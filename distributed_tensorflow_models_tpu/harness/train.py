@@ -257,17 +257,66 @@ def build_lm_loss(cfg: ExperimentConfig, apply_fn):
     return train_loop.lm_loss_fn(apply_fn, fused_unembed=cfg.fused_unembed)
 
 
-def build_step(cfg: ExperimentConfig, state: TrainState):
+def build_loss(cfg: ExperimentConfig, state: TrainState):
+    """The one place a config becomes a loss fn (shared by the single-step
+    and fused multi-step builders so they can never diverge)."""
     if cfg.task == "lm":
-        loss_fn = build_lm_loss(cfg, state.apply_fn)
-    else:
-        loss_fn = train_loop.classification_loss_fn(
-            state.apply_fn,
-            label_smoothing=cfg.label_smoothing,
-            weight_decay=cfg.weight_decay,
-            aux_loss_weight=cfg.aux_loss_weight,
-        )
-    return train_loop.make_train_step(loss_fn)
+        return build_lm_loss(cfg, state.apply_fn)
+    return train_loop.classification_loss_fn(
+        state.apply_fn,
+        label_smoothing=cfg.label_smoothing,
+        weight_decay=cfg.weight_decay,
+        aux_loss_weight=cfg.aux_loss_weight,
+    )
+
+
+def build_step(cfg: ExperimentConfig, state: TrainState):
+    return train_loop.make_train_step(build_loss(cfg, state))
+
+
+def build_multi_step(cfg: ExperimentConfig, state: TrainState):
+    """(fused K-step program, raw single step) for ``steps_per_loop > 1``.
+    The raw step rides along for telemetry: per-step FLOPs must come from
+    a single-step lowering (cost analysis sees a scan body once —
+    InstrumentedMultiStep's docstring)."""
+    loss_fn = build_loss(cfg, state)
+    return (
+        train_loop.make_multi_step(loss_fn),
+        train_loop.make_train_step_fn(loss_fn),
+    )
+
+
+def _chunk_len(
+    step: int, cfg: ExperimentConfig, hooks: Sequence[hooklib.Hook] = ()
+) -> int:
+    """Length of the next fused chunk starting after ``step``: up to
+    ``cfg.steps_per_loop``, shrunk so the chunk ends exactly at (a) the
+    next ``log_every_steps`` boundary, (b) ``train_steps``, and (c) the
+    FIRST step any hook ``wants_step`` — a chunk is one atomic device
+    program, so the only way a hook can observe the exact state of the
+    step it fires at (an early StopAtStepHook in ``extra_hooks``, a
+    fault injection, a profiler window edge, a due checkpoint clock) is
+    for the chunk to end there.  Every hook therefore fires at precisely
+    the same steps, with the same state, as the unfused loop.  The cost
+    model follows: hooks that keep the conservative per-step default
+    ``wants_step`` degrade the loop to per-step dispatch — cadence-aware
+    hooks (all built-ins) are what buy fusion.
+
+    Multi-host note: the chunk length feeds the compiled scan program,
+    so it must be identical on every process — ``wants_step`` of every
+    hook present on more than one process is deterministic in ``step``
+    (the chief-only writer hooks share the cadence the every-process
+    TelemetryHook/NanGuardHook probe anyway), and ``extra_hooks`` that
+    exist on a subset of processes must gate on step-deterministic
+    cadences or the processes' programs desync."""
+    k = min(cfg.steps_per_loop, cfg.train_steps - step)
+    if cfg.log_every_steps and cfg.log_every_steps > 0:
+        k = min(k, cfg.log_every_steps - step % cfg.log_every_steps)
+    k = max(k, 1)
+    for i in range(1, k):
+        if any(h.wants_step(step + i) for h in hooks):
+            return i
+    return k
 
 
 @dataclasses.dataclass
@@ -286,6 +335,15 @@ def fit(
 ) -> FitResult:
     """Train ``cfg`` to ``cfg.train_steps``, resuming from ``workdir`` if a
     checkpoint exists.  Returns the final (host-fetched) state.
+
+    With ``cfg.steps_per_loop > 1`` the loop drives *fused chunks*: K
+    stacked batches per jitted ``lax.scan`` dispatch
+    (``core/train_loop.py::make_multi_step``), per-step metric rows
+    accumulated on device and handed to hooks lazily
+    (``hooks.run_hooks_after_chunk`` — quiet steps are never walked and
+    never force a device sync).  Chunks shrink to end exactly at
+    ``log_every_steps`` boundaries and ``train_steps``, so hook cadences
+    and the training trajectory are identical to the unfused loop.
 
     Telemetry: the run owns a fresh ``MetricsRegistry`` threaded through
     the pipeline, the instrumented step, the checkpoint manager, and a
@@ -328,15 +386,31 @@ def fit(
     device_it = pipelib.DevicePrefetcher(
         host, mesh, depth=2, seq_dim=seq_dim, registry=registry
     )
-    step_fn = train_loop.InstrumentedStep(
-        build_step(cfg, state), registry=registry
-    )
+    steps_per_loop = max(1, int(cfg.steps_per_loop))
+    if steps_per_loop > 1:
+        # Fused multi-step dispatch: stack K sharded batches per chunk and
+        # run them through one jitted lax.scan program — one dispatch, one
+        # hook-gated walk set, one metrics transfer per chunk.
+        stacker = pipelib.BatchStacker(device_it)
+        data_src = stacker
+        multi_fn, raw_step = build_multi_step(cfg, state)
+        step_fn = train_loop.InstrumentedMultiStep(
+            multi_fn, raw_step, registry=registry
+        )
+    else:
+        stacker = None
+        data_src = device_it
+        step_fn = train_loop.InstrumentedStep(
+            build_step(cfg, state), registry=registry
+        )
 
     def save_fn(s, _step):
-        # Use the *device prefetcher's* view of the dataset position — it
-        # lags the host pipeline by the prefetch depth and reflects exactly
-        # the batches the train loop has consumed, so resume never skips.
-        manager.save(s, {"dataset": device_it.get_state()})
+        # Use the consuming stage's view of the dataset position — the
+        # device prefetcher (or, chunked, the batch stacker in front of
+        # it) lags the host pipeline by the prefetch depth and reflects
+        # exactly the batches the train loop has consumed, so resume
+        # never skips.
+        manager.save(s, {"dataset": data_src.get_state()})
 
     # Writer hooks run on process 0 only (the reference's chief-writes-
     # summaries convention, TF monitored_session.py:566-609); the NaN guard
@@ -381,16 +455,50 @@ def fit(
     try:
         while step < cfg.train_steps:
             t_iter = time.perf_counter()
-            with registry.span(telemetry.DATA_WAIT):
-                batch = next(device_it)
-            state, metrics = step_fn(state, batch, rng)
-            registry.timer(telemetry.STEP_TIME).record(
-                time.perf_counter() - t_iter
-            )
-            step += 1
-            steps_run += 1
-            if not hooklib.run_hooks_after_step(all_hooks, state, metrics, step):
-                break
+            if stacker is None:
+                with registry.span(telemetry.DATA_WAIT):
+                    batch = next(device_it)
+                state, metrics = step_fn(state, batch, rng)
+                registry.timer(telemetry.STEP_TIME).record(
+                    time.perf_counter() - t_iter
+                )
+                step += 1
+                steps_run += 1
+                registry.counter(telemetry.HOOK_WALKS).inc()
+                if not hooklib.run_hooks_after_step(
+                    all_hooks, state, metrics, step
+                ):
+                    break
+            else:
+                with registry.span(telemetry.DATA_WAIT):
+                    chunk, k = stacker.next_chunk(
+                        _chunk_len(step, cfg, all_hooks)
+                    )
+                state, rows = step_fn(state, chunk, rng)
+                # Chunk wall ÷ K, recorded once per STEP (k records): the
+                # timer's count stays the step count and its total the
+                # loop wall, so TelemetryHook's per-record mean is not
+                # chunk-weighted when chunk lengths mix (a K=8 chunk and
+                # its K=2 boundary tail would otherwise average 50/50)
+                # and step_time_s stays comparable across steps_per_loop
+                # values.  k sub-µs records per chunk — off the hot path.
+                per_step = (time.perf_counter() - t_iter) / k
+                step_timer = registry.timer(telemetry.STEP_TIME)
+                for _ in range(k):
+                    step_timer.record(per_step)
+                start = step
+                step += k
+                steps_run += k
+                # The latest metrics row, lazily — FitResult materialises
+                # it only at return.  Passed as final_row so TelemetryHook's
+                # injected scalars land on THIS object when the last row is
+                # walked (final_metrics parity with the unfused loop).
+                metrics = hooklib.LazyMetricRow(rows, k - 1, start + 1)
+                if not hooklib.run_hooks_after_chunk(
+                    all_hooks, state, rows, start, k,
+                    registry=registry, final_row=metrics,
+                ):
+                    break
     except BaseException:
         # Already failing: run abort hooks best-effort (single-process, the
         # CheckpointHook crash-save preserves progress when storage still

@@ -26,9 +26,12 @@ Structure:
   strided-slice/pad/add (relay-safe).
 - 1x1 convs skip Pallas entirely: after decimation they ARE a single
   ``dot_general`` (the patches 1x1 path, which has no blow-up).
-- tiny input channels (the RGB stem) fall back to ``patches``: with
-  Cin < 16 the MXU contraction is lane-starved either way and the im2col
-  concat is what lifts K to kh*kw*Cin.
+- low-utilization input channels fall back to ``patches``: the kernel's
+  explicit cin→128 lane pad makes the MXU contraction pay
+  ``ceil(cin/128)·128/cin``× zero-column MACs, so routing is by estimated
+  lane utilization (``_use_mxu_kernel``; < 50% → patches, whose im2col
+  concat lifts K to kh*kw*Cin with one pad for the whole concat) — the
+  RGB stem and every cin < 64 class route to patches.
 - ``custom_vjp``: dx re-enters the same kernel on the (kh-1,kw-1)-padded
   cotangent with the spatially-rotated, IO-transposed kernel; dw is kh*kw
   plain window-slice dots (weight-sized outputs — no large intermediate).
@@ -53,9 +56,17 @@ from .conv import _explicit_padding, conv2d_patches
 
 Padding = Union[str, Sequence[tuple[int, int]]]
 
-# Below this Cin the kernel's K dimension is lane-starved and im2col's
-# K = kh*kw*Cin concat is the better MXU shape (the 7x7 RGB stem).
-_MIN_CIN = 16
+# Minimum useful-lane fraction for the Pallas route.  The kernel's
+# explicit cin→128 lane padding (_core_fwd_impl) means the MXU contraction
+# always runs at ceil(cin/128)*128 lanes: at cin=16 that is 8× zero-column
+# MACs, at cin=64 exactly 2×.  The im2col path pays no such per-tap waste
+# (its K dim is kh*kw*cin, one lane pad for the whole concat) but blows up
+# HBM traffic kh*kw-fold, so the Pallas route stays the winner down to 50%
+# utilization and loses below it — route on the estimated waste ratio, not
+# a bare cin threshold (round-5 advisor: the old _MIN_CIN=16 floor sent
+# 16 ≤ cin < 64 classes to the kernel at up to 8× wasted MACs).
+_MXU_MIN_LANE_UTIL = 0.5
+_LANES = 128
 # VMEM budget for the manually-DMA'd input slab (bytes).  Conservative:
 # the auto-pipelined kernel/output blocks and the f32 accumulator share
 # the ~16 MiB VMEM with it.
@@ -421,6 +432,28 @@ def _on_tpu() -> bool:
         return False
 
 
+def _mxu_lane_utilization(cin: int) -> float:
+    """Fraction of MXU lanes doing useful work after the kernel's cin→128
+    pad: ``cin / (ceil(cin/128)*128)``.  1.0 at lane multiples; 0.5 at
+    cin=64; 0.125 at cin=16."""
+    return cin / (-(-cin // _LANES) * _LANES)
+
+
+def _use_mxu_kernel(kh: int, kw: int, cin: int) -> bool:
+    """Padding-aware Pallas-vs-patches routing.
+
+    1×1 convs are a bare dot in the patches path (no im2col blow-up
+    exists, nothing for the kernel to win).  Otherwise route to the
+    Pallas kernel only when its post-pad lane utilization clears
+    ``_MXU_MIN_LANE_UTIL`` — below that the zero-column MACs the cin→128
+    pad buys exceed what the halo-slab scheme saves over im2col's
+    kh·kw-fold HBM blow-up.
+    """
+    if kh == kw == 1:
+        return False
+    return _mxu_lane_utilization(cin) >= _MXU_MIN_LANE_UTIL
+
+
 def conv2d_mxu(x, kernel, strides=(1, 1), padding: Padding = "SAME",
                interpret: Optional[bool] = None):
     """``lax.conv_general_dilated`` (NHWC, HWIO) semantics on the Pallas
@@ -434,9 +467,11 @@ def conv2d_mxu(x, kernel, strides=(1, 1), padding: Padding = "SAME",
         raise ValueError(
             f"input channels {x.shape[-1]} != kernel input channels {cin}"
         )
-    if kh == kw == 1 or cin < _MIN_CIN:
+    if not _use_mxu_kernel(kh, kw, cin):
         # 1x1 is already a bare dot in the patches path (no im2col
-        # blow-up exists); tiny Cin wants the im2col K-dim lift.
+        # blow-up exists); low-utilization Cin (the cin→128 lane pad's
+        # zero-column MACs) wants the im2col K-dim lift — see
+        # _use_mxu_kernel.
         return conv2d_patches(x, kernel, strides, padding)
     (ph0, ph1), (pw0, pw1) = _explicit_padding(
         padding, kh, kw, sh, sw, x.shape[1], x.shape[2]

@@ -32,6 +32,7 @@ from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
     DISPATCH,
     FLOPS_PER_STEP,
     FLOPS_TOTAL,
+    HOOK_WALKS,
     HOST_QUEUE_DEPTH,
     PREFETCH_DEPTH,
     PREFETCH_FILL,

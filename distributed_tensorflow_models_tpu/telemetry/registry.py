@@ -33,6 +33,11 @@ from typing import Iterator
 DATA_WAIT = "train/data_wait"  # timer: loop blocked in next(batch)
 DISPATCH = "train/dispatch"  # timer: step-fn call (async dispatch)
 STEP_TIME = "train/step_time"  # timer: full iteration wall time
+# Counter: full hook traversals.  The unfused loop walks once per step;
+# the fused loop walks only steps some hook wants (Hook.wants_step), so
+# walks/steps is the direct measure of the host overhead steps_per_loop
+# amortises (tier-1 micro-guard asserts the ≥K-fold drop).
+HOOK_WALKS = "train/hook_walks"
 COMPILE = "train/compile"  # timer: one record per XLA compile event
 FLOPS_PER_STEP = "train/flops_per_step"  # gauge: XLA cost-analysis FLOPs
 FLOPS_TOTAL = "train/flops_total"  # counter: FLOPs retired across all steps

@@ -33,21 +33,26 @@ def _rand(rng, *shape):
     return jnp.asarray(rng.randn(*shape), jnp.float32)
 
 
+# Kernel-routed classes use cin >= 64: the padding-aware router
+# (_use_mxu_kernel) sends lower-utilization channel counts to patches,
+# so sub-64 cin here would silently test the fallback instead of the
+# Pallas kernel.  The two *_fallback cases pin the fallback routing.
 CASES = [
     # (x shape, kernel shape, strides, padding, id)
-    ((2, 16, 16, 32), (3, 3, 32, 48), (1, 1), "SAME", "3x3_s1_same"),
-    ((2, 17, 15, 32), (3, 3, 32, 48), (2, 2), "SAME", "3x3_s2_odd"),
-    ((2, 16, 16, 32), (5, 5, 32, 16), (1, 1), "VALID", "5x5_valid"),
-    ((2, 16, 16, 32), (1, 1, 32, 64), (2, 2), "SAME", "1x1_s2"),
+    ((2, 16, 16, 64), (3, 3, 64, 48), (1, 1), "SAME", "3x3_s1_same"),
+    ((2, 17, 15, 64), (3, 3, 64, 48), (2, 2), "SAME", "3x3_s2_odd"),
+    ((2, 16, 16, 64), (5, 5, 64, 16), (1, 1), "VALID", "5x5_valid"),
+    ((2, 16, 16, 64), (1, 1, 64, 64), (2, 2), "SAME", "1x1_s2"),
     ((2, 24, 24, 3), (7, 7, 3, 32), (2, 2), "SAME", "rgb_stem_fallback"),
+    ((2, 16, 16, 32), (3, 3, 32, 48), (1, 1), "SAME", "low_cin_fallback"),
     ((4, 8, 8, 64), (3, 3, 64, 512), (1, 1), "SAME", "cout_tiled"),
     ((8, 7, 7, 64), (3, 3, 64, 96), (1, 1), "SAME", "batch_folded"),
     ((1, 14, 14, 128), (3, 3, 128, 128), (2, 2), "SAME", "3x3_s2_deep"),
-    ((2, 9, 9, 32), (3, 3, 32, 32), (3, 3), "SAME", "stride3"),
-    ((2, 12, 12, 32), (2, 2, 32, 32), (2, 2), "VALID", "2x2_s2_valid"),
-    ((2, 11, 11, 32), (4, 4, 32, 32), (1, 1), "SAME", "even_kernel_same"),
-    ((2, 16, 16, 32), (3, 3, 32, 48), (1, 2), "SAME", "aniso_stride"),
-    ((2, 16, 16, 32), (3, 3, 32, 48), (1, 1),
+    ((2, 9, 9, 64), (3, 3, 64, 32), (3, 3), "SAME", "stride3"),
+    ((2, 12, 12, 64), (2, 2, 64, 32), (2, 2), "VALID", "2x2_s2_valid"),
+    ((2, 11, 11, 64), (4, 4, 64, 32), (1, 1), "SAME", "even_kernel_same"),
+    ((2, 16, 16, 64), (3, 3, 64, 48), (1, 2), "SAME", "aniso_stride"),
+    ((2, 16, 16, 64), (3, 3, 64, 48), (1, 1),
      ((2, 2), (0, 1)), "explicit_pad"),
 ]
 
@@ -88,8 +93,8 @@ def test_forward_matches_lax_conv(xshape, kshape, strides, padding):
 @pytest.mark.parametrize("strides", [(1, 1), (2, 2)], ids=["s1", "s2"])
 def test_grads_match_lax_conv(strides):
     rng = np.random.RandomState(1)
-    x = _rand(rng, 2, 10, 10, 32)
-    k = _rand(rng, 3, 3, 32, 48) * 0.1
+    x = _rand(rng, 2, 10, 10, 64)
+    k = _rand(rng, 3, 3, 64, 48) * 0.1
 
     # A nonlinearity after the conv makes the cotangent non-constant, so
     # both dx (kernel re-entry path) and dw (window-dot path) are
@@ -110,8 +115,8 @@ def test_grad_through_strided_phase_sum_value():
     """Stride-2 grads flow through the phase-decomposition sum (several
     _core calls + adds), which composes custom_vjp with plain jnp ops."""
     rng = np.random.RandomState(2)
-    x = _rand(rng, 1, 8, 8, 16)
-    k = _rand(rng, 3, 3, 16, 16) * 0.1
+    x = _rand(rng, 1, 8, 8, 64)
+    k = _rand(rng, 3, 3, 64, 16) * 0.1
     v0, g0 = jax.value_and_grad(
         lambda k: jnp.sum(_ref(x, k, (2, 2), "SAME") ** 2)
     )(k)
@@ -124,8 +129,8 @@ def test_grad_through_strided_phase_sum_value():
 
 def test_bf16_inputs():
     rng = np.random.RandomState(3)
-    x = _rand(rng, 2, 8, 8, 32).astype(jnp.bfloat16)
-    k = (_rand(rng, 3, 3, 32, 32) * 0.1).astype(jnp.bfloat16)
+    x = _rand(rng, 2, 8, 8, 64).astype(jnp.bfloat16)
+    k = (_rand(rng, 3, 3, 64, 32) * 0.1).astype(jnp.bfloat16)
     y0 = _ref(x, k, (1, 1), "SAME")
     y1 = conv2d_mxu(x, k, (1, 1), "SAME", interpret=True)
     assert y1.dtype == jnp.bfloat16
@@ -176,9 +181,9 @@ class TestPipelinedKernel:
     @pytest.mark.parametrize(
         "xshape,kshape,strides",
         [
-            ((2, 16, 16, 32), (3, 3, 32, 48), (1, 1)),
+            ((2, 16, 16, 64), (3, 3, 64, 48), (1, 1)),
             ((4, 8, 8, 64), (3, 3, 64, 512), (1, 1)),  # n_j > 1
-            ((2, 17, 15, 32), (3, 3, 32, 48), (2, 2)),  # phase decomp
+            ((2, 17, 15, 64), (3, 3, 64, 48), (2, 2)),  # phase decomp
         ],
         ids=["basic", "cout_tiled", "strided"],
     )
@@ -195,8 +200,8 @@ class TestPipelinedKernel:
 
     def test_grads_match_plain(self, monkeypatch):
         rng = np.random.RandomState(12)
-        x = _rand(rng, 2, 10, 10, 32)
-        k = _rand(rng, 3, 3, 32, 48) * 0.1
+        x = _rand(rng, 2, 10, 10, 64)
+        k = _rand(rng, 3, 3, 64, 48) * 0.1
 
         def loss(x, k):
             return jnp.sum(
@@ -264,8 +269,8 @@ def test_jit_grad_composes():
     model tests with impl="mxu" must run remat-free.
     """
     rng = np.random.RandomState(4)
-    x = _rand(rng, 1, 8, 8, 32)
-    k = _rand(rng, 3, 3, 32, 32) * 0.1
+    x = _rand(rng, 1, 8, 8, 64)
+    k = _rand(rng, 3, 3, 64, 32) * 0.1
 
     @jax.jit
     def f(x, k):
@@ -464,7 +469,9 @@ def test_fuzz_random_shapes(seed):
     B = int(r.randint(1, 4))
     H = int(r.randint(5, 19))
     W = int(r.randint(5, 19))
-    cin = int(r.choice([16, 24, 32, 40, 56, 72]))
+    # cin >= 64: values the padding-aware router keeps on the kernel,
+    # spanning both cin % 128 == 0 and the explicit-pad classes.
+    cin = int(r.choice([64, 72, 96, 104, 128, 160]))
     cout = int(r.choice([8, 16, 48, 96]))
     k = int(r.choice([2, 3, 5]))
     s = int(r.choice([1, 2, 3]))
@@ -477,3 +484,40 @@ def test_fuzz_random_shapes(seed):
     y1 = conv2d_mxu(x, w, (s, s), pad, interpret=True)
     assert y1.shape == y0.shape, (y1.shape, y0.shape)
     np.testing.assert_allclose(y1, y0, atol=3e-4, rtol=3e-4)
+
+
+def test_routing_is_padding_aware():
+    """Pallas-vs-patches dispatch routes on estimated post-pad MXU lane
+    utilization, not a bare cin floor: the kernel's cin→128 pad makes
+    16 <= cin < 64 classes pay 2-8x zero-column MACs, so they take the
+    patches path; >= 50% utilization stays on the kernel."""
+    from distributed_tensorflow_models_tpu.ops.conv_mxu import (
+        _mxu_lane_utilization,
+        _use_mxu_kernel,
+    )
+
+    assert _mxu_lane_utilization(128) == 1.0
+    assert _mxu_lane_utilization(64) == 0.5
+    assert _mxu_lane_utilization(16) == 0.125
+    assert _mxu_lane_utilization(160) == pytest.approx(160 / 256)
+
+    assert not _use_mxu_kernel(1, 1, 512)  # 1x1: bare dot either way
+    assert not _use_mxu_kernel(3, 3, 3)    # RGB stem
+    assert not _use_mxu_kernel(3, 3, 16)   # 8x waste under the old floor
+    assert not _use_mxu_kernel(3, 3, 32)
+    assert not _use_mxu_kernel(3, 3, 63)
+    assert _use_mxu_kernel(3, 3, 64)       # exactly the 50% threshold
+    assert _use_mxu_kernel(3, 3, 128)
+    assert _use_mxu_kernel(5, 5, 160)      # 62.5% of two lane blocks
+    assert _use_mxu_kernel(3, 3, 512)
+
+
+def test_low_cin_routes_to_patches_numerically():
+    """A 3x3 cin=32 conv (patches-routed) still matches lax exactly
+    enough — routing must never change semantics, only the lowering."""
+    rng = np.random.RandomState(7)
+    x = _rand(rng, 2, 12, 12, 32)
+    k = _rand(rng, 3, 3, 32, 24) * 0.1
+    y0 = _ref(x, k, (2, 2), "SAME")
+    y1 = conv2d_mxu(x, k, (2, 2), "SAME", interpret=True)
+    np.testing.assert_allclose(y1, y0, atol=2e-4, rtol=2e-4)

@@ -83,6 +83,94 @@ def test_nan_guard_hook():
         h.after_step(_FakeState(), {"loss": jnp.asarray(float("nan"))}, 4)
 
 
+def test_nan_guard_catches_mid_chunk_nan_with_exact_step():
+    """Fused-chunk NaN detection: the guard fires at a chunk-boundary walk
+    but scans the whole stacked chunk, attributing the NaN to its exact
+    mid-chunk step."""
+    stacked = {
+        "loss": jnp.asarray([1.0, float("nan"), 2.0, 3.0]),
+    }
+    h = hooklib.NanGuardHook(every_steps=4)
+    row = hooklib.LazyMetricRow(stacked, index=3, chunk_start_step=5)
+    with pytest.raises(FloatingPointError, match="at step 6"):
+        h.after_step(_FakeState(), row, 8)
+    # A clean chunk passes.
+    clean = hooklib.LazyMetricRow(
+        {"loss": jnp.asarray([1.0, 2.0, 3.0, 4.0])}, 3, 5
+    )
+    h.after_step(_FakeState(), clean, 8)
+
+
+def test_lazy_metric_row_semantics():
+    """Row access indexes the stacked leaf; writes land in the overlay
+    (TelemetryHook's injection contract); iteration sees both."""
+    stacked = {"loss": jnp.asarray([1.0, 2.0, 3.0]), "acc": jnp.asarray([0.1, 0.2, 0.3])}
+    row = hooklib.LazyMetricRow(stacked, index=1, chunk_start_step=10)
+    assert float(row["loss"]) == 2.0
+    assert float(row["acc"]) == pytest.approx(0.2)
+    row.update({"steps_per_sec": 42.0, "loss": 9.0})  # overlay shadows
+    assert row["steps_per_sec"] == 42.0
+    assert float(row["loss"]) == 9.0
+    assert set(row) == {"loss", "acc", "steps_per_sec"}
+    assert len(row) == 3
+    assert {k: float(v) for k, v in row.items()}["acc"] == pytest.approx(0.2)
+
+
+def test_wants_step_gating():
+    """Built-in hooks declare their active steps; the default stays
+    conservative (every step) so arbitrary user hooks keep per-step
+    semantics under the fused loop."""
+    assert hooklib.Hook().wants_step(1)
+    assert hooklib.StopAtStepHook(5).wants_step(5)
+    assert not hooklib.StopAtStepHook(5).wants_step(4)
+    ng = hooklib.NanGuardHook(every_steps=10)
+    assert ng.wants_step(10) and not ng.wants_step(9)
+    fault = hooklib.FaultInjectionHook(7)
+    assert fault.wants_step(7) and not fault.wants_step(6)
+    ck = hooklib.CheckpointHook(lambda s, st: None, every_secs=1e9)
+    assert not ck.wants_step(3)  # clock nowhere near due
+    ck2 = hooklib.CheckpointHook(
+        lambda s, st: None, every_secs=None, every_steps=4
+    )
+    assert ck2.wants_step(8) and not ck2.wants_step(7)
+
+
+def test_run_hooks_after_chunk_walks_only_wanted_steps():
+    """The chunk walk skips steps no hook wants and counts full walks into
+    train/hook_walks; StopRequested stops the walk after its step."""
+    from distributed_tensorflow_models_tpu import telemetry
+
+    seen = []
+
+    class Every4(hooklib.Hook):
+        def wants_step(self, step):
+            return step % 4 == 0
+
+        def after_step(self, state, metrics, step):
+            seen.append((step, float(metrics["loss"])))
+
+    reg = telemetry.MetricsRegistry()
+    stacked = {"loss": jnp.arange(8, dtype=jnp.float32)}
+    ok = hooklib.run_hooks_after_chunk(
+        [Every4(), hooklib.StopAtStepHook(100)],
+        _FakeState(), stacked, start_step=0, length=8, registry=reg,
+    )
+    assert ok
+    assert seen == [(4, 3.0), (8, 7.0)]  # rows 3 and 7 of the chunk
+    assert reg.snapshot()[f"{telemetry.HOOK_WALKS}"] == 2.0
+
+    # Stop at a mid-chunk step: later rows are not walked (the unfused
+    # loop breaks immediately after the stop step too).
+    seen.clear()
+    reg2 = telemetry.MetricsRegistry()
+    ok = hooklib.run_hooks_after_chunk(
+        [Every4(), hooklib.StopAtStepHook(4)],
+        _FakeState(), stacked, start_step=0, length=8, registry=reg2,
+    )
+    assert not ok
+    assert seen == [(4, 3.0)]
+
+
 def test_metric_writer_hook(tmp_path):
     h = hooklib.MetricWriterHook(str(tmp_path), every_steps=2)
     h.after_step(_FakeState(), {"loss": jnp.asarray(2.0)}, 1)  # skipped
@@ -326,6 +414,31 @@ def test_fit_auto_resume(mesh8, tmp_path):
     result3 = trainlib.fit(cfg2, str(tmp_path), mesh=mesh8)
     assert result3.steps_run == 0
     assert int(result3.state.step) == 8
+
+
+def test_fused_loop_host_overhead_drops_k_fold(mesh8, tmp_path):
+    """Tier-1 micro-guard for the fused multi-step dispatch: at
+    steps_per_loop=K the host overhead per step — jitted dispatches and
+    full hook walks — must drop ≥K-fold vs the unfused loop.  Counts come
+    from the run's own telemetry snapshot (telemetry.json), the same
+    instrument a production run reads."""
+    K = 8
+    cfg = _small_cfg(train_steps=16, log_every_steps=8)
+
+    def run(workdir, **kw):
+        trainlib.fit(cfg.replace(**kw), workdir, mesh=mesh8)
+        with open(os.path.join(workdir, "telemetry.json")) as f:
+            snap = json.load(f)["metrics"]
+        dispatches = snap.get("train/dispatch/count", 0.0) + snap.get(
+            "train/compile/count", 0.0
+        )
+        return dispatches, snap.get("train/hook_walks", 0.0)
+
+    d1, w1 = run(str(tmp_path / "unfused"))
+    dk, wk = run(str(tmp_path / "fused"), steps_per_loop=K)
+    assert d1 == 16.0 and w1 == 16.0  # one dispatch + one walk per step
+    assert dk * K <= d1, (dk, d1)
+    assert wk * K <= w1, (wk, w1)
 
 
 def test_recoverable_fit_survives_injected_fault(mesh8, tmp_path):

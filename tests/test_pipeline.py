@@ -1,6 +1,8 @@
 """Pipeline parallelism: the GPipe scan/ppermute schedule must match
 sequential stage application exactly — forward and gradient — and compose
-with the data axis."""
+with the data axis.  Plus the data-pipeline BatchStacker stage feeding the
+fused multi-step train loop (stacking, sharding, ragged tail, resume
+state)."""
 
 import jax
 import jax.numpy as jnp
@@ -8,6 +10,10 @@ import numpy as np
 import pytest
 
 from distributed_tensorflow_models_tpu.core import mesh as meshlib
+from distributed_tensorflow_models_tpu.data import (
+    datasets,
+    pipeline as datapipe,
+)
 from distributed_tensorflow_models_tpu.parallel import pipeline as pp
 
 N_STAGES = 4
@@ -87,6 +93,89 @@ def test_pipeline_gradient_matches_sequential(pipe_mesh, setup):
         g_pipe,
         g_seq,
     )
+
+
+# --------------------------------------------------------------------------
+# BatchStacker (data/pipeline.py): the chunk-assembly stage for the fused
+# multi-step train loop.
+# --------------------------------------------------------------------------
+
+
+def test_batch_stacker_stacks_sharded_batches(mesh8):
+    """K sharded device batches stack into one [K, ...] chunk laid out
+    P(None, data) — rows identical to the consecutive upstream batches."""
+    from jax.sharding import PartitionSpec as P
+
+    x = np.arange(64 * 2, dtype=np.float32).reshape(64, 2)
+    y = np.arange(64, dtype=np.int32)
+    ds = datasets.ArrayDataset({"image": x, "label": y}, 8, seed=5)
+    ref_it = iter(datasets.ArrayDataset({"image": x, "label": y}, 8, seed=5))
+
+    pre = datapipe.DevicePrefetcher(ds, mesh8, depth=2)
+    stacker = datapipe.BatchStacker(pre)
+    chunk, n = stacker.next_chunk(3)
+    assert n == 3
+    assert chunk["image"].shape == (3, 8, 2)
+    assert chunk["label"].shape == (3, 8)
+    spec = chunk["image"].sharding.spec
+    assert tuple(spec)[:2] == tuple(P(None, meshlib.AxisNames.DATA))
+    for i in range(3):
+        expect = next(ref_it)
+        np.testing.assert_array_equal(
+            np.asarray(chunk["label"][i]), expect["label"]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(chunk["image"][i]), expect["image"]
+        )
+
+
+def test_batch_stacker_ragged_tail_and_stop():
+    """A finite upstream ends mid-chunk: the partial chunk is returned
+    (never dropped), and the next call raises StopIteration."""
+
+    def gen():
+        for i in range(5):
+            yield {"x": np.full((4,), i, np.float32)}
+
+    stacker = datapipe.BatchStacker(gen())
+    c1, n1 = stacker.next_chunk(2)
+    assert n1 == 2 and c1["x"].shape == (2, 4)
+    c2, n2 = stacker.next_chunk(2)
+    assert n2 == 2
+    c3, n3 = stacker.next_chunk(2)  # only one batch left
+    assert n3 == 1 and c3["x"].shape == (1, 4)
+    np.testing.assert_array_equal(np.asarray(c3["x"][0]), np.full((4,), 4))
+    with pytest.raises(StopIteration):
+        stacker.next_chunk(2)
+    with pytest.raises(StopIteration):  # stays exhausted
+        stacker.next_chunk(1)
+
+
+def test_batch_stacker_state_resumes_at_next_unconsumed_batch(mesh8):
+    """get_state() after a chunk is the producer state of the chunk's LAST
+    batch: a resume from it yields exactly the next unconsumed batch."""
+    x = np.arange(40, dtype=np.float32).reshape(40, 1)
+    y = np.arange(40, dtype=np.int32)
+
+    def fresh():
+        return datasets.ArrayDataset({"image": x, "label": y}, 8, seed=9)
+
+    ds = fresh()
+    pre = datapipe.DevicePrefetcher(ds, mesh8, depth=2)
+    stacker = datapipe.BatchStacker(pre)
+    _, n = stacker.next_chunk(3)
+    assert n == 3
+    state = stacker.get_state()
+
+    ds2 = fresh()
+    ds2.set_state(state)
+    resumed = next(iter(ds2))
+
+    ref_it = iter(fresh())
+    for _ in range(3):
+        next(ref_it)
+    expect = next(ref_it)
+    np.testing.assert_array_equal(resumed["label"], expect["label"])
 
 
 def test_pipeline_trains(pipe_mesh, setup):

@@ -1,6 +1,7 @@
 """End-to-end train-step tests on the 8-fake-device mesh (SURVEY.md §4.3):
 the real Mesh/collective code path, no TPU required."""
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -129,6 +130,203 @@ def test_ema_tracks_params(lenet_setup, mesh8):
     assert max(diffs) > 0
     # eval_params prefers EMA
     assert s.eval_params is s.ema_params
+
+
+# --------------------------------------------------------------------------
+# Fused multi-step dispatch (make_multi_step): K-chunked lax.scan must be
+# bit-identical to per-step dispatch — rng fold_in by the in-carry step,
+# BN stats and the recurrent carry threading through the scan carry.
+# --------------------------------------------------------------------------
+
+
+class _TinyBN(nn.Module):
+    """Minimal BN+dropout classifier: exercises batch_stats threading and
+    per-step rng derivation without ResNet-sized compile times."""
+
+    @nn.compact
+    def __call__(self, x, train=False, **kw):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(16)(x)
+        x = nn.BatchNorm(use_running_average=not train)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        return nn.Dense(10)(x)
+
+
+def _stack(batches):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+
+
+def _assert_trees_bitequal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_multi_step_bitexact_bn_model(mesh8):
+    """steps_per_loop ∈ {1, K} trajectories agree EXACTLY (not within
+    tolerance) for a BN+dropout model: same rng derivation per step, BN
+    statistics threaded through the scan carry."""
+    model = _TinyBN()
+    tx = optim.tf_momentum(0.1, 0.9)
+    state0 = TrainState.create(
+        model, tx, jax.random.key(0), jnp.zeros((2, 28, 28, 1))
+    )
+    state0 = train_loop.place_state(state0, mesh8)
+    loss_fn = train_loop.classification_loss_fn(model.apply)
+    single = train_loop.make_train_step(loss_fn)
+    multi = train_loop.make_multi_step(loss_fn)
+    batches = [
+        shardlib.shard_batch(mesh8, make_batch(seed=i)) for i in range(6)
+    ]
+    rng = jax.random.key(11)
+
+    s1 = state0
+    step_losses = []
+    for b in batches:
+        s1, m = single(s1, b, rng)
+        step_losses.append(float(m["loss"]))
+
+    s2 = state0
+    chunk_losses = []
+    for lo, hi in ((0, 4), (4, 6)):  # K=4 plus a shrunken tail
+        s2, rows = multi(s2, _stack(batches[lo:hi]), rng)
+        chunk_losses.extend(float(x) for x in np.asarray(rows["loss"]))
+
+    assert step_losses == chunk_losses
+    _assert_trees_bitequal(s1.params, s2.params)
+    _assert_trees_bitequal(s1.batch_stats, s2.batch_stats)
+    _assert_trees_bitequal(s1.opt_state, s2.opt_state)
+    assert int(s2.step) == 6
+
+
+def test_multi_step_bitexact_lstm_carry(mesh8):
+    """The PTB LSTM's truncated-BPTT carry threads through the fused scan
+    exactly as through the per-step loop — final carry and params bit-equal."""
+    VOCAB, B, T = 50, 16, 8
+    model = get_model(
+        "ptb_lstm", config="small", vocab_size=VOCAB, dropout_rate=0.1
+    )
+    import optax
+
+    tx = optax.chain(optim.clip_by_global_norm(5.0), optim.sgd(0.5))
+    state0 = TrainState.create(
+        model,
+        tx,
+        jax.random.key(0),
+        jnp.zeros((B, T), jnp.int32),
+        carry=model.initial_carry(B),
+    )
+    state0 = train_loop.place_state(state0, mesh8)
+    loss_fn = train_loop.lm_loss_fn(model.apply)
+    single = train_loop.make_train_step(loss_fn)
+    multi = train_loop.make_multi_step(loss_fn)
+
+    def lm_batch(seed):
+        r = np.random.RandomState(seed)
+        seq = r.randint(0, VOCAB, (B, T + 1))
+        return shardlib.shard_batch(
+            mesh8, {"inputs": seq[:, :-1], "targets": seq[:, 1:]}
+        )
+
+    batches = [lm_batch(i) for i in range(4)]
+    rng = jax.random.key(3)
+
+    s1 = state0
+    for b in batches:
+        s1, _ = single(s1, b, rng)
+    s2, rows = multi(state0, _stack(batches), rng)
+
+    _assert_trees_bitequal(s1.params, s2.params)
+    _assert_trees_bitequal(s1.carry, s2.carry)
+    assert np.asarray(rows["loss"]).shape == (4,)
+
+
+def _fit_cfg(**kw):
+    from distributed_tensorflow_models_tpu.harness import config as configlib
+
+    base = dict(
+        train_steps=10,
+        global_batch_size=16,
+        log_every_steps=5,
+        checkpoint_every_secs=10_000.0,
+    )
+    base.update(kw)
+    return configlib.get_config("lenet_mnist", **base)
+
+
+def test_fit_steps_per_loop_trajectory_identical(mesh8, tmp_path):
+    """fit with steps_per_loop=4 must reproduce steps_per_loop=1 exactly:
+    same batches (BatchStacker resume-exact state), same rng, same final
+    params bit-for-bit on the CPU fake mesh."""
+    from distributed_tensorflow_models_tpu.harness import train as trainlib
+
+    r1 = trainlib.fit(_fit_cfg(), str(tmp_path / "spl1"), mesh=mesh8)
+    rk = trainlib.fit(
+        _fit_cfg(steps_per_loop=4), str(tmp_path / "splk"), mesh=mesh8
+    )
+    assert r1.steps_run == rk.steps_run == 10
+    _assert_trees_bitequal(r1.state.params, rk.state.params)
+    assert r1.final_metrics["loss"] == rk.final_metrics["loss"]
+    # final_metrics parity includes TelemetryHook's injected scalars (the
+    # run ends on a log boundary, so the final row was walked and the
+    # injection must land on the returned row, not a throwaway one).
+    assert "steps_per_sec" in r1.final_metrics
+    assert set(r1.final_metrics) == set(rk.final_metrics)
+
+
+def test_fit_early_stop_extra_hook_is_step_exact(mesh8, tmp_path):
+    """An early StopAtStepHook passed via extra_hooks must stop the fused
+    loop at EXACTLY its step (not the chunk end): _chunk_len consults
+    Hook.wants_step, so the chunk ends where the stop fires and the
+    returned state carries no extra optimizer updates."""
+    from distributed_tensorflow_models_tpu.harness import (
+        hooks as hooklib2,
+        train as trainlib,
+    )
+
+    res = trainlib.fit(
+        _fit_cfg(steps_per_loop=4), str(tmp_path), mesh=mesh8,
+        extra_hooks=[hooklib2.StopAtStepHook(7)],
+    )
+    assert res.steps_run == 7
+    assert int(res.state.step) == 7
+
+
+def test_fit_kill_mid_chunk_resumes_exact_next_batch(mesh8, tmp_path):
+    """A fault injected at a MID-chunk step aborts with the end-of-chunk
+    state + data position saved; the resumed run consumes exactly the next
+    unconsumed batch, so the final params equal an uninterrupted run's
+    bit-for-bit."""
+    from distributed_tensorflow_models_tpu.harness import (
+        hooks as hooklib2,
+        train as trainlib,
+    )
+
+    ref = trainlib.fit(
+        _fit_cfg(steps_per_loop=4), str(tmp_path / "ref"), mesh=mesh8
+    )
+
+    # Without the fault, chunks under log_every=5 are 1-4, 5, 6-9, 10.
+    # Step 7 would be mid third chunk — but _chunk_len consults
+    # wants_step, so the fault's presence cuts that chunk to end at
+    # exactly step 7 and the abort saves the true step-7 state.
+    wd = str(tmp_path / "killed")
+    fault = hooklib2.FaultInjectionHook(
+        7, lambda: RuntimeError("injected mid-chunk kill")
+    )
+    with pytest.raises(RuntimeError, match="mid-chunk kill"):
+        trainlib.fit(
+            _fit_cfg(steps_per_loop=4), wd, mesh=mesh8,
+            extra_hooks=[fault],
+        )
+    resumed = trainlib.fit(_fit_cfg(steps_per_loop=4), wd, mesh=mesh8)
+    # Resume restores step 7 + the exact next unconsumed batch and runs
+    # steps 8-10; the final params equal the uninterrupted run's exactly
+    # (scan chunking is length-invariant, so the different chunk split
+    # cannot change numerics).
+    assert resumed.steps_run == 3
+    assert int(resumed.state.step) == 10
+    _assert_trees_bitequal(ref.state.params, resumed.state.params)
 
 
 def test_bn_model_train_step(mesh8):
