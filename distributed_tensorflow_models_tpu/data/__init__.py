@@ -18,9 +18,14 @@ Modules:
 - :mod:`augment` — the reference's augmentation set, transform-for-transform
   (SURVEY.md §7.4.3).
 - :mod:`datasets` — array-backed datasets for every reference config
-  (MNIST, CIFAR-10, ImageNet-from-TFRecord, PTB).
+  (MNIST, CIFAR-10, ImageNet-from-TFRecord, PTB), each factored into a
+  cheap checkpointable cursor (``next_work``) plus a pure per-batch
+  ``assemble`` function so production can parallelize deterministically.
 - :mod:`pipeline` — threaded host prefetcher with checkpointable iterator
-  state (the QueueRunner/Coordinator replacement, SURVEY.md §2.2 F10/F11).
+  state (the QueueRunner/Coordinator replacement, SURVEY.md §2.2 F10/F11);
+  ``num_workers > 1`` restores the reference's many-QueueRunner producer
+  parallelism behind an ordered-reassembly stage, bit-identical at any
+  worker count.
 """
 
 from distributed_tensorflow_models_tpu.data.pipeline import (  # noqa: F401

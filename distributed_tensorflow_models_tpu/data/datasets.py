@@ -10,12 +10,29 @@ testable in this offline environment.
 All iterators expose ``get_state()/set_state()`` for mid-epoch resume —
 the capability gap called out in SURVEY.md §5.4 (the reference's queue
 pipeline cannot resume; it restarts input from scratch after recovery).
+
+Worker-pool split (``pipeline.py::HostPipeline`` with ``num_workers>1``):
+every dataset here additionally factors its iteration into
+
+- ``next_work()`` — advance the *cheap cursor* and return a work
+  descriptor for the next batch.  The cursor (epoch/batch position, or
+  the TFRecord read head + global record count) is the entire
+  checkpointable state; ``next_work`` is the only method that mutates it.
+- ``assemble(work)`` — the *pure function* a pool worker executes:
+  work descriptor → numpy batch, thread-safe, deterministic (all
+  augmentation rngs are derived from positions carried in the work item,
+  the reference's many-QueueRunner parallelism made reproducible).
+
+``__iter__`` is defined *through* this split (:func:`iterate_via_work`),
+so the serial producer and the worker pool can never diverge — the
+emitted stream is bit-identical at any worker count.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Callable, Iterator, Optional, Sequence
+import threading
+from typing import Any, Callable, Iterator, Optional, Sequence
 
 import numpy as np
 
@@ -36,6 +53,19 @@ def _validate_process_shard(
     if not 0 <= process_index < process_count:
         raise ValueError(f"bad process {process_index}/{process_count}")
     return batch_size // process_count
+
+
+def iterate_via_work(dataset) -> Iterator[dict[str, np.ndarray]]:
+    """Serial iteration expressed through the worker-pool split: pull a
+    work item off the cursor, assemble it inline.  Every dataset's
+    ``__iter__`` routes through this, so the single-producer path and the
+    N-worker pool execute the *same* code and emit the same stream."""
+    while True:
+        try:
+            work = dataset.next_work()
+        except StopIteration:
+            return
+        yield dataset.assemble(work)
 
 
 # --------------------------------------------------------------------------
@@ -98,6 +128,13 @@ class ArrayDataset:
             raise NotImplementedError("partial final batches unsupported")
         self._epoch = 0
         self._batch_idx = 0
+        # Per-epoch permutation cache: assemble() is called from pool
+        # worker threads that may straddle an epoch boundary, so the perm
+        # is computed once per epoch under a lock (the value is a pure
+        # function of (seed, epoch) — any thread computes the same one)
+        # and old epochs are pruned to bound memory.
+        self._perm_lock = threading.Lock()
+        self._perm_cache: dict[int, np.ndarray] = {}
 
     @property
     def batches_per_epoch(self) -> int:
@@ -110,33 +147,53 @@ class ArrayDataset:
         self._epoch = int(state["epoch"])
         self._batch_idx = int(state["batch_idx"])
 
-    def _perm(self) -> np.ndarray:
+    def _perm_for(self, epoch: int) -> np.ndarray:
         if not self._shuffle:
             return np.arange(self._n)
-        return np.random.RandomState(
-            (self._seed + self._epoch) & 0x7FFFFFFF
-        ).permutation(self._n)
+        with self._perm_lock:
+            perm = self._perm_cache.get(epoch)
+            if perm is None:
+                perm = np.random.RandomState(
+                    (self._seed + epoch) & 0x7FFFFFFF
+                ).permutation(self._n)
+                self._perm_cache[epoch] = perm
+                while len(self._perm_cache) > 4:
+                    self._perm_cache.pop(min(self._perm_cache))
+            return perm
 
-    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
-        while True:
-            perm = self._perm()
-            while self._batch_idx < self.batches_per_epoch:
-                lo = self._batch_idx * self._batch_size + self._local_lo
-                idx = perm[lo : lo + self._local_batch]
-                batch = {k: v[idx] for k, v in self._arrays.items()}
-                if self._transform is not None:
-                    key = self._transform_key
-                    out = []
-                    for j, img in enumerate(batch[key]):
-                        rng = np.random.default_rng(
-                            (self._seed, self._epoch, lo + j)
-                        )
-                        out.append(self._transform(img, rng))
-                    batch[key] = np.stack(out)
-                self._batch_idx += 1
-                yield batch
+    def next_work(self) -> tuple[int, int]:
+        """Advance the cursor; return the ``(epoch, batch_idx)`` position
+        the next batch is a pure function of.  Infinite (epochs loop)."""
+        if self._batch_idx >= self.batches_per_epoch:
             self._epoch += 1
             self._batch_idx = 0
+        work = (self._epoch, self._batch_idx)
+        self._batch_idx += 1
+        return work
+
+    def assemble(self, work: tuple[int, int]) -> dict[str, np.ndarray]:
+        """Pure position → batch (thread-safe; what a pool worker runs).
+
+        Augmentation rngs are keyed by ``(seed, epoch, global sample
+        position)`` exactly as the serial path always did, so the batch
+        depends only on the work item — never on which worker assembles
+        it or in what order."""
+        epoch, batch_idx = work
+        perm = self._perm_for(epoch)
+        lo = batch_idx * self._batch_size + self._local_lo
+        idx = perm[lo : lo + self._local_batch]
+        batch = {k: v[idx] for k, v in self._arrays.items()}
+        if self._transform is not None:
+            key = self._transform_key
+            out = []
+            for j, img in enumerate(batch[key]):
+                rng = np.random.default_rng((self._seed, epoch, lo + j))
+                out.append(self._transform(img, rng))
+            batch[key] = np.stack(out)
+        return batch
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        return iterate_via_work(self)
 
 
 # --------------------------------------------------------------------------
@@ -299,6 +356,10 @@ class ImageNetTFRecordDataset:
         self._seed = seed
         self._label_offset = label_offset
         self._count = 0
+        # Persistent record iterator behind the cursor (created lazily so
+        # set_state before first use replays into a fresh one).
+        self._rec_it: Optional[Iterator[bytes]] = None
+        self._exhausted = False
 
     def get_state(self) -> dict:
         return {"records": self._records.get_state(), "count": self._count}
@@ -306,8 +367,10 @@ class ImageNetTFRecordDataset:
     def set_state(self, state: dict) -> None:
         self._records.set_state(state["records"])
         self._count = int(state["count"])
+        self._rec_it = None
+        self._exhausted = False
 
-    def _parse(self, raw: bytes) -> tuple[np.ndarray, int]:
+    def _parse(self, raw: bytes, count: int) -> tuple[np.ndarray, int]:
         feats = example_proto.parse_example(raw)
         img = augment.decode_jpeg(feats["image/encoded"][0])
         label = int(feats["image/class/label"][0]) - self._label_offset
@@ -330,7 +393,7 @@ class ImageNetTFRecordDataset:
             # without it all hosts would apply identical crop/flip
             # parameters at each within-batch position.
             salt = self._process_index if self._file_sharded else 0
-            rng = np.random.default_rng((self._seed, salt, self._count))
+            rng = np.random.default_rng((self._seed, salt, count))
             img = augment.preprocess_imagenet_train(
                 img, rng, size=self._size, bbox=bbox
             )
@@ -338,67 +401,82 @@ class ImageNetTFRecordDataset:
             img = augment.preprocess_imagenet_eval(img, size=self._size)
         return img.astype(np.float32), label
 
-    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+    def next_work(self) -> dict[str, Any]:
+        """Pull the raw records for the next batch off the read head.
+
+        This is the *cheap cursor* half of the pool split: serial record
+        I/O plus count bookkeeping, no decode.  The returned work item
+        carries ``(raw bytes, global record count)`` pairs — everything
+        :meth:`assemble` needs to be a pure function — plus the number of
+        ``label=-1`` fill rows (multi-process eval tail only).
+        """
+        if self._exhausted:
+            raise StopIteration
+        if self._rec_it is None:
+            self._rec_it = iter(self._records)
+        items: list[tuple[bytes, int]] = []
         if self._file_sharded:
-            # Own shard files == own slice of the global batch; nothing but
-            # local records are ever read or decoded.
-            images, labels = [], []
-            for raw in self._records:
-                img, label = self._parse(raw)
+            # Own shard files == own slice of the global batch; nothing
+            # but local records are ever read or decoded.
+            for raw in self._rec_it:
+                items.append((raw, self._count))
                 self._count += 1
-                images.append(img)
-                labels.append(label)
-                if len(images) == self._local_batch:
-                    yield {
-                        "image": np.stack(images),
-                        "label": np.asarray(labels, np.int32),
-                    }
-                    images, labels = [], []
-            return
+                if len(items) == self._local_batch:
+                    return {"items": items, "pad": 0}
+            # Finite stream ended mid-batch: the ragged train tail is
+            # dropped, exactly as the serial loop always did.
+            self._exhausted = True
+            raise StopIteration
 
         # Replicated-read modes: all processes see the same global record
-        # stream; each parses only its row block [lo, hi) of every global
+        # stream; each keeps only its row block [lo, hi) of every global
         # batch.  ``_count`` advances globally (even past skipped rows), so
         # augmentation rngs agree with a single-process run and the
         # process-order concatenation reproduces its batches exactly.
         lo = self._process_index * self._local_batch
         hi = lo + self._local_batch
-        images, labels = [], []
         pos = 0
-        for raw in self._records:
+        for raw in self._rec_it:
             if lo <= pos < hi:
-                img, label = self._parse(raw)
-                images.append(img)
-                labels.append(label)
+                items.append((raw, self._count))
             self._count += 1
             pos += 1
             if pos == self._batch_size:
-                yield {
-                    "image": np.stack(images),
-                    "label": np.asarray(labels, np.int32),
-                }
-                images, labels = [], []
-                pos = 0
+                return {"items": items, "pad": 0}
+        self._exhausted = True
         if pos and not self._train:
             # Partial final global batch so a one-pass eval covers every
-            # record.  Single-process: yield it ragged (the eval driver
-            # pads).  Multi-process: pad every row block to equal shape
-            # with label=-1 rows, masked out by the padded-batch counting.
+            # record.  Single-process: ragged (the eval driver pads).
+            # Multi-process: pad every row block to equal shape with
+            # label=-1 rows, masked out by the padded-batch counting.
             if self._process_count == 1:
-                if images:
-                    yield {
-                        "image": np.stack(images),
-                        "label": np.asarray(labels, np.int32),
-                    }
-                return
-            pad = self._local_batch - len(images)
+                if items:
+                    return {"items": items, "pad": 0}
+                raise StopIteration
+            return {"items": items, "pad": self._local_batch - len(items)}
+        raise StopIteration
+
+    def assemble(self, work: dict[str, Any]) -> dict[str, np.ndarray]:
+        """Pure work → batch: JPEG decode + augment for every carried
+        record (the expensive half, what a pool worker runs).  Rngs key on
+        the global record count inside the work item, so the result is
+        independent of assembly order and worker identity."""
+        images, labels = [], []
+        for raw, count in work["items"]:
+            img, label = self._parse(raw, count)
+            images.append(img)
+            labels.append(label)
+        if work["pad"]:
             fill = np.zeros((self._size, self._size, 3), np.float32)
-            images.extend([fill] * pad)
-            labels.extend([-1] * pad)
-            yield {
-                "image": np.stack(images),
-                "label": np.asarray(labels, np.int32),
-            }
+            images.extend([fill] * work["pad"])
+            labels.extend([-1] * work["pad"])
+        return {
+            "image": np.stack(images),
+            "label": np.asarray(labels, np.int32),
+        }
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        return iterate_via_work(self)
 
 
 def synthetic_imagenet_dataset(
@@ -476,20 +554,27 @@ class PTBDataset:
         self._epoch = int(state["epoch"])
         self._pos = int(state["pos"])
 
-    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
-        T = self._num_steps
-        while True:
-            while self._pos < self._epoch_size:
-                i = self._pos * T
-                self._pos += 1
-                yield {
-                    "inputs": self._data[:, i : i + T].astype(np.int32),
-                    "targets": self._data[:, i + 1 : i + T + 1].astype(
-                        np.int32
-                    ),
-                }
+    def next_work(self) -> int:
+        """Advance the cursor; return the window position the next batch
+        is a pure function of.  Infinite (epochs loop)."""
+        if self._pos >= self._epoch_size:
             self._epoch += 1
             self._pos = 0
+        work = self._pos
+        self._pos += 1
+        return work
+
+    def assemble(self, work: int) -> dict[str, np.ndarray]:
+        """Pure position → window batch (thread-safe; slices only)."""
+        T = self._num_steps
+        i = work * T
+        return {
+            "inputs": self._data[:, i : i + T].astype(np.int32),
+            "targets": self._data[:, i + 1 : i + T + 1].astype(np.int32),
+        }
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        return iterate_via_work(self)
 
 
 def load_ptb_tokens(split: str = "train", vocab_size: int = 10000) -> np.ndarray:

@@ -1,28 +1,43 @@
 """Host prefetch pipeline: the QueueRunner/Coordinator replacement.
 
 The reference overlaps input with compute via graph-resident queues driven
-by Python ``QueueRunner`` threads under a ``Coordinator`` (SURVEY.md §2.2
-F10/F11; TF queue_runner_impl.py:34, coordinator.py:28).  The TPU-native
-split: a background host thread produces numpy batches into a bounded
-buffer (:class:`HostPipeline` — the queue-runner role, including the
-Coordinator's cooperative-stop and exception-propagation semantics), and
-:class:`DevicePrefetcher` keeps a couple of batches resident on the mesh so
-the next step's transfer overlaps the current step's compute.
+by *many* Python ``QueueRunner`` threads per queue under a ``Coordinator``
+(SURVEY.md §2.2 F10/F11; TF queue_runner_impl.py:34, coordinator.py:28).
+The TPU-native split: :class:`HostPipeline` produces numpy batches into a
+bounded buffer — one background producer thread by default, or an
+N-worker pool (``num_workers > 1``) that restores the reference's
+producer parallelism for decode/augment-bound inputs — and
+:class:`DevicePrefetcher` keeps a couple of batches resident on the mesh
+so the next step's transfer overlaps the current step's compute.
+
+The worker pool keeps the Coordinator semantics AND, unlike the
+reference's free-running queue runners, stays deterministic: a serial
+dispatcher advances the dataset's cheap cursor (``next_work()``,
+datasets.py) and enqueues indexed work items; workers execute the pure
+``assemble(work)`` in parallel; an ordered-reassembly stage releases
+batches strictly in dispatch-index order.  The emitted stream is
+therefore bit-identical for any worker count, a producer error surfaces
+at exactly the position it occurred (after every earlier good batch has
+drained), and the resume contract below is unchanged.
 
 Unlike the reference's queues, the pipeline is *checkpointable*: each batch
 carries the producer state that follows it, so `state` after consuming
 batch k resumes at batch k+1 exactly (SURVEY.md §5.4 gap).
 
-Telemetry: both stages record into an injectable
+Telemetry: all stages record into an injectable
 :class:`...telemetry.MetricsRegistry` (default: the process-global one) —
 ``pipeline/host_queue_depth`` + ``pipeline/producer_wait`` from the host
-producer, ``pipeline/prefetch_fill`` + ``pipeline/prefetch_depth`` from
-the device stage.  High producer wait = consumer-bound (healthy); high
-prefetch-fill p95 = the host stream is the bottleneck.
+producer, ``pipeline/worker_busy/<i>`` per-worker utilization +
+``pipeline/reassembly_wait`` from the pool, ``pipeline/prefetch_fill`` +
+``pipeline/prefetch_depth`` from the device stage.  High producer wait =
+consumer-bound (healthy); high prefetch-fill p95 = the host stream is the
+bottleneck — then worker_busy vs reassembly_wait splits "pool too small /
+decode-bound" from "serial cursor-bound" (README "Performance").
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -32,6 +47,8 @@ from distributed_tensorflow_models_tpu import telemetry
 
 PyTree = Any
 
+log = logging.getLogger("dtm")
+
 
 class _Stop:
     pass
@@ -40,11 +57,36 @@ class _Stop:
 _STOP = _Stop()
 
 
+class _Failure:
+    """A producer-side error travelling the queues as a payload, so the
+    ordered-release stage surfaces it at the position it occurred."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
 class HostPipeline:
-    """Background-thread batch producer with bounded buffering.
+    """Batch producer with bounded buffering: one background thread, or an
+    ordered worker pool.
 
     ``dataset`` must be iterable (yielding numpy pytrees) and may expose
-    ``get_state()/set_state()`` for resume.
+    ``get_state()/set_state()`` for resume.  With ``num_workers > 1`` it
+    must additionally expose the worker-pool split (``next_work()`` +
+    pure ``assemble(work)`` — every dataset in ``datasets.py`` does);
+    datasets without it fall back to the serial producer with a warning.
+
+    Pool topology (all threads daemon, all loops cooperative on the stop
+    event): ``host-pipeline`` (dispatcher) advances the cursor serially
+    and enqueues ``(index, work, state-after)``; ``data-worker-<i>``
+    threads run ``assemble`` in parallel; ``host-pipeline-reassembly``
+    releases results strictly in index order into the bounded consumer
+    buffer.  Because release is ordered and state was captured at
+    dispatch, the checkpointable state follows the last *released* batch
+    exactly as in the serial path, and in-flight work is naturally
+    bounded by the dispatch queue depth + pool width (the reassembly
+    hold-back set can never exceed it).
     """
 
     def __init__(
@@ -52,6 +94,7 @@ class HostPipeline:
         dataset,
         *,
         prefetch: int = 4,
+        num_workers: int = 1,
         registry: Optional[telemetry.MetricsRegistry] = None,
     ):
         self._dataset = dataset
@@ -60,14 +103,103 @@ class HostPipeline:
         )
         self._buffer: queue.Queue = queue.Queue(maxsize=prefetch)
         self._error: Optional[BaseException] = None
+        self._error_raised = False
         self._stop_event = threading.Event()
         self._state: Optional[dict] = (
             dataset.get_state() if hasattr(dataset, "get_state") else None
         )
-        self._thread = threading.Thread(
-            target=self._run, name="host-pipeline", daemon=True
-        )
-        self._thread.start()
+        # Pool wind-down, distinct from the consumer-facing stop event:
+        # set by reassembly when it exits early (producer error) so the
+        # dispatcher and workers stop feeding the unbounded results queue
+        # while the consumer is still draining buffered good batches —
+        # the STOP sentinel (gated on _stop_event only) still goes out.
+        self._pool_stop = threading.Event()
+        pooled = num_workers > 1
+        if pooled and not (
+            hasattr(dataset, "next_work") and hasattr(dataset, "assemble")
+        ):
+            log.warning(
+                "num_workers=%d requested but %s does not expose the "
+                "next_work/assemble worker-pool split; using the serial "
+                "producer",
+                num_workers,
+                type(dataset).__name__,
+            )
+            pooled = False
+        if pooled:
+            self._num_workers = num_workers
+            # Dispatch depth = pool width + prefetch: enough queued work
+            # to keep every worker fed while the consumer drains, small
+            # enough that dispatch (and so checkpoint state) never runs
+            # far ahead of release.
+            self._work_q: queue.Queue = queue.Queue(
+                maxsize=num_workers + prefetch
+            )
+            # Unbounded on purpose: in-flight items are bounded by
+            # work_q depth + num_workers, and a bounded results queue
+            # could deadlock reassembly waiting for an index a blocked
+            # worker holds.
+            self._results_q: queue.Queue = queue.Queue()
+            self._dispatched = 0
+            self._dispatch_done = False
+            # Reassembly's hold-back set, an attribute so stop() can
+            # sweep it (with the results queue) for a failure that never
+            # reached the release point.
+            self._pending: dict[int, tuple] = {}
+            self._threads = [
+                threading.Thread(
+                    target=self._dispatch, name="host-pipeline", daemon=True
+                ),
+                *(
+                    threading.Thread(
+                        target=self._worker,
+                        args=(i,),
+                        name=f"data-worker-{i}",
+                        daemon=True,
+                    )
+                    for i in range(num_workers)
+                ),
+                threading.Thread(
+                    target=self._reassemble,
+                    name="host-pipeline-reassembly",
+                    daemon=True,
+                ),
+            ]
+        else:
+            self._threads = [
+                threading.Thread(
+                    target=self._run, name="host-pipeline", daemon=True
+                )
+            ]
+        for t in self._threads:
+            t.start()
+
+    # -- queue helpers (every blocking op must observe the stop event) ----
+
+    def _put_stop_aware(self, q: queue.Queue, item) -> bool:
+        """Put, polling the stop event; False if stop was requested."""
+        while not self._stop_event.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _pool_halted(self) -> bool:
+        return self._stop_event.is_set() or self._pool_stop.is_set()
+
+    def _put_pool_aware(self, q: queue.Queue, item) -> bool:
+        """Put, polling stop AND pool wind-down; False if either fired."""
+        while not self._pool_halted():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # -- serial producer (num_workers == 1 or no pool protocol) -----------
 
     def _run(self) -> None:
         reg = self._registry
@@ -81,19 +213,16 @@ class HostPipeline:
                 # Time blocked on a full buffer: high producer wait means
                 # the consumer is the bottleneck — the healthy state.
                 t0 = time.perf_counter()
-                while not self._stop_event.is_set():
-                    try:
-                        self._buffer.put((batch, state), timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                delivered = self._put_stop_aware(
+                    self._buffer, (batch, state)
+                )
                 reg.timer(telemetry.PRODUCER_WAIT).record(
                     time.perf_counter() - t0
                 )
                 reg.gauge(telemetry.HOST_QUEUE_DEPTH).set(
                     self._buffer.qsize()
                 )
-                if self._stop_event.is_set():
+                if not delivered:
                     return
         except BaseException as e:  # propagate like Coordinator.join
             self._error = e
@@ -101,12 +230,130 @@ class HostPipeline:
             # The STOP sentinel must not be dropped: without it a consumer
             # blocks forever after draining the buffer (and a stored error
             # would never surface).  Retry until delivered or stop requested.
-            while not self._stop_event.is_set():
+            self._put_stop_aware(self._buffer, (_STOP, None))
+
+    # -- worker pool -------------------------------------------------------
+
+    def _dispatch(self) -> None:
+        """Serial cursor walk: the only thread that touches the dataset's
+        mutable state.  State is captured immediately after ``next_work``
+        so it names the position *after* the dispatched batch — the
+        resume-exact value released alongside that batch downstream."""
+        idx = 0
+        try:
+            while not self._pool_halted():
                 try:
-                    self._buffer.put((_STOP, None), timeout=0.1)
+                    work = self._dataset.next_work()
+                except StopIteration:
                     break
-                except queue.Full:
-                    continue
+                state = (
+                    self._dataset.get_state()
+                    if hasattr(self._dataset, "get_state")
+                    else None
+                )
+                if not self._put_pool_aware(
+                    self._work_q, (idx, work, state)
+                ):
+                    return
+                idx += 1
+        except BaseException as e:
+            # A cursor error holds position idx: reassembly releases
+            # 0..idx-1 first, then surfaces it — straight to results, no
+            # worker involved.
+            self._results_q.put((idx, _Failure(e), None))
+            idx += 1
+        finally:
+            self._dispatched = idx
+            self._dispatch_done = True
+            for _ in range(self._num_workers):
+                if not self._put_pool_aware(self._work_q, _STOP):
+                    break
+
+    def _worker(self, wid: int) -> None:
+        reg = self._registry
+        busy_gauge = reg.gauge(f"{telemetry.WORKER_BUSY}/{wid}")
+        t_start = time.perf_counter()
+        busy = 0.0
+        while not self._pool_halted():
+            try:
+                item = self._work_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if isinstance(item, _Stop):
+                return
+            idx, work, state = item
+            t0 = time.perf_counter()
+            try:
+                payload = self._dataset.assemble(work)
+            except BaseException as e:
+                payload = _Failure(e)
+            now = time.perf_counter()
+            busy += now - t0
+            busy_gauge.set(busy / max(now - t_start, 1e-9))
+            self._results_q.put((idx, payload, state))
+
+    def _reassemble(self) -> None:
+        """Ordered release: batches leave in dispatch-index order no
+        matter which worker finished first, so the stream (and the state
+        riding with each batch) is identical to the serial producer's."""
+        reg = self._registry
+        pending = self._pending
+        next_idx = 0
+        try:
+            while not self._stop_event.is_set():
+                # Wait for the *next in-order* index.  This timer is the
+                # pool's stall signal: fat p95 with workers near 1.0 busy
+                # = pool too small (decode-bound); fat p95 with workers
+                # idle = the serial cursor is the bottleneck.
+                t0 = time.perf_counter()
+                while next_idx not in pending:
+                    if self._stop_event.is_set():
+                        return
+                    if (
+                        self._dispatch_done
+                        and next_idx >= self._dispatched
+                    ):
+                        return
+                    try:
+                        idx, payload, state = self._results_q.get(
+                            timeout=0.1
+                        )
+                    except queue.Empty:
+                        continue
+                    pending[idx] = (payload, state)
+                reg.timer(telemetry.REASSEMBLY_WAIT).record(
+                    time.perf_counter() - t0
+                )
+                payload, state = pending.pop(next_idx)
+                next_idx += 1
+                if isinstance(payload, _Failure):
+                    # Surfaces after every earlier good batch has drained
+                    # — the position-exact Coordinator contract.
+                    self._error = payload.error
+                    return
+                # Blocked on a full buffer = consumer-bound (healthy) —
+                # the same signal the serial producer records.
+                t0 = time.perf_counter()
+                delivered = self._put_stop_aware(
+                    self._buffer, (payload, state)
+                )
+                reg.timer(telemetry.PRODUCER_WAIT).record(
+                    time.perf_counter() - t0
+                )
+                reg.gauge(telemetry.HOST_QUEUE_DEPTH).set(
+                    self._buffer.qsize()
+                )
+                if not delivered:
+                    return
+        finally:
+            # Wind the pool down on EVERY exit — on the error path the
+            # dispatcher and workers would otherwise free-run an
+            # infinite dataset into the unbounded results queue while
+            # the consumer drains buffered batches toward the error.
+            self._pool_stop.set()
+            self._put_stop_aware(self._buffer, (_STOP, None))
+
+    # -- consumer side -----------------------------------------------------
 
     def __iter__(self) -> Iterator[PyTree]:
         return self
@@ -115,8 +362,14 @@ class HostPipeline:
         # Buffered good batches drain before a producer error surfaces —
         # the error is raised at the position it occurred, not earlier.
         item, state = self._buffer.get()
+        # Sample depth on the consumer side too: a drained queue must
+        # read 0, not the last depth the producer happened to publish.
+        self._registry.gauge(telemetry.HOST_QUEUE_DEPTH).set(
+            self._buffer.qsize()
+        )
         if isinstance(item, _Stop):
             if self._error is not None:
+                self._error_raised = True
                 raise self._error
             raise StopIteration
         self._state = state
@@ -127,15 +380,51 @@ class HostPipeline:
         return self._state
 
     def stop(self) -> None:
-        """Cooperative stop — ``Coordinator.request_stop`` +
-        ``join`` (TF coordinator.py:181,318)."""
+        """Cooperative stop — ``Coordinator.request_stop`` + ``join``
+        (TF coordinator.py:181,318).  Like ``Coordinator.join``, a stored
+        producer error that never reached the consumer is re-raised here
+        (after the threads are down) rather than silently dropped, and a
+        thread that outlives the join timeout is reported."""
         self._stop_event.set()
         while True:  # drain so the producer unblocks
             try:
                 self._buffer.get_nowait()
             except queue.Empty:
                 break
-        self._thread.join(timeout=5.0)
+        for t in self._threads:
+            t.join(timeout=5.0)
+            if t.is_alive():
+                log.warning(
+                    "pipeline thread %s still alive after 5s join timeout",
+                    t.name,
+                )
+        if self._error is None and hasattr(self, "_results_q"):
+            # A pooled failure may still be in flight — produced by a
+            # worker but not yet walked past by reassembly when stop cut
+            # it short.  Sweep the results queue and the hold-back set
+            # (threads are joined; no writers remain) and surface the
+            # earliest-index failure, matching the serial path where the
+            # error is stored the moment it is raised.
+            while True:
+                try:
+                    idx, payload, state = self._results_q.get_nowait()
+                except queue.Empty:
+                    break
+                self._pending[idx] = (payload, state)
+            failures = [
+                (idx, payload)
+                for idx, (payload, _) in self._pending.items()
+                if isinstance(payload, _Failure)
+            ]
+            if failures:
+                self._error = min(failures, key=lambda f: f[0])[1].error
+        if self._error is not None and not self._error_raised:
+            self._error_raised = True
+            log.error(
+                "host pipeline stopped with pending producer error: %r",
+                self._error,
+            )
+            raise self._error
 
 
 class DevicePrefetcher:

@@ -37,6 +37,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "'Performance')",
     )
     p.add_argument(
+        "--data-workers", type=int, default=None,
+        help="parallel host input pipeline: worker threads decoding/"
+        "augmenting batches behind ordered reassembly (1 = single "
+        "producer thread).  Deterministic — the batch stream is "
+        "bit-identical for any value; raise it for decode-bound inputs "
+        "(README 'Performance')",
+    )
+    p.add_argument(
         "--mesh-model", type=int, default=None,
         help="tensor-parallel axis size (default 1)",
     )
@@ -86,6 +94,8 @@ def _overrides(args) -> dict:
         out["seed"] = args.seed
     if getattr(args, "steps_per_loop", None) is not None:
         out["steps_per_loop"] = args.steps_per_loop
+    if getattr(args, "data_workers", None) is not None:
+        out["data_workers"] = args.data_workers
     for attr, key in (
         ("mesh_model", "mesh_model"),
         ("mesh_seq", "mesh_seq"),

@@ -121,6 +121,16 @@ class ExperimentConfig:
     # precisely the same steps as the unfused loop; trajectories are
     # bit-identical either way (tests/test_train_loop.py pins this).
     steps_per_loop: int = 1
+    # Parallel host input pipeline: N worker threads run the per-batch
+    # assemble/decode/augment in parallel behind an ordered-reassembly
+    # stage (data/pipeline.py::HostPipeline) — the reference's
+    # many-QueueRunner producer parallelism, made deterministic.  1 =
+    # single producer thread.  The emitted batch stream is bit-identical
+    # for ANY value and checkpoints stay resume-exact, so this is purely
+    # a throughput knob: raise it when telemetry shows the host stream
+    # starving the device (pipeline/prefetch_fill p95 fat) while workers
+    # saturate (pipeline/worker_busy near 1) — README "Performance".
+    data_workers: int = 1
     log_every_steps: int = 100
     checkpoint_every_secs: float = 600.0
     keep_checkpoints: int = 5

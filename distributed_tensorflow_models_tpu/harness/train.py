@@ -377,7 +377,12 @@ def fit(
     if restored and data_state.get("dataset") and hasattr(dataset, "set_state"):
         dataset.set_state(data_state["dataset"])
 
-    host = pipelib.HostPipeline(dataset, prefetch=4, registry=registry)
+    host = pipelib.HostPipeline(
+        dataset,
+        prefetch=4,
+        num_workers=max(1, int(cfg.data_workers)),
+        registry=registry,
+    )
     seq_dim = (
         1
         if cfg.task == "lm" and mesh.shape[meshlib.AxisNames.SEQ] > 1

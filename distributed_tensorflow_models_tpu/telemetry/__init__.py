@@ -37,7 +37,9 @@ from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
     PREFETCH_DEPTH,
     PREFETCH_FILL,
     PRODUCER_WAIT,
+    REASSEMBLY_WAIT,
     STEP_TIME,
+    WORKER_BUSY,
     Counter,
     Gauge,
     MetricsRegistry,

@@ -45,6 +45,15 @@ HOST_QUEUE_DEPTH = "pipeline/host_queue_depth"  # gauge
 PRODUCER_WAIT = "pipeline/producer_wait"  # timer: producer blocked on full buffer
 PREFETCH_FILL = "pipeline/prefetch_fill"  # timer: DevicePrefetcher upstream fetch
 PREFETCH_DEPTH = "pipeline/prefetch_depth"  # gauge
+# Worker-pool producer (HostPipeline num_workers>1).  WORKER_BUSY is a
+# per-worker utilization gauge family — one gauge per worker at
+# ``pipeline/worker_busy/<i>`` (fraction of wall time spent assembling
+# since the pool started).  REASSEMBLY_WAIT times the ordered-release
+# stage waiting for the next in-index-order batch: high with workers
+# near 1.0 busy = pool too small / decode-bound; high with workers idle
+# = the serial record cursor is the bottleneck.
+WORKER_BUSY = "pipeline/worker_busy"  # gauge family: /<worker index>
+REASSEMBLY_WAIT = "pipeline/reassembly_wait"  # timer
 CKPT_SAVE = "checkpoint/save"  # timer
 CKPT_RESTORE = "checkpoint/restore"  # timer
 CKPT_WAIT = "checkpoint/wait"  # timer: blocking on async save completion

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Probe the machine for real datasets and write DATA_AVAILABILITY.md.
+"""Probe the machine for real datasets and write DATA_AVAILABILITY.md —
+and, with ``--worker-sweep``, bench the parallel host input pipeline.
 
 Every convergence/A-B artifact in this repo is honest about running on
 synthetic data; this probe is the companion evidence that real data was
@@ -13,12 +14,26 @@ Checks the exact paths the dataset loaders read (data/datasets.py):
   - .../imagenet/train-* + validation-* TFRecord shards
   - .../ptb.{train,valid,test}.txt
 and records sizes/counts for whatever exists.
+
+``--worker-sweep`` instead measures producer throughput of
+``data/pipeline.py::HostPipeline`` at ``data_workers ∈ {1,2,4}`` on a
+decode-bound config (synthetic JPEG TFRecord shards → full inception
+train preprocessing), banks ``data_probe_workers.json``, and asserts the
+streams are bit-identical across worker counts while it measures.  Two
+profiles: pure-CPU decode (gains bounded by free host cores — the probe
+records the measured core count) and decode+fetch-latency (each batch's
+record fetch blocks in the worker, the remote-storage regime of real TPU
+input hosts — the pool overlaps fetch with decode on any host).
 """
 # Runnable from anywhere (same idiom as recompute_mfu.py).
+import argparse
 import glob
+import hashlib
 import json
 import os
 import sys
+import tempfile
+import time
 from datetime import datetime, timezone
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -100,7 +115,215 @@ def _probe_egress(timeout=5.0):
     return False
 
 
+# --------------------------------------------------------------------------
+# Worker sweep: producer throughput of the parallel host pipeline
+# --------------------------------------------------------------------------
+
+
+class _FetchLatencyDataset:
+    """Models the remote-storage regime of real TPU input hosts: each
+    batch's record fetch blocks for ``fetch_s`` before decode.  The wait
+    lives in ``assemble`` (executed by the pool worker), as it does for
+    readers that fetch their own shard ranges, so the pool can overlap
+    fetch with decode — a genuine win even on a single host core."""
+
+    def __init__(self, inner, fetch_s: float):
+        self._inner = inner
+        self._fetch_s = fetch_s
+
+    def next_work(self):
+        return self._inner.next_work()
+
+    def assemble(self, work):
+        time.sleep(self._fetch_s)
+        return self._inner.assemble(work)
+
+    def get_state(self):
+        return self._inner.get_state()
+
+    def set_state(self, state):
+        self._inner.set_state(state)
+
+    def __iter__(self):
+        from distributed_tensorflow_models_tpu.data import datasets
+
+        return datasets.iterate_via_work(self)
+
+
+def _build_shards(tmp: str, n_records: int = 64, src_size: int = 160):
+    """Synthetic JPEG TFRecord shards — the decode-bound input."""
+    import numpy as np
+
+    from distributed_tensorflow_models_tpu.data import (
+        augment,
+        example_proto,
+        tfrecord,
+    )
+
+    rs = np.random.RandomState(0)
+    paths = []
+    per_shard = n_records // 2
+    for s in range(2):
+        recs = []
+        for i in range(per_shard):
+            img = (rs.rand(src_size, src_size, 3) * 255).astype(np.uint8)
+            recs.append(
+                example_proto.build_example(
+                    {
+                        "image/encoded": [augment.encode_jpeg(img)],
+                        "image/class/label": [1 + (s * per_shard + i) % 1000],
+                    }
+                )
+            )
+        p = os.path.join(tmp, f"train-{s:05d}")
+        tfrecord.write_records(p, recs)
+        paths.append(p)
+    return paths
+
+
+def _run_pipeline(dataset, workers: int, batches: int, warmup: int):
+    """Drain the HostPipeline as fast as possible; return (rate, stream
+    fingerprint, telemetry facts)."""
+    import numpy as np
+
+    from distributed_tensorflow_models_tpu import telemetry
+    from distributed_tensorflow_models_tpu.data import pipeline
+
+    reg = telemetry.MetricsRegistry()
+    pipe = pipeline.HostPipeline(
+        dataset, prefetch=4, num_workers=workers, registry=reg
+    )
+    fingerprint = hashlib.sha256()
+    try:
+        for _ in range(warmup):
+            next(pipe)
+        t0 = time.perf_counter()
+        for _ in range(batches):
+            b = next(pipe)
+            fingerprint.update(np.ascontiguousarray(b["image"]).tobytes())
+            fingerprint.update(np.ascontiguousarray(b["label"]).tobytes())
+        elapsed = time.perf_counter() - t0
+    finally:
+        pipe.stop()
+    snap = reg.snapshot()
+    busy = {
+        k.rsplit("/", 1)[1]: round(v, 3)
+        for k, v in snap.items()
+        if k.startswith(telemetry.WORKER_BUSY + "/")
+    }
+    return {
+        "batches_per_s": round(batches / elapsed, 3),
+        "elapsed_s": round(elapsed, 3),
+        "fingerprint": fingerprint.hexdigest(),
+        "worker_busy": busy,
+        "reassembly_wait_p95_s": round(
+            snap.get(telemetry.REASSEMBLY_WAIT + "/p95_s", 0.0), 5
+        ),
+        "producer_wait_total_s": round(
+            snap.get(telemetry.PRODUCER_WAIT + "/total_s", 0.0), 3
+        ),
+    }
+
+
+def worker_sweep(
+    workers=(1, 2, 4),
+    batches: int = 24,
+    warmup: int = 4,
+    batch_size: int = 8,
+    image_size: int = 96,
+    fetch_ms: float = 20.0,
+):
+    from distributed_tensorflow_models_tpu.data import datasets
+
+    result = {
+        "timestamp": datetime.now(timezone.utc).isoformat(),
+        "host": {
+            "platform": sys.platform,
+            "cpu_count": os.cpu_count(),
+            "usable_cores": len(os.sched_getaffinity(0))
+            if hasattr(os, "sched_getaffinity")
+            else os.cpu_count(),
+        },
+        "config": {
+            "source": "synthetic 160x160 JPEG TFRecord shards (64 records)",
+            "pipeline": "ImageNetTFRecordDataset train=True "
+            f"image_size={image_size} batch_size={batch_size}",
+            "batches_timed": batches,
+            "warmup_batches": warmup,
+            "fetch_ms": fetch_ms,
+        },
+        "profiles": {},
+        "notes": [
+            "decode: pure-CPU JPEG decode + inception train augment; "
+            "worker threads scale with FREE HOST CORES only (PIL/cv2/"
+            "NumPy release the GIL during the heavy kernels).",
+            f"decode_fetch: each batch additionally blocks {fetch_ms}ms "
+            "in the worker before decode, modeling remote-storage record "
+            "fetch on real TPU input hosts; the pool overlaps fetch with "
+            "decode, so this profile shows the pool's gain even on a "
+            "single-core container.",
+            "streams_bit_identical asserts the sha256 of the full "
+            "emitted (image, label) stream matches across all worker "
+            "counts — the determinism contract, measured not assumed.",
+        ],
+    }
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = _build_shards(tmp)
+
+        def fresh(fetch_s: float):
+            ds = datasets.ImageNetTFRecordDataset(
+                paths,
+                batch_size,
+                train=True,
+                image_size=image_size,
+                label_offset=1,
+                seed=17,
+            )
+            return _FetchLatencyDataset(ds, fetch_s) if fetch_s else ds
+
+        for profile, fetch_s in (
+            ("decode", 0.0),
+            ("decode_fetch", fetch_ms / 1e3),
+        ):
+            rows = {}
+            for w in workers:
+                rows[str(w)] = _run_pipeline(
+                    fresh(fetch_s), w, batches, warmup
+                )
+            base = rows[str(workers[0])]["batches_per_s"]
+            fps = {r["fingerprint"] for r in rows.values()}
+            for r in rows.values():
+                r["speedup_vs_w1"] = round(r["batches_per_s"] / base, 3)
+                del r["fingerprint"]
+            result["profiles"][profile] = {
+                "streams_bit_identical": len(fps) == 1,
+                "by_workers": rows,
+            }
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(here, "data_probe_workers.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result, indent=1))
+    return result
+
+
 def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--worker-sweep",
+        action="store_true",
+        help="bench HostPipeline producer throughput at data_workers "
+        "∈ {1,2,4} instead of probing dataset availability",
+    )
+    ap.add_argument("--batches", type=int, default=24)
+    ap.add_argument("--fetch-ms", type=float, default=20.0)
+    args = ap.parse_args()
+    if args.worker_sweep:
+        worker_sweep(batches=args.batches, fetch_ms=args.fetch_ms)
+        return
+
     result = probe()
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, "data_probe.json"), "w") as f:

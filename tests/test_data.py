@@ -394,6 +394,221 @@ def test_host_pipeline_propagates_errors():
         next(pipe)
 
 
+def test_host_pipeline_worker_count_invariance():
+    """The pool contract: the emitted stream is bit-identical for any
+    data_workers — ImageNet-synthetic (plain slicing) and CIFAR train
+    (per-sample augmentation, rngs keyed by global sample position)."""
+    builders = {
+        "imagenet_synthetic": lambda: datasets.synthetic_imagenet_dataset(
+            8, image_size=32, seed=7
+        ),
+        "cifar_augmented": lambda: datasets.cifar10_dataset(
+            8, "train", seed=3
+        ),
+    }
+    for name, fresh in builders.items():
+        ref_it = iter(fresh())
+        ref = [next(ref_it) for _ in range(10)]
+        for workers in (1, 4):
+            pipe = pipeline.HostPipeline(
+                fresh(), prefetch=2, num_workers=workers
+            )
+            got = [next(pipe) for _ in range(10)]
+            state = pipe.get_state()
+            pipe.stop()
+            for a, b in zip(got, ref):
+                np.testing.assert_array_equal(
+                    a["image"], b["image"], err_msg=f"{name} w={workers}"
+                )
+                np.testing.assert_array_equal(a["label"], b["label"])
+            # State follows the last released batch regardless of pool
+            # width: position 10, exactly where the serial path stands.
+            assert state == {"epoch": 0, "batch_idx": 10}, (name, workers)
+
+
+def test_host_pipeline_worker_pool_tfrecord_decode(tmp_path):
+    """The decode-bound path through the pool: TFRecord shards → JPEG
+    decode + distorted-bbox augment in parallel workers, stream and
+    resume state identical to the serial iterator."""
+    rs = np.random.RandomState(1)
+    recs = []
+    for i in range(12):
+        img = (rs.rand(40, 40, 3) * 255).astype(np.uint8)
+        recs.append(
+            example_proto.build_example(
+                {
+                    "image/encoded": [augment.encode_jpeg(img)],
+                    "image/class/label": [1 + i % 10],
+                }
+            )
+        )
+    p = str(tmp_path / "train-00000")
+    tfrecord.write_records(p, recs)
+
+    def fresh():
+        return datasets.ImageNetTFRecordDataset(
+            [p], 4, train=True, image_size=32, label_offset=1, seed=11
+        )
+
+    ref_it = iter(fresh())
+    ref = [next(ref_it) for _ in range(5)]  # loops epochs past 12 records
+
+    pipe = pipeline.HostPipeline(fresh(), prefetch=2, num_workers=2)
+    got = [next(pipe) for _ in range(5)]
+    state = pipe.get_state()
+    pipe.stop()
+    for a, b in zip(got, ref):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+    # Resume from the pool-produced state = the serial continuation.
+    ds2 = fresh()
+    ds2.set_state(state)
+    b_resume = next(iter(ds2))
+    b_expect = next(ref_it)
+    np.testing.assert_array_equal(b_resume["image"], b_expect["image"])
+    np.testing.assert_array_equal(b_resume["label"], b_expect["label"])
+
+
+class _ExplodingDataset:
+    """Pool-protocol dataset whose assemble fails at one index; earlier
+    items finish deliberately out of order (later index = faster)."""
+
+    def __init__(self, boom_at=3):
+        self._i = 0
+        self._boom_at = boom_at
+
+    def next_work(self):
+        w = self._i
+        self._i += 1
+        return w
+
+    def assemble(self, w):
+        if w == self._boom_at:
+            raise RuntimeError(f"boom at {w}")
+        import time
+
+        time.sleep(0.005 * (self._boom_at + 1 - min(w, self._boom_at)))
+        return {"x": np.full((2,), w, np.float32)}
+
+    def get_state(self):
+        return {"i": self._i}
+
+
+def test_host_pipeline_pool_error_surfaces_at_position():
+    """Coordinator contract under the pool: every good batch before the
+    failure index drains in order, THEN the error raises."""
+    pipe = pipeline.HostPipeline(
+        _ExplodingDataset(boom_at=3), prefetch=4, num_workers=4
+    )
+    got = []
+    with pytest.raises(RuntimeError, match="boom at 3"):
+        for _ in range(10):
+            got.append(float(next(pipe)["x"][0]))
+    assert got == [0.0, 1.0, 2.0]
+    pipe.stop()  # error already consumed: must not re-raise
+
+
+def test_host_pipeline_stop_raises_pending_error():
+    """stop() must not silently drop a producer error the consumer never
+    reached (the old pipeline.py:129-138 behavior)."""
+    import time
+
+    pipe = pipeline.HostPipeline(
+        _ExplodingDataset(boom_at=2), prefetch=4, num_workers=2
+    )
+    assert float(next(pipe)["x"][0]) == 0.0
+    for _ in range(200):  # wait for the failure to reach reassembly
+        if pipe._error is not None:
+            break
+        time.sleep(0.01)
+    with pytest.raises(RuntimeError, match="boom at 2"):
+        pipe.stop()
+
+
+def test_host_pipeline_stop_finds_error_still_in_flight():
+    """A failure a worker produced but reassembly never walked past
+    (blocked on a full consumer buffer) must still surface from stop()
+    — swept from the in-flight queues, not silently dropped."""
+    import time
+
+    # prefetch=1 and no consumption: reassembly releases batch 0, blocks
+    # on the full buffer; the failure at index 2 stays in flight.
+    pipe = pipeline.HostPipeline(
+        _ExplodingDataset(boom_at=2), prefetch=1, num_workers=2
+    )
+    for _ in range(200):  # wait until the failing assemble has run
+        with pipe._results_q.mutex:
+            in_q = any(
+                isinstance(p, pipeline._Failure)
+                for _, p, _ in list(pipe._results_q.queue)
+            )
+        in_pending = any(
+            isinstance(p, pipeline._Failure)
+            for p, _ in list(pipe._pending.values())
+        )
+        if in_q or in_pending or pipe._error is not None:
+            break
+        time.sleep(0.01)
+    with pytest.raises(RuntimeError, match="boom at 2"):
+        pipe.stop()
+
+
+def test_host_pipeline_pool_winds_down_after_error():
+    """After a mid-stream producer error the pool must stop feeding the
+    results queue (an infinite dataset would otherwise free-run into
+    unbounded memory while the consumer drains toward the error)."""
+    import time
+
+    pipe = pipeline.HostPipeline(
+        _ExplodingDataset(boom_at=2), prefetch=4, num_workers=2
+    )
+    with pytest.raises(RuntimeError, match="boom at 2"):
+        for _ in range(10):
+            next(pipe)
+    assert pipe._pool_stop.wait(timeout=2.0)
+    for t in pipe._threads:
+        t.join(timeout=2.0)
+    assert not any(t.is_alive() for t in pipe._threads)
+    assert pipe._results_q.qsize() <= 8  # bounded in-flight, not free-run
+    pipe.stop()
+
+
+def test_host_pipeline_pool_falls_back_without_protocol():
+    """A plain iterable (no next_work/assemble) with num_workers>1 warns
+    and degrades to the serial producer — never breaks."""
+
+    def gen():
+        for i in range(4):
+            yield {"x": np.full((2,), i, np.float32)}
+
+    pipe = pipeline.HostPipeline(gen(), prefetch=2, num_workers=4)
+    got = [float(next(pipe)["x"][0]) for _ in range(4)]
+    assert got == [0.0, 1.0, 2.0, 3.0]
+    with pytest.raises(StopIteration):
+        next(pipe)
+    pipe.stop()
+
+
+def test_host_queue_depth_reads_zero_when_drained():
+    """The gauge is sampled on the consumer side too: after the stream is
+    fully drained it must read 0, not the last produced depth."""
+    from distributed_tensorflow_models_tpu import telemetry
+
+    def gen():
+        for i in range(3):
+            yield {"x": np.full((2,), i, np.float32)}
+
+    reg = telemetry.MetricsRegistry()
+    pipe = pipeline.HostPipeline(gen(), prefetch=4, registry=reg)
+    for _ in range(3):
+        next(pipe)
+    with pytest.raises(StopIteration):
+        next(pipe)
+    assert reg.gauge(telemetry.HOST_QUEUE_DEPTH).value == 0.0
+    pipe.stop()
+
+
 def test_device_prefetcher(mesh8):
     x = np.arange(64, dtype=np.float32).reshape(8, 8)
     y = np.arange(8, dtype=np.int32)

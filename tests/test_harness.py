@@ -441,6 +441,41 @@ def test_fused_loop_host_overhead_drops_k_fold(mesh8, tmp_path):
     assert wk * K <= w1, (wk, w1)
 
 
+def _pipeline_threads():
+    import threading
+
+    return [
+        t.name
+        for t in threading.enumerate()
+        if t.is_alive()
+        and t.name.startswith(("host-pipeline", "data-worker"))
+    ]
+
+
+def test_fit_leaves_no_pipeline_threads(mesh8, tmp_path):
+    """Tier-1 thread-leak guard: after fit() returns — normal end AND the
+    abort path — every host-pipeline / data-worker-* thread is joined.
+    Run with a worker pool so the guard covers dispatcher + workers +
+    reassembly, not just the single serial producer."""
+    cfg = _small_cfg(train_steps=2, data_workers=2)
+    trainlib.fit(cfg, str(tmp_path / "ok"), mesh=mesh8)
+    assert _pipeline_threads() == []
+
+    class Poison(hooklib.Hook):
+        def after_step(self, state, metrics, step):
+            if step == 1:
+                raise FloatingPointError("injected abort")
+
+    with pytest.raises(FloatingPointError):
+        trainlib.fit(
+            cfg,
+            str(tmp_path / "abort"),
+            mesh=mesh8,
+            extra_hooks=[Poison()],
+        )
+    assert _pipeline_threads() == []
+
+
 def test_recoverable_fit_survives_injected_fault(mesh8, tmp_path):
     """_RecoverableSession semantics (TF monitored_session.py:1261-1274):
     a preemption-class failure mid-training restarts from the latest

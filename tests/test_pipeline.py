@@ -178,6 +178,47 @@ def test_batch_stacker_state_resumes_at_next_unconsumed_batch(mesh8):
     np.testing.assert_array_equal(resumed["label"], expect["label"])
 
 
+def test_full_stack_kill_resume_with_worker_pool(mesh8):
+    """Mid-stream kill/resume through the full HostPipeline(pool) →
+    DevicePrefetcher → BatchStacker stack: the state captured after a
+    chunk restores the exact next unconsumed batch, at ANY worker count
+    (producer parallelism must never skip or replay batches)."""
+    x = np.arange(80, dtype=np.float32).reshape(80, 1)
+    y = np.arange(80, dtype=np.int32)
+
+    def fresh():
+        return datasets.ArrayDataset({"image": x, "label": y}, 8, seed=9)
+
+    host = datapipe.HostPipeline(fresh(), prefetch=2, num_workers=4)
+    pre = datapipe.DevicePrefetcher(host, mesh8, depth=2)
+    stacker = datapipe.BatchStacker(pre)
+    chunk, n = stacker.next_chunk(3)
+    assert n == 3
+    state = stacker.get_state()
+    host.stop()  # kill mid-stream: prefetched/in-flight batches dropped
+
+    # Resume with a DIFFERENT worker count: same continuation.
+    ds2 = fresh()
+    ds2.set_state(state)
+    host2 = datapipe.HostPipeline(ds2, prefetch=2, num_workers=2)
+    pre2 = datapipe.DevicePrefetcher(host2, mesh8, depth=2)
+    chunk2, n2 = datapipe.BatchStacker(pre2).next_chunk(2)
+    assert n2 == 2
+    host2.stop()
+
+    ref_it = iter(fresh())
+    for _ in range(3):
+        next(ref_it)  # the three consumed batches
+    for i in range(2):
+        expect = next(ref_it)
+        np.testing.assert_array_equal(
+            np.asarray(chunk2["label"][i]), expect["label"]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(chunk2["image"][i]), expect["image"]
+        )
+
+
 def test_pipeline_trains(pipe_mesh, setup):
     """A few SGD steps through the pipelined loss must reduce it."""
     params, x = setup
