@@ -579,6 +579,36 @@ def make_train_step_fn(
     return step_fn
 
 
+def state_is_finite(state: TrainState) -> bool:
+    """True when every float leaf of the *trajectory-carrying* state —
+    params, batch_stats, carry, opt_state, EMA shadows — is finite: the
+    rollback path's checkpoint-candidate gate (``nan_policy="rollback"``).
+    A checkpoint saved after divergence began must not be restored as a
+    rollback target, or the retry replays the poison
+    ``rollback_budget`` times; opt_state matters as much as params (an
+    inf Adam second moment zeroes its update, leaving params finite
+    while the optimizer is already poisoned).  One reduction per leaf,
+    one scalar sync total — cheap enough for the (rare) rollback path,
+    never on the hot path."""
+    leaves = [
+        leaf
+        for tree in (
+            state.params,
+            state.batch_stats,
+            state.carry,
+            state.opt_state,
+            state.ema_params,
+        )
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+    ]
+    if not leaves:
+        return True
+    return bool(
+        jnp.all(jnp.stack([jnp.all(jnp.isfinite(leaf)) for leaf in leaves]))
+    )
+
+
 def make_eval_step(
     apply_fn: Callable, use_ema: bool = True
 ) -> Callable[[TrainState, Batch], dict]:

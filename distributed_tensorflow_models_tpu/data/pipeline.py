@@ -379,12 +379,19 @@ class HostPipeline:
         """Producer state as of the last *consumed* batch (resume-exact)."""
         return self._state
 
-    def stop(self) -> None:
+    def stop(self, raise_pending: bool = True) -> None:
         """Cooperative stop — ``Coordinator.request_stop`` + ``join``
         (TF coordinator.py:181,318).  Like ``Coordinator.join``, a stored
         producer error that never reached the consumer is re-raised here
         (after the threads are down) rather than silently dropped, and a
-        thread that outlives the join timeout is reported."""
+        thread that outlives the join timeout is reported.
+
+        ``raise_pending=False`` downgrades that re-raise to a warning —
+        for callers tearing the pipeline down because they are about to
+        *abandon this stream position anyway* (the divergence-rollback
+        path rebuilds the pipeline at the restored cursor), where an
+        in-flight producer error from the doomed lookahead must not mask
+        the recovery in progress."""
         self._stop_event.set()
         while True:  # drain so the producer unblocks
             try:
@@ -420,6 +427,13 @@ class HostPipeline:
                 self._error = min(failures, key=lambda f: f[0])[1].error
         if self._error is not None and not self._error_raised:
             self._error_raised = True
+            if not raise_pending:
+                log.warning(
+                    "host pipeline stopped with pending producer error "
+                    "(suppressed by caller): %r",
+                    self._error,
+                )
+                return
             log.error(
                 "host pipeline stopped with pending producer error: %r",
                 self._error,
@@ -461,10 +475,22 @@ class DevicePrefetcher:
         self._state: Optional[dict] = (
             iterator.get_state() if hasattr(iterator, "get_state") else None
         )
+        # An upstream error caught while *refilling* is deferred until the
+        # buffered good batches have drained, then raised at the pull that
+        # actually needs the failed position.  Raising it from the refill
+        # inside __next__ would lose the batch just popped (and advance
+        # ``_state`` past it) — a crash-time checkpoint would then resume
+        # one batch ahead of what was trained, silently skipping data.
+        self._pending_error: Optional[BaseException] = None
+        self._exhausted = False
         self._fill()
 
     def _fill(self) -> None:
         reg = self._registry
+        if self._pending_error is not None or self._exhausted:
+            # The upstream already ended (error or clean stop); pulling
+            # again would block on the host pipeline's drained buffer.
+            return
         while len(self._buf) < self._depth:
             # Fill stall: time blocked on the upstream (host) stream.  A
             # fat p95 here is the data-stall smoking gun — the host
@@ -473,6 +499,23 @@ class DevicePrefetcher:
             try:
                 batch = next(self._it)
             except StopIteration:
+                self._exhausted = True
+                return
+            except (KeyboardInterrupt, SystemExit):
+                # Hard aborts (second ctrl-C, watchdog escalation) must
+                # act NOW — deferring one would train through buffered
+                # batches first, or drop it entirely if the run ends.
+                raise
+            except BaseException as e:  # surfaces after the buffer drains
+                # Loud at deferral time: if the run ends (train_steps
+                # reached) before draining to the failed position, this
+                # line is the error's only trace — the host pipeline
+                # already counts it raised, so stop() won't re-raise.
+                log.error(
+                    "upstream pipeline error deferred until buffered "
+                    "batches drain: %r", e,
+                )
+                self._pending_error = e
                 return
             reg.timer(telemetry.PREFETCH_FILL).record(
                 time.perf_counter() - t0
@@ -490,6 +533,9 @@ class DevicePrefetcher:
 
     def __next__(self) -> PyTree:
         if not self._buf:
+            if self._pending_error is not None:
+                error, self._pending_error = self._pending_error, None
+                raise error
             raise StopIteration
         out, state = self._buf.pop(0)
         self._state = state

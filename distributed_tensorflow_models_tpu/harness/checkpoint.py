@@ -37,10 +37,18 @@ import orbax.checkpoint as ocp
 
 from distributed_tensorflow_models_tpu import telemetry
 from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.resilience import fsck as fscklib
 
 log = logging.getLogger("dtm")
 
 PyTree = Any
+
+
+class NoValidCheckpointError(FileNotFoundError):
+    """Checkpoints exist but every candidate is torn/unrestorable.
+    Distinct from the bare ``FileNotFoundError`` ("no checkpoint found")
+    so ``restore_or_init`` can fall back to a fresh init with a loud
+    warning instead of crashing the job at recovery time."""
 
 
 def _array_tree(state: TrainState) -> dict:
@@ -74,7 +82,11 @@ class CheckpointManager:
         self._registry = (
             registry if registry is not None else telemetry.get_registry()
         )
-        self._dir = f"{workdir}/checkpoints"
+        # Absolute path required: orbax's async tensorstore writer rejects
+        # relative paths at SAVE time ("Checkpoint path should be
+        # absolute") — i.e. a relative --workdir would train fine and then
+        # fail at the first checkpoint, losing the run.
+        self._dir = os.path.abspath(os.path.join(workdir, "checkpoints"))
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -102,6 +114,48 @@ class CheckpointManager:
         force: bool = False,
     ) -> bool:
         step = int(state.step)
+        # Known multi-host limitation: the skip/replace decision below
+        # reads the shared checkpoint dir per-process.  Orbax saves are
+        # collective, so on storage with cross-host visibility skew
+        # (e.g. object stores) processes could in principle decide
+        # differently and de-sync the save; the fix, if skew is ever
+        # observed, is a chief-decides broadcast like CheckpointHook's
+        # clock poll.  Same-filesystem fleets (and every drill here)
+        # see one consistent view.
+        if step in self._mgr.all_steps():
+            step_dir = self._step_dir(step)
+            if not os.path.isdir(step_dir):
+                # Listed but no finalized dir yet: an in-flight async
+                # save of this very step (orbax registers the step while
+                # still writing the tmp dir).  It IS this state —
+                # deterministic in step — so skip; deleting/overwriting
+                # would corrupt the write in progress.
+                log.info(
+                    "checkpoint at step %d is still being written; "
+                    "skipping duplicate save", step,
+                )
+                return False
+            if not fscklib.validate_step_dir(step_dir):
+                # Idempotent by construction: training is deterministic
+                # in step, so a VALID checkpoint for this step IS this
+                # state.  Orbax raises StepAlreadyExistsError here
+                # (force=True included), which would turn e.g. a
+                # preemption's emergency save at a boundary the cadence
+                # save just wrote into a crash.
+                log.info(
+                    "checkpoint at step %d already exists; skipping save",
+                    step,
+                )
+                return False
+            # A FINALIZED dir that fails validation is damage, not a
+            # checkpoint — treating it as one would silently suppress a
+            # real save (e.g. the emergency save "succeeding" while
+            # resume walks back past the damage).  Replace it.
+            log.warning(
+                "existing checkpoint at step %d is torn; replacing it",
+                step,
+            )
+            self.delete(step)
         # The span covers the *blocking* portion only — orbax finishes the
         # write async; the remainder lands in checkpoint/wait when
         # wait()/close() blocks on durability.  Goodput sums both.
@@ -140,16 +194,110 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list[int]:
+        """Ascending retained steps (rollback and fsck candidates)."""
+        return sorted(self._mgr.all_steps())
+
+    def delete(self, step: int) -> None:
+        """Remove one retained step (best-effort).  The rollback path
+        deletes the abandoned timeline's checkpoints after rewinding —
+        they hold post-divergence state that must never be restored, and
+        their steps will be re-saved by the replay."""
+        try:
+            self._mgr.delete(step)
+        except Exception:  # noqa: BLE001 — stale steps are non-fatal
+            log.exception("failed to delete checkpoint step %d", step)
+
+    @property
+    def directory(self) -> str:
+        """The orbax checkpoint root (``<workdir>/checkpoints``)."""
+        return self._dir
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._dir, str(step))
+
     def restore(
         self, template: TrainState, step: Optional[int] = None
     ) -> tuple[TrainState, dict]:
         """Restore into the structure of ``template`` (a freshly-created
         state — supplies static fields and the pytree layout).  Returns the
-        restored state and the dataset iterator state dict."""
+        restored state and the dataset iterator state dict.
+
+        With ``step=None`` (the auto-resume path) candidates are validated
+        structurally (``resilience/fsck.py`` — orbax completeness markers)
+        and restore *walks back* to the newest valid step instead of
+        crashing on a torn write; a candidate that passes validation but
+        still fails orbax restore (damage the structural check can't see)
+        is likewise skipped with a warning.  An explicit ``step`` is taken
+        at its word and restored directly — callers naming a step want
+        that step or the error.
+
+        No finiteness gate here: eval/generate restore through this path
+        and must see the newest checkpoint even if e.g. its opt_state
+        diverged (they read only params/EMA).  The *training* resume
+        path adds the gate in :func:`restore_or_init`."""
         if step is None:
-            step = self.latest_step()
-        if step is None:
+            return self.restore_newest_valid(template)
+        return self._restore_step(template, step)
+
+    def restore_newest_valid(
+        self,
+        template: TrainState,
+        accept=None,
+        accept_name: str = "",
+    ) -> tuple[TrainState, dict]:
+        """Walk candidate steps newest-first, skipping torn (structural
+        validation), unrestorable, and — when ``accept(state)`` is given
+        — rejected candidates (the rollback path passes a finiteness
+        gate).  Raises :class:`NoValidCheckpointError` when nothing
+        survives.  (Same multi-host caveat as :meth:`save`: the walk
+        validates per-process; cross-host storage visibility skew could
+        pick different steps on different hosts — chief-decides
+        broadcast is the upgrade path if that is ever observed.)"""
+        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        if not candidates:
             raise FileNotFoundError("no checkpoint found")
+        last_error: Optional[BaseException] = None
+        for i, step in enumerate(candidates):
+            issues = fscklib.validate_step_dir(self._step_dir(step))
+            if issues:
+                log.warning(
+                    "checkpoint step %d fails validation (%s); walking "
+                    "back to an earlier step (scripts/fsck_checkpoints.py "
+                    "reports and can --repair)",
+                    step, "; ".join(issues),
+                )
+                continue
+            try:
+                out = self._restore_step(template, step)
+            except Exception as e:  # noqa: BLE001 — damage fsck can't see
+                last_error = e
+                log.warning(
+                    "checkpoint step %d passed validation but failed to "
+                    "restore (%s); walking back", step, e,
+                )
+                continue
+            if accept is not None and not accept(out[0]):
+                log.warning(
+                    "checkpoint step %d rejected (%s); walking back",
+                    step, accept_name or "accept predicate",
+                )
+                continue
+            if i > 0:
+                log.warning(
+                    "restored step %d instead of the newest step %d "
+                    "(newer candidates torn/unrestorable/rejected)",
+                    step, candidates[0],
+                )
+            return out
+        raise NoValidCheckpointError(
+            f"no valid checkpoint among steps {candidates} under "
+            f"{self._dir}"
+        ) from last_error
+
+    def _restore_step(
+        self, template: TrainState, step: int
+    ) -> tuple[TrainState, dict]:
         abstract = jax.tree.map(
             ocp.utils.to_shape_dtype_struct, _array_tree(template)
         )
@@ -174,13 +322,22 @@ class CheckpointManager:
         if self._nproc > 1:
             path = self._sidecar(step)
             wrapped = None
+            missing_why = "no per-process dataset sidecar"
             if os.path.exists(path):
-                with open(path) as f:
-                    wrapped = json.load(f)
+                # A truncated/unparseable sidecar (torn write at
+                # preemption time) must degrade to the primary's
+                # position exactly like a missing one — never kill the
+                # job at restore time over an *auxiliary* file.
+                try:
+                    with open(path) as f:
+                        wrapped = json.load(f)
+                except (OSError, ValueError) as e:
+                    missing_why = f"dataset sidecar is unreadable ({e})"
             if wrapped is None:
                 log.warning(
-                    "no per-process dataset sidecar at %s; using the "
-                    "primary's position (approximate resume)",
+                    "%s at %s; using the primary's position (approximate "
+                    "resume)",
+                    missing_why,
                     path,
                 )
             elif "nproc" not in wrapped:
@@ -216,9 +373,38 @@ def restore_or_init(
     """``SessionManager.prepare_session`` semantics (TF
     session_manager.py:259): restore the latest checkpoint when one exists,
     otherwise return the fresh ``template``.  Returns
-    ``(state, dataset_state, restored)``."""
+    ``(state, dataset_state, restored)``.
+
+    When checkpoints exist but every candidate is torn (restore
+    hardening found no valid step), training starts fresh with a loud
+    warning — for auto-resume, re-training from scratch is strictly
+    better than a job that can never start again until a human deletes
+    the damage.
+
+    Training resume additionally gates candidates on finiteness: a
+    crash-time save after a NaN trip (CheckpointHook.abort) is
+    structurally valid but poisoned — without the gate it becomes the
+    newest checkpoint and every rerun restores NaN and dies, bricking
+    the workdir.  (Eval/generate restore via ``manager.restore`` and
+    stay ungated — they read only params/EMA.)"""
     if manager.latest_step() is None:
         return template, {}, False
-    state, data = manager.restore(template)
+    from distributed_tensorflow_models_tpu.core.train_loop import (
+        state_is_finite,
+    )
+
+    try:
+        state, data = manager.restore_newest_valid(
+            template,
+            accept=state_is_finite,
+            accept_name="non-finite state (post-divergence save)",
+        )
+    except NoValidCheckpointError as e:
+        log.error(
+            "checkpoints exist but none are restorable (%s); "
+            "initializing fresh — run scripts/fsck_checkpoints.py "
+            "--repair to clear the torn steps", e,
+        )
+        return template, {}, False
     log.info("restored checkpoint at step %d", int(state.step))
     return state, data, True

@@ -22,6 +22,15 @@ import logging
 import sys
 
 
+def _parse_chaos(text: str) -> dict:
+    """argparse ``type=`` for --chaos: a ValueError here becomes a clean
+    usage error naming the bad key/value (lazy import keeps CLI startup
+    light)."""
+    from distributed_tensorflow_models_tpu.resilience import chaos
+
+    return chaos.parse_chaos_spec(text)
+
+
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--config", required=True, help="config name (see `list`)")
     p.add_argument("--workdir", required=True, help="checkpoint/metrics dir")
@@ -79,6 +88,46 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "that default fused",
     )
     p.add_argument(
+        "--nan-policy", choices=("abort", "rollback"), default=None,
+        help="divergence policy: abort (default — non-finite loss kills "
+        "the run) or rollback (restore the last finite checkpoint, skip "
+        "exactly the offending chunk's batches, retry under "
+        "--rollback-budget; README 'Robustness')",
+    )
+    p.add_argument(
+        "--rollback-budget", type=int, default=None,
+        help="max nan_policy=rollback rewinds per run (default 3)",
+    )
+    p.add_argument(
+        "--watchdog-timeout-s", type=float, default=None,
+        help="step-progress watchdog: warn (ERROR log + "
+        "train/watchdog_last_progress_s gauge) when no chunk completes "
+        "within this many seconds — a hung collective or pipeline "
+        "deadlock produces a diagnosis instead of a silent stall",
+    )
+    p.add_argument(
+        "--watchdog-abort", action=argparse.BooleanOptionalAction,
+        default=None,
+        help="escalate a persistent stall (2+ watchdog timeout "
+        "intervals, after at least one chunk has completed) to an "
+        "abort attempt instead of warnings only",
+    )
+    p.add_argument(
+        "--preempt-poll-steps", type=int, default=None,
+        help="multi-host preemption-notice poll cadence in steps (the "
+        "poll is a collective; default 20).  Keep poll_steps x step_time "
+        "inside the fleet's SIGTERM grace window or the emergency "
+        "checkpoint never runs; single-process runs poll every chunk "
+        "boundary and ignore this",
+    )
+    p.add_argument(
+        "--chaos", type=_parse_chaos, default=None, metavar="K=V[,K=V...]",
+        help="deterministic fault injection (testing/drills; off by "
+        "default): pipeline_fail_at_batch, nan_at_step, "
+        "torn_checkpoint_at_step, sigterm_at_step — e.g. "
+        "--chaos 'nan_at_step=50' (resilience/chaos.py)",
+    )
+    p.add_argument(
         "--multihost", action="store_true",
         help="initialize jax.distributed (multi-host SPMD)",
     )
@@ -96,6 +145,18 @@ def _overrides(args) -> dict:
         out["steps_per_loop"] = args.steps_per_loop
     if getattr(args, "data_workers", None) is not None:
         out["data_workers"] = args.data_workers
+    if getattr(args, "nan_policy", None) is not None:
+        out["nan_policy"] = args.nan_policy
+    if getattr(args, "rollback_budget", None) is not None:
+        out["rollback_budget"] = args.rollback_budget
+    if getattr(args, "watchdog_timeout_s", None) is not None:
+        out["watchdog_timeout_s"] = args.watchdog_timeout_s
+    if getattr(args, "watchdog_abort", None) is not None:
+        out["watchdog_abort"] = args.watchdog_abort
+    if getattr(args, "preempt_poll_steps", None) is not None:
+        out["preempt_poll_steps"] = args.preempt_poll_steps
+    if getattr(args, "chaos", None) is not None:
+        out["chaos"] = args.chaos
     for attr, key in (
         ("mesh_model", "mesh_model"),
         ("mesh_seq", "mesh_seq"),
@@ -214,7 +275,19 @@ def main(argv: list[str] | None = None) -> int:
         from distributed_tensorflow_models_tpu.harness import train as trainlib
 
         result = trainlib.recoverable_fit(cfg, args.workdir)
-        print(json.dumps({"final_metrics": result.final_metrics}))
+        print(
+            json.dumps(
+                {
+                    "final_metrics": result.final_metrics,
+                    "preempted": result.preempted,
+                }
+            )
+        )
+        if result.preempted:
+            # Preemption grace: the run checkpointed and stopped early.
+            # Exit with the resumable code (EX_TEMPFAIL) so wrappers —
+            # including launch.py — distinguish "rerun me" from failure.
+            return launchlib.RESUMABLE_EXIT_CODE
         return 0
 
     if args.cmd == "generate":

@@ -134,6 +134,35 @@ class ExperimentConfig:
     log_every_steps: int = 100
     checkpoint_every_secs: float = 600.0
     keep_checkpoints: int = 5
+    # Divergence policy (harness/train.py::fit).  "abort" = the reference
+    # NanTensorHook behavior: a non-finite loss kills the run.  "rollback"
+    # = restore the last finite checkpoint, advance the dataset cursor
+    # exactly past the offending chunk (skip logged + counted as
+    # train/skipped_batches), and retry — at most ``rollback_budget``
+    # times per run, then abort.  README "Robustness".
+    nan_policy: str = "abort"  # abort | rollback
+    rollback_budget: int = 3
+    # Step-progress watchdog (resilience/watchdog.py): warn when no chunk
+    # completes within this many seconds (None = off); with
+    # ``watchdog_abort`` the stall escalates to an abort attempt from the
+    # second timeout interval on.  Live gauge:
+    # train/watchdog_last_progress_s.
+    watchdog_timeout_s: Optional[float] = None
+    watchdog_abort: bool = False
+    # Multi-host preemption-notice poll cadence (steps): the SIGTERM flag
+    # is allgathered every this-many steps so all processes enter the
+    # emergency checkpoint together (the poll is a collective — it cannot
+    # run at every step for free).  Budget rule: poll_steps x step_time
+    # must fit inside the fleet's preemption grace window, or the SIGKILL
+    # lands before the flag is ever observed — lower it for slow-step
+    # runs.  Single-process runs check the flag at every chunk boundary
+    # and ignore this.
+    preempt_poll_steps: int = 20
+    # Deterministic chaos injection (resilience/chaos.py) — OFF when
+    # empty.  Keys: pipeline_fail_at_batch, nan_at_step,
+    # torn_checkpoint_at_step, sigterm_at_step (ints; each fires at most
+    # once per process per workdir).  CLI: --chaos "nan_at_step=50,...".
+    chaos: dict[str, Any] = dataclasses.field(default_factory=dict)
     eval_every_steps: Optional[int] = None
     eval_batches: Optional[int] = None
     seed: int = 0

@@ -342,6 +342,9 @@ class TelemetryHook(Hook):
     - ``compile_count`` / ``compile_s`` — cumulative compile events
     - ``checkpoint_s``   — cumulative blocking checkpoint time
     - ``host_queue_depth`` — producer buffer depth right now
+    - ``restarts`` / ``rollbacks`` / ``skipped_batches`` — resilience
+      counters (recoverable_fit restarts; nan_policy=rollback rewinds
+      and the batches their skips discarded)
 
     Multi-host: steps/sec and stall fraction are allgathered
     (``multihost_utils.process_allgather`` — a collective, so the hook
@@ -426,6 +429,14 @@ class TelemetryHook(Hook):
                 + snap.get(f"{telemetry.CKPT_WAIT}/total_s", 0.0)
             ),
             "host_queue_depth": snap.get(telemetry.HOST_QUEUE_DEPTH, 0.0),
+            # Resilience counters (always the three together — the schema
+            # lint checks them as a set): cumulative within this fit
+            # attempt; a recoverable_fit restart resets rollbacks/
+            # skipped_batches and bumps restarts (fresh per-run registry,
+            # seeded with the attempt count).
+            "restarts": snap.get(telemetry.RESTARTS, 0.0),
+            "rollbacks": snap.get(telemetry.ROLLBACKS, 0.0),
+            "skipped_batches": snap.get(telemetry.SKIPPED_BATCHES, 0.0),
         }
         if self._nproc > 1:
             from jax.experimental import multihost_utils

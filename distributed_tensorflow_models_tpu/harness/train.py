@@ -27,7 +27,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from distributed_tensorflow_models_tpu import telemetry
+from distributed_tensorflow_models_tpu import resilience, telemetry
 from distributed_tensorflow_models_tpu.core import mesh as meshlib
 from distributed_tensorflow_models_tpu.core import train_loop
 from distributed_tensorflow_models_tpu.core.train_state import TrainState
@@ -319,11 +319,48 @@ def _chunk_len(
     return k
 
 
+# Default for ``ExperimentConfig.preempt_poll_steps`` — how often (in
+# steps) multi-host runs agree on the preemption flag: the flag is
+# per-process (the runtime signals every host, but not at the same
+# instant), and the emergency save is a collective, so processes must
+# decide "preempted now" at the same step — the same reasoning as
+# CheckpointHook's clock-broadcast poll.  Single-process runs read the
+# flag directly at every chunk boundary.  Lower it (via the config) when
+# poll_steps x step_time would overrun the fleet's preemption grace
+# window.
+PREEMPT_POLL_STEPS = 20
+
+
+class _PreemptPollHook(hooklib.Hook):
+    """Boundary-alignment only: makes fused chunks end at the multi-host
+    preemption-poll steps so every process runs the poll collective at
+    the same step.  ``after_step`` does nothing — the loop itself polls."""
+
+    def __init__(self, every_steps: int):
+        self._every = every_steps
+
+    def wants_step(self, step):
+        return step % self._every == 0
+
+    def after_step(self, state, metrics, step):
+        pass
+
+
 @dataclasses.dataclass
 class FitResult:
     state: TrainState
     final_metrics: dict
     steps_run: int
+    # Resilience markers (README "Robustness"): ``preempted`` — the run
+    # stopped early at a chunk boundary on a preemption notice
+    # (SIGTERM/SIGINT), after a forced emergency checkpoint; rerunning
+    # the same command resumes it, so callers must treat it as
+    # *resumable*, not failed.  ``rollbacks``/``skipped_batches`` — the
+    # nan_policy="rollback" activity of this run (also exported as the
+    # train/rollbacks and train/skipped_batches counters).
+    preempted: bool = False
+    rollbacks: int = 0
+    skipped_batches: int = 0
 
 
 def fit(
@@ -332,6 +369,8 @@ def fit(
     *,
     extra_hooks: Sequence[hooklib.Hook] = (),
     mesh: Optional[object] = None,
+    restarts: int = 0,
+    listener: Optional[resilience.PreemptionListener] = None,
 ) -> FitResult:
     """Train ``cfg`` to ``cfg.train_steps``, resuming from ``workdir`` if a
     checkpoint exists.  Returns the final (host-fetched) state.
@@ -350,9 +389,40 @@ def fit(
     ``TelemetryHook``; on exit (success *and* failure) the chief writes
     ``<workdir>/telemetry.json`` — the goodput report splitting total wall
     time into compute / data-stall / checkpoint / compile.
+    ``restarts`` seeds the ``train/restarts`` counter (``recoverable_fit``
+    passes its attempt number so the final report carries the cumulative
+    count).
+
+    Resilience (README "Robustness"; mechanisms in ``resilience/``):
+
+    - **Preemption grace** — SIGTERM (or a first SIGINT) sets a flag the
+      loop polls at chunk boundaries; on it, a forced emergency
+      checkpoint (state + dataset sidecars) is written, teardown runs
+      cleanly, and the result carries ``preempted=True`` (resumable).
+      Multi-host, the flag is allgathered every
+      ``cfg.preempt_poll_steps`` steps so the collective save is entered
+      by everyone or no one — keep poll_steps x step_time inside the
+      fleet's preemption grace window.
+    - **Divergence rollback** — ``cfg.nan_policy="rollback"`` turns the
+      NaN guard's ``FloatingPointError`` into: restore the newest
+      *finite* checkpoint, rebuild the input pipeline at its exact
+      cursor, replay, and — when the replay reaches the offending chunk
+      — advance the cursor exactly past its batches (skip counted in
+      ``train/skipped_batches``), bounded by ``cfg.rollback_budget``.
+    - **Watchdog** — ``cfg.watchdog_timeout_s`` starts a progress
+      watchdog diagnosing silent stalls (hung collective / pipeline
+      deadlock) instead of letting them look like slow steps.
+    - **Chaos** — ``cfg.chaos`` (off by default) injects deterministic
+      faults at these exact seams (``resilience/chaos.py``).
     """
+    if cfg.nan_policy not in ("abort", "rollback"):
+        raise ValueError(
+            f"nan_policy must be 'abort' or 'rollback', got {cfg.nan_policy!r}"
+        )
     t_run0 = time.perf_counter()
     registry = telemetry.MetricsRegistry()
+    registry.counter(telemetry.RESTARTS).inc(restarts)
+    chaos = resilience.get_injector(cfg.chaos, seed=cfg.seed, scope=workdir)
     if mesh is None:
         mesh = mesh_from_config(cfg)
     state = build_state(cfg, mesh)
@@ -360,150 +430,456 @@ def fit(
         workdir, keep=cfg.keep_checkpoints, registry=registry
     )
     state, data_state, restored = ckptlib.restore_or_init(manager, state)
-    if restored:
+
+    from distributed_tensorflow_models_tpu.parallel import tensor as tensorlib
+
+    def _place(s: TrainState) -> TrainState:
         # Restored arrays arrive with default placement; re-lay them out on
         # the mesh exactly as the fresh template was — including the
         # tensor-parallel rules, or a resumed TP run would silently come
-        # back fully replicated.
-        from distributed_tensorflow_models_tpu.parallel import (
-            tensor as tensorlib,
+        # back fully replicated.  (Also the rollback path's re-placement.)
+        return train_loop.place_state(
+            s, mesh, tensorlib.get_rules(cfg.param_rules)
         )
 
-        state = train_loop.place_state(
-            state, mesh, tensorlib.get_rules(cfg.param_rules)
-        )
+    if restored:
+        state = _place(state)
 
     dataset = build_dataset(cfg, "train")
     if restored and data_state.get("dataset") and hasattr(dataset, "set_state"):
         dataset.set_state(data_state["dataset"])
+    if chaos is not None:
+        dataset = chaos.wrap_dataset(dataset)
 
-    host = pipelib.HostPipeline(
-        dataset,
-        prefetch=4,
-        num_workers=max(1, int(cfg.data_workers)),
-        registry=registry,
-    )
     seq_dim = (
         1
         if cfg.task == "lm" and mesh.shape[meshlib.AxisNames.SEQ] > 1
         else None
     )
-    device_it = pipelib.DevicePrefetcher(
-        host, mesh, depth=2, seq_dim=seq_dim, registry=registry
-    )
     steps_per_loop = max(1, int(cfg.steps_per_loop))
-    if steps_per_loop > 1:
-        # Fused multi-step dispatch: stack K sharded batches per chunk and
-        # run them through one jitted lax.scan program — one dispatch, one
-        # hook-gated walk set, one metrics transfer per chunk.
-        stacker = pipelib.BatchStacker(device_it)
-        data_src = stacker
-        multi_fn, raw_step = build_multi_step(cfg, state)
-        step_fn = train_loop.InstrumentedMultiStep(
-            multi_fn, raw_step, registry=registry
-        )
-    else:
-        stacker = None
-        data_src = device_it
-        step_fn = train_loop.InstrumentedStep(
-            build_step(cfg, state), registry=registry
-        )
+    host = device_it = stacker = data_src = None
 
-    def save_fn(s, _step):
-        # Use the consuming stage's view of the dataset position — the
-        # device prefetcher (or, chunked, the batch stacker in front of
-        # it) lags the host pipeline by the prefetch depth and reflects
-        # exactly the batches the train loop has consumed, so resume
-        # never skips.
-        manager.save(s, {"dataset": data_src.get_state()})
+    def _open_pipeline() -> None:
+        # One place builds the input stack so the rollback path can
+        # rebuild it at a restored cursor bit-identically to fit entry.
+        nonlocal host, device_it, stacker, data_src
+        host = pipelib.HostPipeline(
+            dataset,
+            prefetch=4,
+            num_workers=max(1, int(cfg.data_workers)),
+            registry=registry,
+        )
+        device_it = pipelib.DevicePrefetcher(
+            host, mesh, depth=2, seq_dim=seq_dim, registry=registry
+        )
+        if steps_per_loop > 1:
+            # Fused multi-step dispatch: stack K sharded batches per chunk
+            # and run them through one jitted lax.scan program — one
+            # dispatch, one hook-gated walk set, one metrics transfer per
+            # chunk.
+            stacker = pipelib.BatchStacker(device_it)
+            data_src = stacker
+        else:
+            stacker = None
+            data_src = device_it
 
-    # Writer hooks run on process 0 only (the reference's chief-writes-
-    # summaries convention, TF monitored_session.py:566-609); the NaN guard
-    # runs everywhere so all processes abort together (metrics are global,
-    # identical on every process); the checkpoint hook runs everywhere —
-    # orbax saves are collective.
-    is_chief = jax.process_index() == 0
-    chief_hooks: list[hooklib.Hook] = (
-        [
-            hooklib.StepCounterHook(
-                cfg.log_every_steps, cfg.global_batch_size
+    own_listener = listener is None
+    if own_listener:
+        listener = resilience.PreemptionListener()
+    try:
+        # The pipeline threads start inside this block, and the rest
+        # of the setup below it can fail for real reasons (a hook
+        # constructor hitting an unwritable workdir, a bad fused-step
+        # build) — any such failure must tear the pipeline and the
+        # checkpoint manager down instead of leaking a producer
+        # thread blocked forever on its full buffer.
+        _open_pipeline()
+        if steps_per_loop > 1:
+            multi_fn, raw_step = build_multi_step(cfg, state)
+            step_fn = train_loop.InstrumentedMultiStep(
+                multi_fn, raw_step, registry=registry
+            )
+        else:
+            step_fn = train_loop.InstrumentedStep(
+                build_step(cfg, state), registry=registry
+            )
+
+        def save_fn(s, _step, *, force: bool = False):
+            # Use the consuming stage's view of the dataset position — the
+            # device prefetcher (or, chunked, the batch stacker in front of
+            # it) lags the host pipeline by the prefetch depth and reflects
+            # exactly the batches the train loop has consumed, so resume
+            # never skips.
+            manager.save(s, {"dataset": data_src.get_state()}, force=force)
+            if chaos is not None and chaos.should_tear(int(s.step)):
+                # Chaos torn-write injection damages only *durable* files —
+                # wait for the async save so the tear is the post-finalization
+                # corruption the restore hardening exists for.
+                manager.wait()
+                chaos.tear_checkpoint(manager.directory, int(s.step))
+
+        # Writer hooks run on process 0 only (the reference's chief-writes-
+        # summaries convention, TF monitored_session.py:566-609); the NaN guard
+        # runs everywhere so all processes abort together (metrics are global,
+        # identical on every process); the checkpoint hook runs everywhere —
+        # orbax saves are collective.
+        is_chief = jax.process_index() == 0
+        chief_hooks: list[hooklib.Hook] = (
+            [
+                hooklib.StepCounterHook(
+                    cfg.log_every_steps, cfg.global_batch_size
+                ),
+                hooklib.LoggingHook(cfg.log_every_steps, keys=("loss",)),
+                hooklib.MetricWriterHook(workdir, cfg.log_every_steps),
+                hooklib.TensorBoardHook(workdir, cfg.log_every_steps),
+            ]
+            if is_chief
+            else []
+        )
+        # Preemption grace: flag-setting signal handlers for the life of the
+        # run (released in the finally below).  ``recoverable_fit`` passes a
+        # listener spanning its whole retry loop, so a notice received in one
+        # attempt (or during a backoff sleep) is not forgotten by the next;
+        # standalone fit owns its own.  Install is a no-op off the main
+        # thread — such a caller simply never observes a preemption.
+        listener_active = listener.install()
+
+        chaos_hooks: list[hooklib.Hook] = []
+        if chaos is not None:
+            sigterm_hook = chaos.sigterm_hook()
+            if sigterm_hook is not None:
+                if listener_active:
+                    chaos_hooks.append(sigterm_hook)
+                else:
+                    # Without the handler a raised SIGTERM is a hard kill —
+                    # the drill would demonstrate an ungraceful death
+                    # instead of proving the graceful path.
+                    log.warning(
+                        "chaos sigterm_at_step disabled: preemption listener "
+                        "inactive (fit not on the main thread)"
+                    )
+            tear_hook = chaos.tear_hook(save_fn, final_step=cfg.train_steps)
+            if tear_hook is not None:
+                chaos_hooks.append(tear_hook)
+        nproc = jax.process_count()
+        preempt_poll_steps = max(
+            1, int(cfg.preempt_poll_steps or PREEMPT_POLL_STEPS)
+        )
+        all_hooks: list[hooklib.Hook] = [
+            hooklib.StopAtStepHook(cfg.train_steps),
+            # Before the chief writer hooks: TelemetryHook injects its derived
+            # scalars (data_wait_s, step_time_s, mfu, ...) into the metrics
+            # dict for the writers to record.  Runs on every process — its
+            # multi-host aggregation is a collective.
+            hooklib.TelemetryHook(registry, cfg.log_every_steps),
+            *chief_hooks,
+            hooklib.NanGuardHook(cfg.log_every_steps),
+            hooklib.CheckpointHook(
+                save_fn, every_secs=cfg.checkpoint_every_secs
             ),
-            hooklib.LoggingHook(cfg.log_every_steps, keys=("loss",)),
-            hooklib.MetricWriterHook(workdir, cfg.log_every_steps),
-            hooklib.TensorBoardHook(workdir, cfg.log_every_steps),
+            *chaos_hooks,
+            *extra_hooks,
+            # Multi-host only: align fused-chunk boundaries with the
+            # preemption-poll steps (the poll is a collective).
+            *(
+                [_PreemptPollHook(preempt_poll_steps)] if nproc > 1 else []
+            ),
         ]
-        if is_chief
-        else []
-    )
-    all_hooks: list[hooklib.Hook] = [
-        hooklib.StopAtStepHook(cfg.train_steps),
-        # Before the chief writer hooks: TelemetryHook injects its derived
-        # scalars (data_wait_s, step_time_s, mfu, ...) into the metrics
-        # dict for the writers to record.  Runs on every process — its
-        # multi-host aggregation is a collective.
-        hooklib.TelemetryHook(registry, cfg.log_every_steps),
-        *chief_hooks,
-        hooklib.NanGuardHook(cfg.log_every_steps),
-        hooklib.CheckpointHook(
-            save_fn, every_secs=cfg.checkpoint_every_secs
-        ),
-        *extra_hooks,
-    ]
 
-    rng = jax.random.key(cfg.seed + 1)
-    for h in all_hooks:
-        h.begin(state)
+        def _preempt_due(step: int) -> bool:
+            if nproc == 1:
+                return listener.preempted
+            if step % preempt_poll_steps:
+                return False
+            from jax.experimental import multihost_utils
 
-    metrics = {}
-    steps_run = 0
-    step = int(state.step)
+            import numpy as np
+
+            flags = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray(listener.preempted, np.int32)
+                )
+            )
+            return bool(flags.max())
+
+        rng = jax.random.key(cfg.seed + 1)
+        metrics = {}
+        steps_run = 0
+        preempted = False
+        rollbacks_done = 0
+        skipped_total = 0
+        # Rollback bookkeeping.  pending: [step, n] — when the (replayed)
+        # loop reaches ``step``, discard the next ``n`` batches (the offending
+        # chunk's).  executed: skips already performed, re-scheduled if a
+        # later rollback rewinds behind them (their batches are back in the
+        # stream).
+        pending_skips: list[list[int]] = []
+        executed_skips: list[tuple[int, int]] = []
+        step = int(state.step)
+
+    except BaseException:
+        if own_listener:
+            listener.uninstall()  # no-op if install never ran
+        _close_quietly(host, manager)
+        raise
+
+    watchdog = None
+    try:
+        # Everything that can raise between handler install and the main
+        # loop's finally runs guarded — a bad watchdog timeout, a hook's
+        # begin() failing, or the anchor save hitting dead storage must
+        # not leak the replaced signal handlers / watchdog thread into
+        # the caller.
+        if cfg.watchdog_timeout_s:
+            watchdog = resilience.ProgressWatchdog(
+                cfg.watchdog_timeout_s,
+                registry=registry,
+                abort=cfg.watchdog_abort,
+            )
+        for h in all_hooks:
+            h.begin(state)
+        if cfg.nan_policy == "rollback" and not restored:
+            # Rollback needs a restore anchor even before the first
+            # scheduled save: bank the initial state (once, cheap) so a
+            # divergence in the first cadence window has somewhere to
+            # rewind to.  Gated on ``not restored`` — not on
+            # latest_step() — because the fresh-init fallback (torn
+            # checkpoints present but nothing restorable) also needs the
+            # anchor.
+            save_fn(state, step, force=True)
+    except BaseException:
+        if watchdog is not None:
+            watchdog.stop()
+        if own_listener:
+            listener.uninstall()
+        # The pipeline threads and the checkpoint manager already exist at
+        # this point — a setup failure must not leak them into the caller
+        # (the producer would sit blocked on its full buffer forever).
+        _close_quietly(host, manager)
+        raise
+
+    def _check_chunk_finite(loss_rows, chunk_start: int, n: int) -> None:
+        """Rollback mode guards EVERY chunk, not only the NaN guard's
+        log-cadence walks: the skip ledger's exactness rests on detection
+        landing in the offending chunk — cadence-delayed detection would
+        attribute the divergence to (and skip) an innocent later chunk
+        while the real poison replays on every rewind until the budget
+        dies.  Cost: one small device→host read per chunk, paid only
+        under ``nan_policy="rollback"``.  Raised BEFORE the hook walk, so
+        the checkpoint hook can never persist the poisoned state."""
+        if loss_rows is None:
+            return
+        import numpy as np
+
+        arr = np.atleast_1d(np.asarray(loss_rows))[:n]
+        bad = ~np.isfinite(arr)
+        if bad.any():
+            i = int(np.argmax(bad))
+            raise FloatingPointError(
+                f"loss is {arr[i]} at step {chunk_start + 1 + i}"
+            )
+
+    def _discard_batches(n: int) -> int:
+        """Advance the consuming stage exactly ``n`` batches (the rollback
+        skip).  Pulled through the normal stages so the resume-exact state
+        rides along and the next checkpoint names the post-skip cursor."""
+        done = 0
+        with registry.span(telemetry.DATA_WAIT):
+            if stacker is not None:
+                try:
+                    _, done = stacker.next_chunk(n)
+                except StopIteration:
+                    pass
+            else:
+                for _ in range(n):
+                    try:
+                        next(device_it)
+                    except StopIteration:
+                        break
+                    done += 1
+        return done
+
+    def _rollback(offender_start: int, offender_len: int) -> bool:
+        """Restore the newest finite checkpoint and schedule the exact
+        skip of the offending chunk (steps ``offender_start+1 ..
+        offender_start+offender_len``).  False = no usable restore point
+        (caller re-raises the divergence error)."""
+        nonlocal state, step
+        try:
+            host.stop(raise_pending=False)
+        except Exception:  # noqa: BLE001 — teardown must not mask recovery
+            log.exception("pipeline teardown during rollback failed")
+        manager.wait()
+        try:
+            # The hardened walk-back (torn/unrestorable candidates
+            # skipped) plus a finiteness gate: a clock-due save can land
+            # at a walk the NaN guard's cadence skipped — after
+            # divergence began — and restoring it would replay the poison.
+            restored_state, restored_data = manager.restore_newest_valid(
+                state,
+                accept=train_loop.state_is_finite,
+                accept_name="non-finite parameters",
+            )
+        except FileNotFoundError as e:  # incl. NoValidCheckpointError
+            log.error("rollback: no finite checkpoint to restore (%s)", e)
+            return False
+        state = _place(restored_state)
+        step = int(state.step)
+        # Delete the abandoned timeline's checkpoints (anything newer
+        # than the restore point): they hold post-divergence state that
+        # must never be auto-resumed, and leaving them would shadow the
+        # replay's own saves at the same steps (save() skips existing
+        # steps by design).
+        for stale in manager.all_steps():
+            if stale > step:
+                log.warning(
+                    "rollback: deleting post-divergence checkpoint at "
+                    "step %d", stale,
+                )
+                manager.delete(stale)
+        if restored_data.get("dataset") and hasattr(dataset, "set_state"):
+            dataset.set_state(restored_data["dataset"])
+        _open_pipeline()
+        # Re-schedule every skip the rewind re-exposed, plus the new
+        # offender; dedup by step, keeping the widest span.
+        wanted = {s: n for s, n in executed_skips if s >= step}
+        for s, n in pending_skips:
+            wanted[s] = max(wanted.get(s, 0), n)
+        if offender_start >= step:
+            wanted[offender_start] = max(
+                wanted.get(offender_start, 0), offender_len
+            )
+        else:  # only reachable via exotic extra_hooks save ordering
+            log.warning(
+                "rollback: restored step %d is past the offending chunk "
+                "at %d; nothing to skip", step, offender_start,
+            )
+        pending_skips[:] = sorted([s, n] for s, n in wanted.items())
+        log.warning(
+            "rollback: restored step %d; will skip the offending chunk "
+            "(steps %d..%d) on replay",
+            step, offender_start + 1, offender_start + offender_len,
+        )
+        if watchdog is not None:
+            watchdog.beat(step)
+        return True
+
     try:
         while step < cfg.train_steps:
-            t_iter = time.perf_counter()
-            if stacker is None:
-                with registry.span(telemetry.DATA_WAIT):
-                    batch = next(device_it)
-                state, metrics = step_fn(state, batch, rng)
-                registry.timer(telemetry.STEP_TIME).record(
-                    time.perf_counter() - t_iter
+            if _preempt_due(step):
+                log.warning(
+                    "preemption: writing emergency checkpoint at step %d "
+                    "and exiting (resumable — rerun the same command)",
+                    step,
                 )
-                step += 1
-                steps_run += 1
-                registry.counter(telemetry.HOOK_WALKS).inc()
-                if not hooklib.run_hooks_after_step(
-                    all_hooks, state, metrics, step
-                ):
-                    break
-            else:
-                with registry.span(telemetry.DATA_WAIT):
-                    chunk, k = stacker.next_chunk(
-                        _chunk_len(step, cfg, all_hooks)
+                save_fn(state, step, force=True)
+                preempted = True
+                break
+            while pending_skips and pending_skips[0][0] <= step:
+                skip_at, n = pending_skips.pop(0)
+                if skip_at < step:
+                    # Defensive: the skip's boundary was overshot (should
+                    # not happen — chunks are capped at pending skips
+                    # below); skipping NOW would discard the wrong
+                    # batches, so drop the entry rather than jam the
+                    # queue or corrupt the stream.
+                    log.warning(
+                        "rollback: scheduled skip at step %d overshot "
+                        "(loop is at %d); dropping it", skip_at, step,
                     )
-                state, rows = step_fn(state, chunk, rng)
-                # Chunk wall ÷ K, recorded once per STEP (k records): the
-                # timer's count stays the step count and its total the
-                # loop wall, so TelemetryHook's per-record mean is not
-                # chunk-weighted when chunk lengths mix (a K=8 chunk and
-                # its K=2 boundary tail would otherwise average 50/50)
-                # and step_time_s stays comparable across steps_per_loop
-                # values.  k sub-µs records per chunk — off the hot path.
-                per_step = (time.perf_counter() - t_iter) / k
-                step_timer = registry.timer(telemetry.STEP_TIME)
-                for _ in range(k):
-                    step_timer.record(per_step)
-                start = step
-                step += k
-                steps_run += k
-                # The latest metrics row, lazily — FitResult materialises
-                # it only at return.  Passed as final_row so TelemetryHook's
-                # injected scalars land on THIS object when the last row is
-                # walked (final_metrics parity with the unfused loop).
-                metrics = hooklib.LazyMetricRow(rows, k - 1, start + 1)
-                if not hooklib.run_hooks_after_chunk(
-                    all_hooks, state, rows, start, k,
-                    registry=registry, final_row=metrics,
-                ):
-                    break
+                    continue
+                done = _discard_batches(n)
+                skipped_total += done
+                registry.counter(telemetry.SKIPPED_BATCHES).inc(done)
+                executed_skips.append((step, done))
+                log.warning(
+                    "rollback: advanced the dataset cursor past %d "
+                    "offending batch(es) at step %d", done, step,
+                )
+            start = step
+            t_iter = time.perf_counter()
+            k = 0
+            try:
+                if stacker is None:
+                    with registry.span(telemetry.DATA_WAIT):
+                        batch = next(device_it)
+                    k = 1
+                    if chaos is not None:
+                        batch = chaos.poison_batch(batch, start + 1, 1)
+                    state, metrics = step_fn(state, batch, rng)
+                    if cfg.nan_policy == "rollback":
+                        _check_chunk_finite(metrics.get("loss"), start, 1)
+                    registry.timer(telemetry.STEP_TIME).record(
+                        time.perf_counter() - t_iter
+                    )
+                    step = start + 1
+                    steps_run += 1
+                    registry.counter(telemetry.HOOK_WALKS).inc()
+                    ok = hooklib.run_hooks_after_step(
+                        all_hooks, state, metrics, step
+                    )
+                else:
+                    k_req = _chunk_len(start, cfg, all_hooks)
+                    if pending_skips and pending_skips[0][0] > start:
+                        # A chunk is one atomic device program, so the
+                        # only way to execute a scheduled skip at its
+                        # exact step — replay chunk boundaries are not
+                        # guaranteed to reproduce the original run's
+                        # (clock-due hooks) — is to end the chunk there.
+                        k_req = min(k_req, pending_skips[0][0] - start)
+                    with registry.span(telemetry.DATA_WAIT):
+                        chunk, k = stacker.next_chunk(k_req)
+                    if chaos is not None:
+                        chunk = chaos.poison_batch(chunk, start + 1, k)
+                    state, rows = step_fn(state, chunk, rng)
+                    if cfg.nan_policy == "rollback":
+                        _check_chunk_finite(rows.get("loss"), start, k)
+                    # Chunk wall ÷ K, recorded once per STEP (k records):
+                    # the timer's count stays the step count and its total
+                    # the loop wall, so TelemetryHook's per-record mean is
+                    # not chunk-weighted when chunk lengths mix (a K=8
+                    # chunk and its K=2 boundary tail would otherwise
+                    # average 50/50) and step_time_s stays comparable
+                    # across steps_per_loop values.  k sub-µs records per
+                    # chunk — off the hot path.
+                    per_step = (time.perf_counter() - t_iter) / k
+                    step_timer = registry.timer(telemetry.STEP_TIME)
+                    for _ in range(k):
+                        step_timer.record(per_step)
+                    step = start + k
+                    steps_run += k
+                    # The latest metrics row, lazily — FitResult
+                    # materialises it only at return.  Passed as final_row
+                    # so TelemetryHook's injected scalars land on THIS
+                    # object when the last row is walked (final_metrics
+                    # parity with the unfused loop).
+                    metrics = hooklib.LazyMetricRow(rows, k - 1, start + 1)
+                    ok = hooklib.run_hooks_after_chunk(
+                        all_hooks, state, rows, start, k,
+                        registry=registry, final_row=metrics,
+                    )
+            except FloatingPointError:
+                # The NaN guard's divergence signal.  Policy "abort"
+                # (default) keeps the reference behavior: propagate.
+                if cfg.nan_policy != "rollback" or k == 0:
+                    raise
+                if rollbacks_done >= cfg.rollback_budget:
+                    log.error(
+                        "rollback budget (%d) exhausted; aborting",
+                        cfg.rollback_budget,
+                    )
+                    raise
+                if not _rollback(start, k):
+                    raise
+                # Counted only when a rewind actually happened, so the
+                # counter equals restores performed even on exhaustion.
+                rollbacks_done += 1
+                registry.counter(telemetry.ROLLBACKS).inc()
+                continue
+            if watchdog is not None:
+                watchdog.beat(step)
+            if not ok:
+                break
     except BaseException:
         # Already failing: run abort hooks best-effort (single-process, the
         # CheckpointHook crash-save preserves progress when storage still
@@ -540,11 +916,36 @@ def fit(
         # After close: the report's checkpoint split includes the final
         # save's wait-until-durable time.
         _write_telemetry_report(workdir, registry, t_run0, steps_run)
+        if chaos is not None and not preempted:
+            # A drill whose fault never injected must not exit 0 looking
+            # like a passed drill (a preempted run legitimately leaves
+            # later-positioned faults unfired).
+            chaos.warn_unfired()
         if end_error is not None:
             raise end_error
+    finally:
+        # Both exits: release the signal handlers (the caller's SIGINT
+        # behavior must come back — unless the listener is owned by
+        # recoverable_fit, which spans restarts) and the watchdog thread.
+        if watchdog is not None:
+            watchdog.stop()
+        if own_listener:
+            listener.uninstall()
 
     host_metrics = {k: float(v) for k, v in metrics.items()}
-    return FitResult(state=state, final_metrics=host_metrics, steps_run=steps_run)
+    if preempted:
+        log.warning(
+            "run preempted at step %d after an emergency checkpoint; "
+            "resumable by rerunning the same command", step,
+        )
+    return FitResult(
+        state=state,
+        final_metrics=host_metrics,
+        steps_run=steps_run,
+        preempted=preempted,
+        rollbacks=rollbacks_done,
+        skipped_batches=skipped_total,
+    )
 
 
 def _write_telemetry_report(
@@ -575,8 +976,11 @@ def _write_telemetry_report(
 
 
 def _close_quietly(host, manager) -> None:
+    # ``host`` is None when teardown runs before (or because) the
+    # pipeline build itself failed.
     try:
-        host.stop()
+        if host is not None:
+            host.stop()
     except Exception:
         log.exception("host pipeline stop failed")
     finally:
@@ -644,12 +1048,38 @@ def is_transient_error(e: BaseException) -> bool:
     return not any(m in msg for m in _DETERMINISTIC_MARKERS)
 
 
+def restart_backoff(
+    attempt: int, *, base_s: float = 1.0, max_s: float = 60.0, seed: int = 0
+) -> float:
+    """Delay before restart ``attempt`` (1-based): exponential backoff
+    with *deterministic* jitter.
+
+    The raw delay ``min(max_s, base_s · 2^(attempt−1))`` is scaled into
+    ``[0.5, 1.0)`` of itself by a hash of ``(seed, attempt)`` — jitter
+    that de-synchronizes a fleet tripped by one shared fault (no
+    thundering-herd re-slamming the coordinator/storage on the same
+    second) while keeping every run's timeline replayable and testable,
+    matching the repo-wide determinism contract.  ``base_s <= 0``
+    disables backoff entirely (tests, and callers with their own
+    scheduler-level backoff)."""
+    if base_s <= 0:
+        return 0.0
+    import hashlib
+
+    raw = min(max_s, base_s * (2.0 ** (attempt - 1)))
+    digest = hashlib.sha256(f"{seed}:{attempt}".encode()).digest()
+    frac = int.from_bytes(digest[:8], "big") / 2.0**64
+    return raw * (0.5 + 0.5 * frac)
+
+
 def recoverable_fit(
     cfg: ExperimentConfig,
     workdir: str,
     *,
     max_restarts: int = 3,
     recover_on: tuple[type[BaseException], ...] | None = None,
+    backoff_base_s: float = 1.0,
+    backoff_max_s: float = 60.0,
     **fit_kwargs,
 ) -> FitResult:
     """``fit`` wrapped in the reference's session-recovery loop.
@@ -661,7 +1091,16 @@ def recoverable_fit(
     latest checkpoint — parameters, optimizer state, EMA, step, and the
     input-pipeline position — so no progress is lost beyond the last save.
     Bounded by ``max_restarts`` to avoid crash-looping on deterministic
-    failures (e.g. a NaN guard trip, which is *not* in the recoverable set).
+    failures (e.g. a NaN guard trip, which is *not* in the recoverable set),
+    and spaced by :func:`restart_backoff` so a flapping fault is retried
+    on a widening, jittered schedule instead of a hot crash-loop.
+
+    A ``preempted`` result returns as-is (no restart): the process was
+    told to die — the emergency checkpoint makes the *next invocation*
+    the resume, not this one.  The attempt count is threaded into each
+    ``fit`` as the ``train/restarts`` counter, so the final attempt's
+    ``telemetry.json`` records how many restore-retrain cycles the run
+    burned.
     """
     # The message filter guards only the *default* set, where JaxRuntimeError
     # is too broad a class; an explicit recover_on is taken at its word so
@@ -669,22 +1108,51 @@ def recoverable_fit(
     filter_messages = recover_on is None
     if recover_on is None:
         recover_on = default_recoverable_errors()
+    # One listener spans ALL attempts (threaded into each fit): a
+    # preemption notice received in attempt N — or during a backoff
+    # sleep, which would otherwise run under the default (fatal) SIGTERM
+    # handler — is still honored by attempt N+1, which emergency-saves
+    # and returns preempted at its first boundary.
+    listener = resilience.PreemptionListener()
+    listener.install()
     attempt = 0
-    while True:
-        try:
-            # steps_run counts the final (successful) attempt; overall
-            # progress is state.step, which spans attempts via checkpoints.
-            return fit(cfg, workdir, **fit_kwargs)
-        except recover_on as e:
-            if filter_messages and not is_transient_error(e):
-                raise
-            attempt += 1
-            if attempt > max_restarts:
-                raise
-            log.warning(
-                "fit failed (%s: %s); restart %d/%d from latest checkpoint",
-                type(e).__name__,
-                e,
-                attempt,
-                max_restarts,
-            )
+    try:
+        while True:
+            try:
+                # steps_run counts the final (successful) attempt;
+                # overall progress is state.step, which spans attempts
+                # via checkpoints.
+                return fit(
+                    cfg, workdir, restarts=attempt, listener=listener,
+                    **fit_kwargs,
+                )
+            except recover_on as e:
+                if filter_messages and not is_transient_error(e):
+                    raise
+                attempt += 1
+                if attempt > max_restarts:
+                    raise
+                delay = restart_backoff(
+                    attempt,
+                    base_s=backoff_base_s,
+                    max_s=backoff_max_s,
+                    seed=cfg.seed,
+                )
+                log.warning(
+                    "fit failed (%s: %s); restart %d/%d from latest "
+                    "checkpoint in %.2fs",
+                    type(e).__name__,
+                    e,
+                    attempt,
+                    max_restarts,
+                    delay,
+                )
+                # Don't sleep out the grace period: skip the backoff
+                # when a notice is already pending, and wake immediately
+                # if one arrives mid-wait (listener.wait, not
+                # time.sleep — PEP 475 would resume the sleep) so the
+                # next attempt can emergency-save and exit resumable.
+                if delay > 0 and not listener.preempted:
+                    listener.wait(delay)
+    finally:
+        listener.uninstall()

@@ -41,6 +41,25 @@ ENV_CPU_DEVICES = "DTM_CPU_DEVICES_PER_PROCESS"
 
 DEFAULT_PORT = 9671
 
+# Exit code a preempted-but-checkpointed training process uses (BSD
+# EX_TEMPFAIL): the run wrote an emergency checkpoint on SIGTERM and
+# rerunning the same command resumes it.  ``launch_local`` reports such
+# children as resumable instead of replaying their logs as a failure,
+# and propagates the code so outer supervisors can requeue.
+RESUMABLE_EXIT_CODE = 75
+
+
+def aggregate_exit_codes(codes) -> int:
+    """Cluster exit code: a real failure always wins over "preempted"
+    (one resumable child must not relabel another child's crash as
+    resumable), preempted wins over success, all-zero is success."""
+    failures = [c for c in codes if c not in (0, RESUMABLE_EXIT_CODE)]
+    if failures:
+        return max(failures)
+    if RESUMABLE_EXIT_CODE in codes:
+        return RESUMABLE_EXIT_CODE
+    return 0
+
 
 def initialize_from_env() -> bool:
     """Bootstrap ``jax.distributed`` from ``DTM_*`` env vars.
@@ -149,7 +168,14 @@ def launch_local(
                 raise subprocess.TimeoutExpired(argv, timeout)
             p.wait(timeout=remaining)
             codes.append(p.returncode)
-            if p.returncode != 0 and i != 0:
+            if p.returncode == RESUMABLE_EXIT_CODE:
+                # Preemption grace, not a failure: the child checkpointed
+                # and asked to be rerun — don't dump its log as a crash.
+                sys.stderr.write(
+                    f"--- process {i} preempted (exit {p.returncode}): "
+                    "resumable — rerun the same command ---\n"
+                )
+            elif p.returncode != 0 and i != 0:
                 logs[i].seek(0)
                 sys.stderr.write(
                     f"--- process {i} (exit {p.returncode}) ---\n"
@@ -225,7 +251,7 @@ def main(argv: Sequence[str] | None = None) -> int:
             port=int(port_str),
             cpu_devices_per_process=args.cpu_devices_per_process,
         )
-        return max(codes, default=0)
+        return aggregate_exit_codes(codes)
 
     env = os.environ
     env[ENV_COORDINATOR] = args.coordinator

@@ -1,0 +1,48 @@
+"""Failure-domain resilience: the subsystem behind ``fit``'s survival story.
+
+The reference's recovery machinery (`_RecoverableSession` +
+``SessionManager``, SURVEY.md §5.4) covers exactly one failure domain —
+a transient session error answered by an immediate restart.  A production
+TPU fleet loses goodput to four more, each handled here and each
+deterministic enough to assert in tier-1 tests:
+
+- :mod:`preemption` — SIGTERM/SIGINT grace: a signal sets a flag the
+  train loop polls at chunk boundaries, triggering a forced emergency
+  checkpoint and a resumable (not failed) exit.
+- divergence rollback — ``nan_policy="rollback"`` in
+  ``harness/train.py::fit``: restore the last finite checkpoint, advance
+  the dataset cursor exactly past the offending chunk, retry under a
+  bounded budget.
+- :mod:`fsck` — restore hardening: structural validation of checkpoint
+  candidates (orbax completeness + sidecar parse + topology stamp) so a
+  torn write walks back to the newest *valid* step instead of crashing;
+  also the engine of ``scripts/fsck_checkpoints.py``.
+- :mod:`chaos` — a seeded, off-by-default fault injector (pipeline
+  worker raise, train-step NaN, torn checkpoint, SIGTERM delivery) that
+  makes every mechanism above testable on demand.
+- :mod:`watchdog` — step-progress watchdog: hung collectives and
+  pipeline deadlocks produce a diagnosis (and optionally an abort)
+  instead of a silent stall.
+
+Layering: this package imports only stdlib + :mod:`telemetry` (+ jax for
+array poisoning), never :mod:`harness` — the harness wires it in.
+"""
+
+from distributed_tensorflow_models_tpu.resilience.chaos import (  # noqa: F401
+    ChaosConfig,
+    ChaosInjector,
+    ChaosPipelineError,
+    get_injector,
+    parse_chaos_spec,
+)
+from distributed_tensorflow_models_tpu.resilience.fsck import (  # noqa: F401
+    fsck_checkpoints,
+    sidecar_issues,
+    validate_step_dir,
+)
+from distributed_tensorflow_models_tpu.resilience.preemption import (  # noqa: F401
+    PreemptionListener,
+)
+from distributed_tensorflow_models_tpu.resilience.watchdog import (  # noqa: F401
+    ProgressWatchdog,
+)

@@ -1,0 +1,436 @@
+"""Deterministic chaos injection: faults on demand, once, at exact positions.
+
+The reference has no fault injection anywhere (SURVEY.md §5.3) — its
+recovery story was only ever exercised by real preemptions.  This module
+makes every failure domain the resilience subsystem handles reproducible
+in a tier-1 test (and drillable in production canaries) with four
+injection points, all **off by default** and driven by
+``ExperimentConfig.chaos`` / ``--chaos``:
+
+- ``pipeline_fail_at_batch=i`` — the dataset's ``assemble`` raises
+  :class:`ChaosPipelineError` for the i-th dispatched batch (0-based).
+  Injection is marked at ``next_work`` time — the serial cursor — so it
+  lands on exactly batch *i* at any ``data_workers`` count, and the
+  ordered pipeline surfaces it at exactly position *i*.  *i* counts
+  dispatches since process start: exact for the first pipeline of the
+  process, but after a mid-process rebuild at a rewound cursor (a
+  rollback replay) abandoned lookahead dispatches have consumed indices,
+  so an armed-but-unfired fault's position shifts (warned at
+  ``set_state`` time) — combine it with the other faults accordingly.
+- ``nan_at_step=k`` — the batch feeding train step *k* is poisoned with
+  NaN (float leaves only), driving the real NaN-guard path.
+- ``torn_checkpoint_at_step=k`` — after the step-*k* checkpoint is
+  durable, files are deleted from its directory, simulating
+  post-finalization damage the restore hardening must walk back over.
+- ``sigterm_at_step=k`` — a real SIGTERM is delivered to the process
+  after step *k* (via a hook, so the fused loop's chunk ends exactly
+  there), driving the preemption-grace path end-to-end.
+
+**Once per process per workdir**: injectors are memoized on
+``(workdir, spec, seed)`` and each fault fires at most once, so the
+recovery that follows — a ``recoverable_fit`` restart, a rollback replay
+— re-traverses the same positions *without* re-faulting.  A genuinely
+new process (real preemption resume) re-arms, which is exactly the
+at-least-once behavior a chaos drill wants.
+
+``seed`` is carried for future randomized modes (and keys the memo); the
+current injection points are all positional, so runs are bit-reproducible
+by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import signal
+import threading
+from typing import Any, Iterator, Optional
+
+from distributed_tensorflow_models_tpu.resilience import fsck as fscklib
+
+log = logging.getLogger("dtm")
+
+
+class ChaosPipelineError(ConnectionError):
+    """Injected producer failure.  A ``ConnectionError`` subclass on
+    purpose: it must look preemption-class to ``recoverable_fit``'s
+    default recoverable set, so the drill exercises the real
+    restore-and-retry path."""
+
+
+_FIELDS = (
+    "pipeline_fail_at_batch",
+    "nan_at_step",
+    "torn_checkpoint_at_step",
+    "sigterm_at_step",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    pipeline_fail_at_batch: Optional[int] = None
+    nan_at_step: Optional[int] = None
+    torn_checkpoint_at_step: Optional[int] = None
+    sigterm_at_step: Optional[int] = None
+    seed: int = 0
+
+    @classmethod
+    def from_dict(cls, spec: dict, seed: int = 0) -> "ChaosConfig":
+        unknown = set(spec) - set(_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown chaos keys {sorted(unknown)}; have {list(_FIELDS)}"
+            )
+        return cls(seed=seed, **{k: int(v) for k, v in spec.items()})
+
+
+def parse_chaos_spec(text: str) -> dict[str, int]:
+    """``--chaos "nan_at_step=5,sigterm_at_step=9"`` → dict.  Raises
+    ValueError (argparse-friendly) on malformed entries or unknown keys."""
+    out: dict[str, int] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        if not sep:
+            raise ValueError(f"chaos entry {part!r} is not key=value")
+        key = key.strip()
+        if key not in _FIELDS:
+            raise ValueError(
+                f"unknown chaos key {key!r}; have {list(_FIELDS)}"
+            )
+        try:
+            out[key] = int(value)
+        except ValueError as e:
+            raise ValueError(f"chaos value for {key!r} must be int: {e}")
+    return out
+
+
+class _ChaosMarked:
+    """Wrapper tagging the work item whose ``assemble`` must raise."""
+
+    __slots__ = ("work", "index")
+
+    def __init__(self, work, index: int):
+        self.work = work
+        self.index = index
+
+
+class _ChaosDataset:
+    """Dataset proxy: transparent except for the worker-pool split, where
+    ``next_work`` tags the fault batch and ``assemble`` raises on the tag
+    — so the fault fires inside the pipeline worker (or the serial
+    producer via ``iterate_via_work``), never on the cursor thread, and
+    surfaces through the pipeline's ordered error contract."""
+
+    def __init__(self, dataset, injector: "ChaosInjector"):
+        self._dataset = dataset
+        self._injector = injector
+
+    def __getattr__(self, name):  # get_state/batches_per_epoch/...
+        return getattr(self._dataset, name)
+
+    def set_state(self, state) -> None:
+        self._dataset.set_state(state)
+        inj = self._injector
+        if (
+            inj.config.pipeline_fail_at_batch is not None
+            and not inj._pipeline_fired
+            and inj._dispatch_count > 0
+        ):
+            # A mid-process rebuild (rollback replay / in-process restart)
+            # rewound the cursor, but the fault index keeps counting
+            # dispatches — including the abandoned lookahead — so the
+            # armed fault no longer lands on logical batch i.  Say so
+            # rather than let a combined drill silently misfire.
+            log.warning(
+                "chaos: cursor repositioned with pipeline_fail_at_batch=%d "
+                "still armed after %d dispatches — the fault index counts "
+                "dispatches since process start (abandoned lookahead "
+                "included), so its stream position is no longer exact",
+                inj.config.pipeline_fail_at_batch, inj._dispatch_count,
+            )
+
+    def next_work(self):
+        work = self._dataset.next_work()
+        idx = self._injector._next_dispatch_index()
+        if self._injector._arm_pipeline_fault(idx):
+            return _ChaosMarked(work, idx)
+        return work
+
+    def assemble(self, work):
+        if isinstance(work, _ChaosMarked):
+            log.warning(
+                "chaos: failing pipeline assemble at batch %d", work.index
+            )
+            raise ChaosPipelineError(
+                f"chaos: injected pipeline failure at batch {work.index}"
+            )
+        return self._dataset.assemble(work)
+
+    def __iter__(self) -> Iterator:
+        # Serial-producer path: the SAME iteration the real datasets use
+        # (lazy import — module-level layering stays telemetry-only).
+        from distributed_tensorflow_models_tpu.data.datasets import (
+            iterate_via_work,
+        )
+
+        return iterate_via_work(self)
+
+
+class _TearAtStep:
+    """Duck-typed hook (harness.hooks.Hook protocol, no import) forcing a
+    checkpoint at ``torn_checkpoint_at_step`` so the tear always has a
+    durable step-k directory to damage.  Without it the fault only fires
+    if some save cadence happens to land at exactly step k — with the
+    default 600 s clock cadence a drill like ``torn_checkpoint_at_step=500``
+    would silently never inject.  The tear itself still runs inside the
+    harness save path (``should_tear``/``tear_checkpoint`` after the save
+    is durable), so drill and production code share one seam."""
+
+    def __init__(self, injector: "ChaosInjector", step: int, save_fn):
+        self._injector = injector
+        self._step = step
+        self._save_fn = save_fn
+
+    def begin(self, state) -> None: ...
+
+    def wants_step(self, step: int) -> bool:
+        return step == self._step and not self._injector._tear_fired
+
+    def after_step(self, state, metrics, step: int) -> None:
+        if step == self._step and not self._injector._tear_fired:
+            log.warning(
+                "chaos: forcing a checkpoint at step %d for the "
+                "torn-write injection", step,
+            )
+            self._save_fn(state, step, force=True)
+
+    def end(self, state) -> None: ...
+
+    def abort(self, state) -> None: ...
+
+
+class _SigtermAtStep:
+    """Duck-typed hook (harness.hooks.Hook protocol, no import — this
+    package stays below the harness) delivering a real SIGTERM after its
+    step.  ``wants_step`` makes the fused loop end a chunk exactly there,
+    so the preemption flag is observed at the very next boundary."""
+
+    def __init__(self, injector: "ChaosInjector", step: int):
+        self._injector = injector
+        self._step = step
+
+    def begin(self, state) -> None: ...
+
+    def wants_step(self, step: int) -> bool:
+        return step == self._step and not self._injector._sigterm_fired
+
+    def after_step(self, state, metrics, step: int) -> None:
+        if step == self._step and not self._injector._sigterm_fired:
+            self._injector._sigterm_fired = True
+            log.warning("chaos: delivering SIGTERM after step %d", step)
+            signal.raise_signal(signal.SIGTERM)
+
+    def end(self, state) -> None: ...
+
+    def abort(self, state) -> None: ...
+
+
+class ChaosInjector:
+    """One injector per (workdir, spec, seed); all fired-state lives here
+    so recovery replays within the process do not re-fault."""
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self._dispatch_count = 0
+        self._pipeline_fired = False
+        self._nan_fired = False
+        self._tear_fired = False
+        self._sigterm_fired = False
+
+    # -- pipeline worker fault --------------------------------------------
+
+    def _next_dispatch_index(self) -> int:
+        with self._lock:
+            idx = self._dispatch_count
+            self._dispatch_count += 1
+            return idx
+
+    def _arm_pipeline_fault(self, index: int) -> bool:
+        target = self.config.pipeline_fail_at_batch
+        if target is None or self._pipeline_fired or index != target:
+            return False
+        self._pipeline_fired = True
+        return True
+
+    def wrap_dataset(self, dataset):
+        """Interpose the assemble-raise injection point.  Requires the
+        worker-pool split (every dataset in ``datasets.py`` has it)."""
+        if self.config.pipeline_fail_at_batch is None:
+            return dataset
+        if not (hasattr(dataset, "next_work") and hasattr(dataset, "assemble")):
+            raise ValueError(
+                "chaos pipeline_fail_at_batch requires the next_work/"
+                f"assemble split, which {type(dataset).__name__} lacks"
+            )
+        return _ChaosDataset(dataset, self)
+
+    # -- train-step NaN ----------------------------------------------------
+
+    def poison_batch(self, batch, first_step: int, k: int):
+        """NaN-poison the row of ``batch`` feeding ``nan_at_step`` when it
+        falls in steps ``[first_step, first_step + k)``.  ``k > 1`` means a
+        stacked fused chunk (leading axis = chunk row); ``k == 1`` a plain
+        batch.  Only float leaves are poisoned (int token streams cannot
+        carry NaN — a config pointing chaos at one gets a warning)."""
+        target = self.config.nan_at_step
+        if (
+            target is None
+            or self._nan_fired
+            or not first_step <= target < first_step + k
+        ):
+            return batch
+        self._nan_fired = True
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        row = target - first_step
+        poisoned_any = False
+
+        def poison(x):
+            nonlocal poisoned_any
+            if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                return x
+            poisoned_any = True
+            if k > 1:
+                if isinstance(x, np.ndarray):
+                    x = x.copy()
+                    x[row] = np.nan
+                    return x
+                return x.at[row].set(jnp.nan)
+            return jnp.full_like(x, jnp.nan)
+
+        out = jax.tree.map(poison, batch)
+        if poisoned_any:
+            log.warning("chaos: poisoned the batch for step %d with NaN", target)
+        else:
+            log.warning(
+                "chaos: nan_at_step=%d found no float leaves to poison "
+                "(integer-only batch); injection skipped", target,
+            )
+        return out
+
+    # -- torn checkpoint ---------------------------------------------------
+
+    def should_tear(self, step: int) -> bool:
+        return (
+            self.config.torn_checkpoint_at_step == step
+            and not self._tear_fired
+        )
+
+    def tear_checkpoint(self, ckpt_dir: str, step: int) -> None:
+        """Damage a *durable* step dir (caller waits for the async save
+        first): delete the state item's metadata/manifest — exactly the
+        post-finalization torn write ``resilience/fsck.py`` detects (the
+        file names come from fsck's own constants, so the drill and the
+        detector cannot drift apart)."""
+        import os
+
+        if not self.should_tear(step):
+            return
+        self._tear_fired = True
+        state_dir = os.path.join(ckpt_dir, str(step), fscklib._STATE_ITEM)
+        removed = []
+        for name in fscklib._STATE_REQUIRED:
+            path = os.path.join(state_dir, name)
+            if os.path.exists(path):
+                os.remove(path)
+                removed.append(name)
+        log.warning(
+            "chaos: tore checkpoint step %d (removed %s from %s)",
+            step, removed, state_dir,
+        )
+
+    # -- SIGTERM delivery --------------------------------------------------
+
+    def sigterm_hook(self):
+        """The hook ``fit`` appends when ``sigterm_at_step`` is set."""
+        if self.config.sigterm_at_step is None:
+            return None
+        return _SigtermAtStep(self, self.config.sigterm_at_step)
+
+    def tear_hook(self, save_fn, *, final_step: int):
+        """The hook ``fit`` appends when ``torn_checkpoint_at_step`` is
+        set: forces a save at step k so the fault fires under ANY
+        checkpoint cadence (``save_fn`` is the harness save path, which
+        tears the durable dir via ``should_tear``/``tear_checkpoint``).
+
+        None when k >= ``final_step``: the end-of-run save lands at
+        ``final_step`` and tears there itself — a forced tear at the
+        final step's *walk* would be silently repaired by that very save
+        (``CheckpointManager.save`` replaces torn dirs), leaving the
+        drill with nothing to detect."""
+        k = self.config.torn_checkpoint_at_step
+        if k is None or k >= final_step:
+            return None
+        return _TearAtStep(self, k, save_fn)
+
+    # -- drill accounting --------------------------------------------------
+
+    def unfired(self) -> list[str]:
+        """Configured-but-never-fired faults, as ``key=value`` strings."""
+        flags = {
+            "pipeline_fail_at_batch": self._pipeline_fired,
+            "nan_at_step": self._nan_fired,
+            "torn_checkpoint_at_step": self._tear_fired,
+            "sigterm_at_step": self._sigterm_fired,
+        }
+        return [
+            f"{field}={getattr(self.config, field)}"
+            for field in _FIELDS
+            if getattr(self.config, field) is not None and not flags[field]
+        ]
+
+    def warn_unfired(self) -> None:
+        """End-of-run audit: a drill whose fault never injected must not
+        read as a passed drill.  (Expected on recovery replays within one
+        process — the fault already fired in an earlier attempt — which
+        is why this logs only when the fault NEVER fired.)"""
+        pending = self.unfired()
+        if pending:
+            log.warning(
+                "chaos: configured fault(s) never fired: %s — this run "
+                "did NOT exercise them (fault position beyond the run's "
+                "end?)", ", ".join(pending),
+            )
+
+
+# Injector memo: one per (scope, spec, seed) per process, so restart /
+# rollback replays inside one process share fired-state (each fault is
+# at-most-once) while distinct runs (different workdirs) stay independent.
+_INJECTORS: dict[str, ChaosInjector] = {}
+_INJECTORS_LOCK = threading.Lock()
+
+
+def get_injector(
+    spec: Optional[dict[str, Any]], *, seed: int = 0, scope: str = ""
+) -> Optional[ChaosInjector]:
+    """The harness entry point: None when chaos is off (empty spec)."""
+    if not spec:
+        return None
+    config = ChaosConfig.from_dict(dict(spec), seed=seed)
+    key = json.dumps(
+        {"scope": scope, "seed": seed, **{f: getattr(config, f) for f in _FIELDS}},
+        sort_keys=True,
+    )
+    with _INJECTORS_LOCK:
+        inj = _INJECTORS.get(key)
+        if inj is None:
+            inj = _INJECTORS[key] = ChaosInjector(config)
+            log.warning("chaos injection ACTIVE: %s", config)
+        return inj

@@ -1,0 +1,130 @@
+"""Checkpoint crash-consistency validation (restore hardening + fsck).
+
+Orbax finalizes a checkpoint by writing into a temp directory and
+renaming, so a *cleanly interrupted* save never appears in
+``all_steps()``.  What that protocol cannot protect against is damage
+*after* finalization — a torn copy/rsync, a truncated disk, a partial
+``rm``, bit-rot on the step's files — which today surfaces as an opaque
+orbax exception at restore time, killing the job at exactly the moment
+it is trying to recover.
+
+This module knows what a complete step directory looks like
+(empirically pinned against orbax 0.7.0's layout, and defensively
+lenient: only files every finalized checkpoint must have are required):
+
+    <step>/_CHECKPOINT_METADATA       finalization marker
+    <step>/state/_METADATA            array-tree metadata
+    <step>/state/manifest.ocdbt       ocdbt root manifest
+    <step>/data/...                   dataset-state JSON item
+
+``validate_step_dir`` returns the *fatal* issues (step unusable — the
+restore walk-back skips it); ``sidecar_issues`` returns the *degraded*
+ones (per-process dataset sidecars unreadable or from a different
+topology — restore still works, falling back to the primary's position).
+``fsck_checkpoints`` sweeps a whole checkpoint root for
+``scripts/fsck_checkpoints.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+# Files a finalized orbax step must carry (relative to the step dir).
+# manifest.ocdbt/_METADATA live under the composite item that holds the
+# array tree — named "state" by harness/checkpoint.py.
+_STEP_REQUIRED = ("_CHECKPOINT_METADATA",)
+_STATE_ITEM = "state"
+_STATE_REQUIRED = ("_METADATA", "manifest.ocdbt")
+
+
+def validate_step_dir(step_dir: str) -> list[str]:
+    """Fatal structural issues of one step directory ([] = valid).
+
+    Purely structural — no orbax import, no restore attempt — so it is
+    safe to run against a live training run's checkpoints and cheap
+    enough to run on every restore.
+    """
+    issues: list[str] = []
+    if not os.path.isdir(step_dir):
+        return [f"missing step directory {step_dir}"]
+    for name in _STEP_REQUIRED:
+        if not os.path.exists(os.path.join(step_dir, name)):
+            issues.append(f"missing {name} (unfinalized or torn write)")
+    state_dir = os.path.join(step_dir, _STATE_ITEM)
+    if not os.path.isdir(state_dir):
+        issues.append(f"missing {_STATE_ITEM}/ item (torn write)")
+    else:
+        for name in _STATE_REQUIRED:
+            if not os.path.exists(os.path.join(state_dir, name)):
+                issues.append(f"missing {_STATE_ITEM}/{name} (torn write)")
+    return issues
+
+
+def sidecar_issues(
+    ckpt_dir: str, step: int, process_count: Optional[int] = None
+) -> list[str]:
+    """Degraded (non-fatal) issues with a step's per-process dataset
+    sidecars: unparseable JSON, or a topology stamp that disagrees with
+    ``process_count`` (when given) — both make resume *approximate*
+    (primary-position fallback), not impossible."""
+    issues: list[str] = []
+    base = os.path.join(ckpt_dir, "dataset_states", str(step))
+    if not os.path.isdir(base):
+        return issues  # single-process runs write no sidecars: fine
+    for name in sorted(os.listdir(base)):
+        if not name.endswith(".json"):  # skips .json.tmp in-flight writes
+            continue
+        path = os.path.join(base, name)
+        try:
+            with open(path) as f:
+                wrapped = json.load(f)
+        except (OSError, ValueError) as e:
+            issues.append(f"sidecar {name}: unreadable ({e})")
+            continue
+        stamp = wrapped.get("nproc") if isinstance(wrapped, dict) else None
+        if (
+            stamp is not None
+            and process_count is not None
+            and stamp != process_count
+        ):
+            issues.append(
+                f"sidecar {name}: topology stamp nproc={stamp} != "
+                f"{process_count} (approximate resume)"
+            )
+    return issues
+
+
+def fsck_checkpoints(
+    ckpt_dir: str, process_count: Optional[int] = None
+) -> dict:
+    """Sweep every step under an orbax checkpoint root.
+
+    Returns ``{"steps": [{"step", "valid", "issues", "sidecar_issues"},
+    ...] (ascending), "latest_step", "newest_valid_step"}`` —
+    ``newest_valid_step`` is what a hardened restore would pick; it
+    differs from ``latest_step`` exactly when the restore would walk
+    back.
+    """
+    steps: list[int] = []
+    if os.path.isdir(ckpt_dir):
+        for name in os.listdir(ckpt_dir):
+            if name.isdigit() and os.path.isdir(os.path.join(ckpt_dir, name)):
+                steps.append(int(name))
+    report: dict = {"steps": [], "latest_step": None, "newest_valid_step": None}
+    for step in sorted(steps):
+        issues = validate_step_dir(os.path.join(ckpt_dir, str(step)))
+        side = sidecar_issues(ckpt_dir, step, process_count)
+        report["steps"].append(
+            {
+                "step": step,
+                "valid": not issues,
+                "issues": issues,
+                "sidecar_issues": side,
+            }
+        )
+        report["latest_step"] = step
+        if not issues:
+            report["newest_valid_step"] = step
+    return report

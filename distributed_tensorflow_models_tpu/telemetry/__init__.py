@@ -38,7 +38,11 @@ from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
     PREFETCH_FILL,
     PRODUCER_WAIT,
     REASSEMBLY_WAIT,
+    RESTARTS,
+    ROLLBACKS,
+    SKIPPED_BATCHES,
     STEP_TIME,
+    WATCHDOG_LAST_PROGRESS,
     WORKER_BUSY,
     Counter,
     Gauge,

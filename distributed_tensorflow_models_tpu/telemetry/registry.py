@@ -57,6 +57,18 @@ REASSEMBLY_WAIT = "pipeline/reassembly_wait"  # timer
 CKPT_SAVE = "checkpoint/save"  # timer
 CKPT_RESTORE = "checkpoint/restore"  # timer
 CKPT_WAIT = "checkpoint/wait"  # timer: blocking on async save completion
+# Resilience (harness/train.py + resilience/).  RESTARTS counts
+# recoverable_fit restore-retrain cycles (seeded into each attempt's fresh
+# registry so the final telemetry.json carries the cumulative count);
+# ROLLBACKS counts nan_policy="rollback" checkpoint rewinds and
+# SKIPPED_BATCHES the batches the rollback cursor-advance discarded;
+# WATCHDOG_LAST_PROGRESS is the live seconds-since-last-completed-chunk
+# gauge the step-progress watchdog maintains (a growing value with the
+# process alive = hung collective / pipeline deadlock).
+RESTARTS = "train/restarts"  # counter
+ROLLBACKS = "train/rollbacks"  # counter
+SKIPPED_BATCHES = "train/skipped_batches"  # counter
+WATCHDOG_LAST_PROGRESS = "train/watchdog_last_progress_s"  # gauge
 
 
 class Counter:

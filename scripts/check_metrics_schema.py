@@ -15,6 +15,11 @@ Checks, per line:
   appends rows from the restored (earlier) step after the crash-era
   rows — a healthy recovered run is not a lint failure;
 
+- resilience counters (``restarts``, ``rollbacks``, ``skipped_batches``
+  — README "Robustness"): injected as a full set, each non-negative
+  (not checked monotonic: a recoverable_fit restart resets the per-run
+  counters mid-file, legally);
+
 and, across the file with ``--require-telemetry``: at least one row
 carries the full telemetry key set (``data_wait_s``, ``step_time_s``,
 ``mfu``) — the TelemetryHook injects them together, so a partial set on
@@ -33,6 +38,12 @@ from typing import Iterable
 
 REQUIRED_KEYS = ("step", "time")
 TELEMETRY_KEYS = ("data_wait_s", "step_time_s", "mfu")
+# Resilience counters TelemetryHook injects alongside the telemetry keys
+# (README "Robustness").  Cumulative non-negative counts within one fit
+# attempt — a restart resets rollbacks/skipped_batches and bumps
+# restarts, so only non-negativity (not monotonicity) is checkable
+# across a whole file.  Injected as a full set, like TELEMETRY_KEYS.
+RESILIENCE_KEYS = ("restarts", "rollbacks", "skipped_batches")
 
 
 def _is_number(v) -> bool:
@@ -97,6 +108,19 @@ def check_lines(
                 f"line {i}: partial telemetry key set {present} "
                 f"(expected all of {list(TELEMETRY_KEYS)} together)"
             )
+        res_present = [k for k in RESILIENCE_KEYS if k in row]
+        if res_present and len(res_present) != len(RESILIENCE_KEYS):
+            errors.append(
+                f"line {i}: partial resilience key set {res_present} "
+                f"(expected all of {list(RESILIENCE_KEYS)} together)"
+            )
+        for key in res_present:
+            value = row[key]
+            if _is_number(value) and value < 0:
+                errors.append(
+                    f"line {i}: resilience counter {key!r} is negative: "
+                    f"{value!r}"
+                )
     return errors, rows, telemetry_rows
 
 

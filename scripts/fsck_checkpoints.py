@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Crash-consistency check for a run's checkpoints (restore hardening,
+offline form).
+
+Validates every retained orbax step under ``<workdir>/checkpoints`` (or
+a checkpoints dir given directly) with the same structural checks
+``CheckpointManager.restore`` applies before auto-resume —
+finalization marker, state-item metadata/manifest — plus the degraded
+(non-fatal) per-process dataset-sidecar checks: unparseable JSON, and
+topology stamps that disagree with ``--process-count`` when given.
+
+Output: one line per step (``OK`` / ``TORN`` / ``DEGRADED``) and a
+summary naming the step a hardened restore would actually use.  Exit 0
+when the newest step is valid, 1 when restore would walk back (or
+nothing is restorable), 2 on usage errors.
+
+``--repair`` deletes torn step directories (and their sidecar dirs) so
+the next run's ``latest_step`` is the newest *valid* step again — run it
+after a crash leaves damage, or when the restore-hardening log told you
+to.  ``--json`` emits the machine-readable report instead.
+
+No jax/orbax import: safe on a login host against live training dirs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO)
+
+from distributed_tensorflow_models_tpu.resilience import fsck  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "path",
+        help="run workdir (containing checkpoints/) or a checkpoints dir",
+    )
+    p.add_argument(
+        "--process-count", type=int, default=None,
+        help="expected topology: flag sidecars stamped with a different "
+        "process count (approximate-resume warning)",
+    )
+    p.add_argument(
+        "--repair", action="store_true",
+        help="delete torn step directories (and their dataset_states/) "
+        "so latest_step becomes the newest valid step",
+    )
+    p.add_argument("--json", action="store_true", help="emit the raw report")
+    args = p.parse_args(argv)
+
+    ckpt_dir = args.path
+    nested = os.path.join(args.path, "checkpoints")
+    if os.path.isdir(nested):
+        ckpt_dir = nested
+    if not os.path.isdir(ckpt_dir):
+        print(f"error: no checkpoint directory at {ckpt_dir}", file=sys.stderr)
+        return 2
+
+    report = fsck.fsck_checkpoints(ckpt_dir, args.process_count)
+    repaired = []
+    if args.repair:
+        for entry in report["steps"]:
+            if entry["valid"]:
+                continue
+            step = entry["step"]
+            shutil.rmtree(os.path.join(ckpt_dir, str(step)), ignore_errors=True)
+            shutil.rmtree(
+                os.path.join(ckpt_dir, "dataset_states", str(step)),
+                ignore_errors=True,
+            )
+            repaired.append(step)
+        if repaired:
+            report = fsck.fsck_checkpoints(ckpt_dir, args.process_count)
+        report["repaired_steps"] = repaired
+
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        for entry in report["steps"]:
+            if not entry["valid"]:
+                status = "TORN"
+            elif entry["sidecar_issues"]:
+                status = "DEGRADED"
+            else:
+                status = "OK"
+            print(f"step {entry['step']:>10d}  {status}")
+            for issue in entry["issues"]:
+                print(f"    {issue}")
+            for issue in entry["sidecar_issues"]:
+                print(f"    (sidecar) {issue}")
+        if repaired:
+            print(f"repaired: removed torn steps {repaired}")
+        if report["newest_valid_step"] is None:
+            print("no restorable checkpoint")
+        elif report["newest_valid_step"] != report["latest_step"]:
+            print(
+                f"restore would WALK BACK: newest step "
+                f"{report['latest_step']} is torn; newest valid is "
+                f"{report['newest_valid_step']}"
+            )
+        else:
+            print(f"restore target: step {report['newest_valid_step']}")
+
+    ok = (
+        report["newest_valid_step"] is not None
+        and report["newest_valid_step"] == report["latest_step"]
+    ) or (
+        # Repair that removed every (torn) step leaves a clean slate —
+        # the next run fresh-inits; that's the repaired state, exit 0.
+        args.repair
+        and not report["steps"]
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -491,6 +491,8 @@ def test_recoverable_fit_survives_injected_fault(mesh8, tmp_path):
         str(tmp_path),
         mesh=mesh8,
         max_restarts=2,
+        backoff_base_s=0.0,  # keep the test immediate (backoff pinned
+        # separately in tests/test_resilience.py)
         extra_hooks=[fault],
     )
     assert int(result.state.step) == 8
@@ -516,6 +518,7 @@ def test_recoverable_fit_gives_up_after_max_restarts(mesh8, tmp_path):
             str(tmp_path),
             mesh=mesh8,
             max_restarts=2,
+            backoff_base_s=0.0,
             extra_hooks=[AlwaysFault()],
         )
 

@@ -1,0 +1,817 @@
+"""Resilience tests: preemption grace, divergence rollback, restore
+hardening, the chaos injector, the watchdog, and restart backoff.
+
+The chaos acceptance contract (ISSUE 4): under injected pipeline-worker
+failure, mid-run SIGTERM, and torn-checkpoint faults, training resumes
+and the final ``TrainState`` is **bit-identical** to the fault-free run;
+under injected NaN with ``nan_policy="rollback"`` the run completes with
+exactly the offending chunk's batches skipped and the
+``train/rollbacks``/``train/skipped_batches`` counters reflecting it;
+with ``nan_policy="abort"`` (default) behavior is unchanged.
+
+All runs are the tiny LeNet config on the fake 8-device CPU mesh; the
+fault-free reference trajectory is computed once per module.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu import resilience, telemetry
+from distributed_tensorflow_models_tpu.core import train_loop
+from distributed_tensorflow_models_tpu.harness import (
+    checkpoint as ckptlib,
+    config as configlib,
+    hooks as hooklib,
+    train as trainlib,
+)
+from distributed_tensorflow_models_tpu.resilience import chaos as chaoslib
+from distributed_tensorflow_models_tpu.resilience import fsck as fscklib
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _load_script(name):
+    from importlib import util as importutil
+
+    spec = importutil.spec_from_file_location(
+        name, os.path.join(_SCRIPTS, f"{name}.py")
+    )
+    mod = importutil.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+STEPS = 8
+
+
+def _cfg(**kw):
+    base = dict(
+        train_steps=STEPS,
+        global_batch_size=32,
+        log_every_steps=2,
+        checkpoint_every_secs=10_000.0,
+    )
+    base.update(kw)
+    return configlib.get_config("lenet_mnist", **base)
+
+
+def _host_tree(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def _assert_states_bit_identical(a, b):
+    """Exact (bitwise) equality of params AND optimizer slots — the
+    strongest statement that recovery replayed the same trajectory."""
+    for name, ta, tb in (("params", a.params, b.params),
+                         ("opt_state", a.opt_state, b.opt_state)):
+        la = jax.tree_util.tree_leaves(ta)
+        lb = jax.tree_util.tree_leaves(tb)
+        assert len(la) == len(lb), name
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(scope="module")
+def baseline(mesh8, tmp_path_factory):
+    """The fault-free run every recovery test compares against.  Runs
+    under the watchdog (which must not perturb the trajectory — the
+    bit-identity tests double as proof)."""
+    workdir = tmp_path_factory.mktemp("baseline")
+    return trainlib.fit(
+        _cfg(watchdog_timeout_s=300.0), str(workdir), mesh=mesh8
+    )
+
+
+# --------------------------------------------------------------------------
+# Preemption grace
+# --------------------------------------------------------------------------
+
+
+def test_preemption_listener_flag_and_escalation():
+    listener = resilience.PreemptionListener()
+    assert listener.install()
+    try:
+        assert not listener.preempted
+        signal.raise_signal(signal.SIGTERM)
+        assert listener.preempted
+        # SIGTERM again: still just the flag (idempotent grace).
+        signal.raise_signal(signal.SIGTERM)
+        assert listener.preempted
+        # First ctrl-C — even after SIGTERM set the flag — stays
+        # graceful: the operator's reflex must not kill the emergency
+        # save mid-write.
+        signal.raise_signal(signal.SIGINT)
+        assert listener.preempted
+        # Second ctrl-C escalates to KeyboardInterrupt.
+        with pytest.raises(KeyboardInterrupt):
+            signal.raise_signal(signal.SIGINT)
+    finally:
+        listener.uninstall()
+
+
+def test_chaos_sigterm_preempts_then_resumes_bit_identical(
+    mesh8, tmp_path, baseline
+):
+    """Mid-run SIGTERM → emergency checkpoint + preempted marker; the
+    rerun resumes and finishes bit-identical to the fault-free run.  The
+    first run goes through recoverable_fit, which must hand the
+    preempted result back (resumable) instead of burning a restart."""
+    cfg = _cfg(chaos={"sigterm_at_step": 4})
+    first = trainlib.recoverable_fit(
+        cfg, str(tmp_path), mesh=mesh8, backoff_base_s=0.0
+    )
+    assert first.preempted
+    assert int(first.state.step) == 4  # stopped at the signal's boundary
+    # The emergency checkpoint is durable and restorable.
+    mgr = ckptlib.CheckpointManager(str(tmp_path))
+    assert mgr.latest_step() == 4
+    mgr.close()
+
+    second = trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    assert not second.preempted
+    assert second.steps_run == STEPS - 4  # resumed, not re-trained
+    assert int(second.state.step) == STEPS
+    _assert_states_bit_identical(second.state, baseline.state)
+
+
+# --------------------------------------------------------------------------
+# Pipeline-worker fault
+# --------------------------------------------------------------------------
+
+
+def test_pipeline_worker_fault_recovers_bit_identical(
+    mesh8, tmp_path, baseline
+):
+    """assemble() raises inside the producer at batch 3: the crash-time
+    save holds the exact consumed position, the restart replays the
+    failed batch (chaos fires once per process), and the final state is
+    bit-identical to fault-free.  Run with a worker pool so the fault
+    travels the ordered-reassembly path."""
+    cfg = _cfg(chaos={"pipeline_fail_at_batch": 3}, data_workers=2)
+    res = trainlib.recoverable_fit(
+        cfg, str(tmp_path), mesh=mesh8, max_restarts=2, backoff_base_s=0.0
+    )
+    assert int(res.state.step) == STEPS
+    _assert_states_bit_identical(res.state, baseline.state)
+    with open(os.path.join(str(tmp_path), "telemetry.json")) as f:
+        snap = json.load(f)["metrics"]
+    assert snap.get("train/restarts") == 1.0
+
+
+# --------------------------------------------------------------------------
+# Torn checkpoint → restore hardening walk-back
+# --------------------------------------------------------------------------
+
+
+def test_torn_checkpoint_walks_back_and_resumes_bit_identical(
+    mesh8, tmp_path, baseline
+):
+    """The only checkpoint is torn after finalization: fsck reports it,
+    restore_or_init falls back to a fresh init (better than a dead job),
+    and the re-trained run is bit-identical to fault-free."""
+    cfg4 = _cfg(train_steps=4, chaos={"torn_checkpoint_at_step": 4})
+    trainlib.fit(cfg4, str(tmp_path), mesh=mesh8)
+
+    ckpt_dir = os.path.join(str(tmp_path), "checkpoints")
+    report = fscklib.fsck_checkpoints(ckpt_dir)
+    assert report["latest_step"] == 4
+    assert report["steps"][-1]["valid"] is False
+    assert report["newest_valid_step"] is None
+
+    cfg8 = _cfg(chaos={"torn_checkpoint_at_step": 4})
+    res = trainlib.fit(cfg8, str(tmp_path), mesh=mesh8)
+    assert res.steps_run == STEPS  # fresh re-train: nothing restorable
+    _assert_states_bit_identical(res.state, baseline.state)
+
+
+def test_mid_run_tear_fires_without_save_cadence(mesh8, tmp_path, baseline):
+    """``torn_checkpoint_at_step`` must fire even when no save cadence
+    lands at that step (the clock cadence here is effectively off): the
+    injector's tear hook forces a durable save at k and tears it, the
+    run completes unperturbed, and fsck reports the torn step next to
+    the valid final checkpoint."""
+    cfg = _cfg(chaos={"torn_checkpoint_at_step": 3})
+    res = trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    assert res.steps_run == STEPS
+    _assert_states_bit_identical(res.state, baseline.state)
+    report = fscklib.fsck_checkpoints(
+        os.path.join(str(tmp_path), "checkpoints")
+    )
+    by_step = {s["step"]: s["valid"] for s in report["steps"]}
+    assert by_step[3] is False  # the tear really injected
+    assert report["newest_valid_step"] == STEPS  # final save intact
+
+
+def test_chaos_warns_when_fault_never_fires(mesh8, tmp_path, caplog):
+    """A drill whose fault position is never reached must say so — an
+    exit-0 run with a silently unfired fault would read as a passed
+    drill that never exercised anything."""
+    import logging
+
+    cfg = _cfg(chaos={"nan_at_step": 10_000})
+    with caplog.at_level(logging.WARNING, logger="dtm"):
+        res = trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    assert res.steps_run == STEPS
+    assert "never fired" in caplog.text
+    assert "nan_at_step=10000" in caplog.text
+
+
+def _tiny_state(step=0):
+    from distributed_tensorflow_models_tpu.core.train_state import TrainState
+    from distributed_tensorflow_models_tpu.models import get_model
+    from distributed_tensorflow_models_tpu.ops import optim
+
+    state = TrainState.create(
+        get_model("lenet", num_classes=4),
+        optim.tf_momentum(0.1, 0.9),
+        jax.random.key(0),
+        jnp.zeros((2, 28, 28, 1)),
+    )
+    return state.replace(step=jnp.asarray(step, jnp.int32))
+
+
+def _tear(ckpt_dir, step, names=("_METADATA", "manifest.ocdbt")):
+    for name in names:
+        path = os.path.join(ckpt_dir, str(step), "state", name)
+        if os.path.exists(path):
+            os.remove(path)
+
+
+def test_restore_walks_back_to_newest_valid_step(tmp_path):
+    """Synthesized torn latest: restore(step=None) silently returns the
+    previous valid step; the explicit-step path still errors."""
+    mgr = ckptlib.CheckpointManager(str(tmp_path), keep=5)
+    for step in (1, 2, 3):
+        assert mgr.save(_tiny_state(step), {"pos": step}, force=True)
+    mgr.wait()
+    _tear(mgr.directory, 3)
+
+    restored, data = mgr.restore(_tiny_state())
+    assert int(restored.step) == 2
+    assert data == {"pos": 2}
+    with pytest.raises(Exception):
+        mgr.restore(_tiny_state(), step=3)  # explicit step: no walk-back
+    mgr.close()
+
+
+def test_train_resume_walks_past_non_finite_crash_save(tmp_path):
+    """A structurally-valid checkpoint holding post-divergence NaN state
+    (e.g. CheckpointHook.abort's crash-save after a NaN trip) must not
+    brick the workdir: the TRAINING resume path (restore_or_init) gates
+    on finiteness and restores the newest FINITE step, while the plain
+    restore() eval/generate use stays ungated and sees the newest
+    structurally-valid step."""
+    mgr = ckptlib.CheckpointManager(str(tmp_path), keep=5)
+    assert mgr.save(_tiny_state(1), {"pos": 1}, force=True)
+    poisoned = _tiny_state(2)
+    poisoned = poisoned.replace(
+        params=jax.tree.map(lambda x: x * jnp.nan, poisoned.params)
+    )
+    assert mgr.save(poisoned, {"pos": 2}, force=True)
+    mgr.wait()
+    state, data, restored = ckptlib.restore_or_init(mgr, _tiny_state())
+    assert restored and int(state.step) == 1
+    assert data == {"pos": 1}
+    # Eval-style restore is ungated: newest structurally-valid step wins.
+    evaled, _ = mgr.restore(_tiny_state())
+    assert int(evaled.step) == 2
+    mgr.close()
+
+
+def test_restore_or_init_fresh_when_everything_torn(tmp_path):
+    mgr = ckptlib.CheckpointManager(str(tmp_path), keep=5)
+    assert mgr.save(_tiny_state(1), {"pos": 1}, force=True)
+    mgr.wait()
+    _tear(mgr.directory, 1)
+    template = _tiny_state()
+    state, data, restored = ckptlib.restore_or_init(mgr, template)
+    assert not restored and state is template and data == {}
+    mgr.close()
+
+
+def test_corrupt_dataset_sidecar_falls_back_to_primary(tmp_path, caplog):
+    """Satellite bugfix: a truncated sidecar must degrade to the
+    primary's position (like a missing one), not kill the restore."""
+    mgr = ckptlib.CheckpointManager(
+        str(tmp_path), keep=2, process_index=1, process_count=2
+    )
+    assert mgr.save(_tiny_state(5), {"pos": "primary"})
+    mgr.wait()
+    sidecar = os.path.join(
+        str(tmp_path), "checkpoints/dataset_states/5/p1.json"
+    )
+    with open(sidecar, "w") as f:
+        f.write('{"nproc": 2, "state": {"pos": "sid')  # torn write
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="dtm"):
+        _, data = mgr.restore(_tiny_state())
+    assert data == {"pos": "primary"}
+    assert "unreadable" in caplog.text
+    mgr.close()
+
+
+def test_fsck_script_reports_and_repairs(tmp_path, capsys):
+    """scripts/fsck_checkpoints.py: torn latest + stale-topology sidecar
+    + unparseable sidecar are all reported; --repair removes the torn
+    step so the next restore target is the newest valid step."""
+    fsck_checkpoints = _load_script("fsck_checkpoints")
+
+    mgr = ckptlib.CheckpointManager(
+        str(tmp_path), keep=5, process_index=0, process_count=2
+    )
+    for step in (1, 2):
+        assert mgr.save(_tiny_state(step), {"pos": step}, force=True)
+    mgr.wait()
+    # Stale topology stamp on step 1's sidecar; garbage on step 2's.
+    with open(
+        os.path.join(mgr.directory, "dataset_states/1/p0.json"), "w"
+    ) as f:
+        json.dump({"nproc": 4, "state": {}}, f)
+    with open(
+        os.path.join(mgr.directory, "dataset_states/2/p0.json"), "w"
+    ) as f:
+        f.write("not json")
+    _tear(mgr.directory, 2)
+    mgr.close()
+
+    rc = fsck_checkpoints.main([str(tmp_path), "--process-count", "2"])
+    out = capsys.readouterr().out
+    assert rc == 1  # latest is torn: restore would walk back
+    assert "TORN" in out and "WALK BACK" in out
+    assert "topology stamp nproc=4" in out
+    assert "unreadable" in out
+
+    rc = fsck_checkpoints.main([str(tmp_path), "--repair"])
+    out = capsys.readouterr().out
+    assert rc == 0  # torn step removed; newest valid (1) is now latest
+    assert "repaired" in out
+    report = fscklib.fsck_checkpoints(os.path.join(str(tmp_path), "checkpoints"))
+    assert report["latest_step"] == 1
+    assert report["newest_valid_step"] == 1
+
+
+# --------------------------------------------------------------------------
+# Divergence rollback
+# --------------------------------------------------------------------------
+
+
+def test_nan_abort_default_unchanged(mesh8, tmp_path):
+    """nan_policy="abort" (default): the injected NaN propagates exactly
+    as the reference NanTensorHook would — no rollback machinery."""
+    cfg = _cfg(train_steps=4, chaos={"nan_at_step": 2})
+    with pytest.raises(FloatingPointError, match="at step 2"):
+        trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+
+
+def test_nan_rollback_skips_exactly_one_batch_unfused(mesh8, tmp_path):
+    """Unfused loop: the offending "chunk" is one step — exactly one
+    batch is skipped, once, and the run completes with finite loss."""
+    cfg = _cfg(nan_policy="rollback", chaos={"nan_at_step": 4})
+    res = trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    assert int(res.state.step) == STEPS
+    assert res.rollbacks == 1
+    assert res.skipped_batches == 1
+    assert np.isfinite(res.final_metrics["loss"])
+    with open(os.path.join(str(tmp_path), "telemetry.json")) as f:
+        snap = json.load(f)["metrics"]
+    assert snap["train/rollbacks"] == 1.0
+    assert snap["train/skipped_batches"] == 1.0
+    # The injected counters ride metrics.jsonl rows (schema-linted set).
+    rows = [
+        json.loads(line)
+        for line in open(os.path.join(str(tmp_path), "metrics.jsonl"))
+    ]
+    assert rows[-1]["rollbacks"] == 1.0 and rows[-1]["skipped_batches"] == 1.0
+
+
+def test_nan_rollback_skips_exactly_offending_chunk_fused(mesh8, tmp_path):
+    """Fused loop (steps_per_loop=4): a mid-chunk NaN rolls back and
+    skips exactly that chunk's 4 batches — the exactly-K-skipped
+    acceptance contract."""
+    cfg = _cfg(
+        nan_policy="rollback",
+        steps_per_loop=4,
+        log_every_steps=4,
+        chaos={"nan_at_step": 3},
+    )
+    res = trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    assert int(res.state.step) == STEPS
+    assert res.rollbacks == 1
+    assert res.skipped_batches == 4
+    assert np.isfinite(res.final_metrics["loss"])
+
+
+def test_nan_rollback_detects_off_cadence_divergence(mesh8, tmp_path):
+    """Rollback guards EVERY chunk itself (one readback per chunk), so
+    detection lands in the offending chunk even when the NaN guard's
+    log-cadence walk would have missed it entirely — here the cadence
+    (100) never fires within the run at all."""
+    cfg = _cfg(
+        nan_policy="rollback",
+        steps_per_loop=4,
+        log_every_steps=100,
+        chaos={"nan_at_step": 6},
+    )
+    res = trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    assert int(res.state.step) == STEPS
+    assert res.rollbacks == 1
+    assert res.skipped_batches == 4  # exactly the offending chunk (5..8)
+    assert train_loop.state_is_finite(res.state)
+
+
+def test_nan_rollback_budget_exhausts_on_persistent_divergence(
+    mesh8, tmp_path
+):
+    """A divergence that survives rollback (here: a hook that raises at
+    every attempt) must exhaust the budget and abort — never loop."""
+
+    class AlwaysNan(hooklib.Hook):
+        def after_step(self, state, metrics, step):
+            if step == 2:
+                raise FloatingPointError("loss is nan at step 2")
+
+    cfg = _cfg(train_steps=4, nan_policy="rollback", rollback_budget=1)
+    with pytest.raises(FloatingPointError):
+        trainlib.fit(
+            cfg, str(tmp_path), mesh=mesh8, extra_hooks=[AlwaysNan()]
+        )
+
+
+def test_save_at_existing_step_is_idempotent_not_fatal(tmp_path):
+    """Orbax raises StepAlreadyExistsError on a re-save (force=True
+    included); the manager must treat it as already-durable instead —
+    the preemption emergency save can land at a boundary the cadence
+    save just wrote, and a crash there turns grace into failure."""
+    mgr = ckptlib.CheckpointManager(str(tmp_path), keep=5)
+    assert mgr.save(_tiny_state(3), {"pos": 3}, force=True)
+    mgr.wait()
+    assert mgr.save(_tiny_state(3), {"pos": 3}, force=True) is False
+    assert mgr.all_steps() == [3]
+    mgr.close()
+
+
+def test_save_replaces_torn_dir_at_same_step(tmp_path):
+    """The idempotency skip must not trust a torn dir: a real save at
+    that step (e.g. the emergency save after the cadence save's write
+    was damaged) replaces the damage instead of silently no-opping."""
+    mgr = ckptlib.CheckpointManager(str(tmp_path), keep=5)
+    assert mgr.save(_tiny_state(3), {"pos": "old"}, force=True)
+    mgr.wait()
+    _tear(mgr.directory, 3)
+    assert mgr.save(_tiny_state(3), {"pos": "new"}, force=True)
+    mgr.wait()
+    restored, data = mgr.restore(_tiny_state())
+    assert int(restored.step) == 3 and data == {"pos": "new"}
+    mgr.close()
+
+
+def test_rollback_anchor_exists_after_torn_fresh_init(mesh8, tmp_path):
+    """Fresh-init fallback (checkpoints exist but all torn) must still
+    bank the rollback anchor — gated on `not restored`, not on
+    latest_step() — so the first divergence has a rewind target."""
+    cfg4 = _cfg(train_steps=4, chaos={"torn_checkpoint_at_step": 4})
+    trainlib.fit(cfg4, str(tmp_path), mesh=mesh8)  # leaves only torn 4
+    cfg8 = _cfg(nan_policy="rollback", chaos={"nan_at_step": 6})
+    res = trainlib.fit(cfg8, str(tmp_path), mesh=mesh8)
+    assert int(res.state.step) == STEPS
+    assert res.rollbacks == 1 and res.skipped_batches == 1
+    assert train_loop.state_is_finite(res.state)
+
+
+def test_rollback_deletes_post_divergence_checkpoints(tmp_path):
+    """CheckpointManager.delete removes a retained step (what _rollback
+    uses to clear the abandoned timeline so replay saves aren't shadowed
+    by stale post-divergence checkpoints)."""
+    mgr = ckptlib.CheckpointManager(str(tmp_path), keep=5)
+    for s in (1, 2):
+        assert mgr.save(_tiny_state(s), {"pos": s}, force=True)
+    mgr.wait()
+    mgr.delete(2)
+    assert mgr.all_steps() == [1]
+    # The freed step can be saved again (the replay's own save).
+    assert mgr.save(_tiny_state(2), {"pos": "replay"}, force=True)
+    mgr.close()
+
+
+def test_launch_aggregate_exit_codes():
+    from distributed_tensorflow_models_tpu import launch
+
+    R = launch.RESUMABLE_EXIT_CODE
+    assert launch.aggregate_exit_codes([0, 0]) == 0
+    assert launch.aggregate_exit_codes([0, R]) == R
+    # A real failure must win over "preempted" — never relabeled resumable.
+    assert launch.aggregate_exit_codes([R, 1]) == 1
+    assert launch.aggregate_exit_codes([2, R, 0]) == 2
+    assert launch.aggregate_exit_codes([]) == 0
+
+
+def test_state_is_finite():
+    state = _tiny_state()
+    assert train_loop.state_is_finite(state)
+    bad = state.replace(
+        params=jax.tree.map(lambda x: x * jnp.nan, state.params)
+    )
+    assert not train_loop.state_is_finite(bad)
+
+
+# --------------------------------------------------------------------------
+# Watchdog
+# --------------------------------------------------------------------------
+
+
+def test_watchdog_diagnoses_stall_and_escalates(caplog):
+    import logging
+    import time
+
+    reg = telemetry.MetricsRegistry()
+    fired = []
+    wd = resilience.ProgressWatchdog(
+        0.05,
+        registry=reg,
+        abort=True,
+        abort_fn=lambda: fired.append(1),
+        poll_s=0.01,
+    )
+    try:
+        with caplog.at_level(logging.ERROR, logger="dtm"):
+            # Abort is disarmed until the first completed chunk (the
+            # initial-compile grace): a never-beaten watchdog warns only.
+            time.sleep(0.25)
+            assert not fired
+            assert "no training progress" in caplog.text
+            wd.beat(1)  # first chunk done: abort arms
+            deadline = time.time() + 5.0
+            while not fired and time.time() < deadline:
+                time.sleep(0.01)
+    finally:
+        wd.stop()
+    assert fired  # abort_fn ran (from the second timeout interval on)
+    assert "no training progress" in caplog.text
+    assert reg.snapshot()[telemetry.WATCHDOG_LAST_PROGRESS] > 0.0
+    # A beat resets the stall clock and the gauge.
+    wd2 = resilience.ProgressWatchdog(10.0, registry=reg, poll_s=0.01)
+    wd2.beat(7)
+    wd2.stop()
+    assert reg.snapshot()[telemetry.WATCHDOG_LAST_PROGRESS] == 0.0
+
+
+def test_fit_setup_failure_releases_signal_handlers(mesh8, tmp_path):
+    """A failure between handler install and the main loop (here: an
+    invalid watchdog timeout) must not leak the replaced SIGTERM/SIGINT
+    handlers, the watchdog thread, or the already-started input-pipeline
+    threads into the caller."""
+    import threading
+
+    before = (
+        signal.getsignal(signal.SIGTERM), signal.getsignal(signal.SIGINT)
+    )
+    cfg = _cfg(train_steps=2, watchdog_timeout_s=-5.0)
+    with pytest.raises(ValueError, match="watchdog timeout"):
+        trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    after = (
+        signal.getsignal(signal.SIGTERM), signal.getsignal(signal.SIGINT)
+    )
+    assert after == before
+    leaked = [
+        t.name
+        for t in threading.enumerate()
+        if t.is_alive()
+        and t.name.startswith(("host-pipeline", "data-worker"))
+    ]
+    assert leaked == []
+
+
+def test_fit_hook_setup_failure_leaks_nothing(mesh8, tmp_path):
+    """A failure AFTER the pipeline threads start but before the main
+    loop (here: MetricWriterHook's eager open hitting a metrics path
+    occupied by a directory) must tear down the pipeline and restore
+    the signal handlers, same as the watchdog-validation failure."""
+    import threading
+
+    before = (
+        signal.getsignal(signal.SIGTERM), signal.getsignal(signal.SIGINT)
+    )
+    (tmp_path / "metrics.jsonl").mkdir()
+    with pytest.raises(OSError):
+        trainlib.fit(_cfg(train_steps=2), str(tmp_path), mesh=mesh8)
+    assert (
+        signal.getsignal(signal.SIGTERM), signal.getsignal(signal.SIGINT)
+    ) == before
+    leaked = [
+        t.name
+        for t in threading.enumerate()
+        if t.is_alive()
+        and t.name.startswith(("host-pipeline", "data-worker"))
+    ]
+    assert leaked == []
+
+
+def test_watchdog_abort_disabled_off_main_thread(caplog):
+    """The default abort (interrupt_main) targets the main thread; a
+    watchdog built off it must drop the abort (keeping the diagnosis)
+    instead of interrupting the caller's unrelated work."""
+    import logging
+    import threading
+
+    out = {}
+
+    def build():
+        with caplog.at_level(logging.WARNING, logger="dtm"):
+            wd = resilience.ProgressWatchdog(10.0, abort=True, poll_s=0.01)
+            out["abort"] = wd._abort
+            wd.stop()
+
+    t = threading.Thread(target=build)
+    t.start()
+    t.join()
+    assert out["abort"] is False
+    assert "watchdog abort disabled" in caplog.text
+    # On the main thread the abort stays armed.
+    wd = resilience.ProgressWatchdog(10.0, abort=True, poll_s=0.01)
+    try:
+        assert wd._abort is True
+    finally:
+        wd.stop()
+
+
+def test_fit_with_watchdog_runs_clean(baseline):
+    """Wiring smoke: the baseline run executed under the watchdog
+    (fixture cfg) — a healthy run completes and leaks no watchdog
+    thread."""
+    import threading
+
+    assert baseline.steps_run == STEPS
+    assert not any(
+        t.name == "progress-watchdog" for t in threading.enumerate()
+    )
+
+
+# --------------------------------------------------------------------------
+# Restart backoff
+# --------------------------------------------------------------------------
+
+
+def test_restart_backoff_deterministic_jittered_growth():
+    d1 = trainlib.restart_backoff(1, base_s=1.0, max_s=60.0, seed=3)
+    d2 = trainlib.restart_backoff(2, base_s=1.0, max_s=60.0, seed=3)
+    d5 = trainlib.restart_backoff(5, base_s=1.0, max_s=60.0, seed=3)
+    assert d1 == trainlib.restart_backoff(1, base_s=1.0, max_s=60.0, seed=3)
+    assert 0.5 <= d1 < 1.0  # half-jitter band of 1s
+    assert 1.0 <= d2 < 2.0
+    assert 8.0 <= d5 < 16.0
+    # Jitter decorrelates seeds; the cap bounds the wait; 0 disables.
+    assert d1 != trainlib.restart_backoff(1, base_s=1.0, max_s=60.0, seed=4)
+    assert trainlib.restart_backoff(30, base_s=1.0, max_s=60.0, seed=3) <= 60.0
+    assert trainlib.restart_backoff(3, base_s=0.0, seed=3) == 0.0
+
+
+def test_recoverable_fit_sleeps_backoff(mesh8, tmp_path, monkeypatch):
+    """The backoff waits on the PREEMPTION-AWARE listener.wait (not
+    time.sleep — a notice must wake it immediately) for exactly the
+    deterministic restart_backoff delay."""
+    slept = []
+    monkeypatch.setattr(
+        resilience.PreemptionListener,
+        "wait",
+        lambda self, t: (slept.append(t), False)[1],
+    )
+
+    class Preempted(ConnectionError):
+        pass
+
+    cfg = _cfg(train_steps=2)
+    fault = hooklib.FaultInjectionHook(1, lambda: Preempted("chip lost"))
+    res = trainlib.recoverable_fit(
+        cfg, str(tmp_path), mesh=mesh8, max_restarts=2,
+        backoff_base_s=0.25, extra_hooks=[fault],
+    )
+    assert int(res.state.step) == 2
+    assert slept == [
+        trainlib.restart_backoff(1, base_s=0.25, max_s=60.0, seed=cfg.seed)
+    ]
+
+
+# --------------------------------------------------------------------------
+# Chaos plumbing + schema lint
+# --------------------------------------------------------------------------
+
+
+def test_parse_chaos_spec():
+    assert chaoslib.parse_chaos_spec("nan_at_step=5, sigterm_at_step=9") == {
+        "nan_at_step": 5,
+        "sigterm_at_step": 9,
+    }
+    assert chaoslib.parse_chaos_spec("") == {}
+    with pytest.raises(ValueError, match="unknown chaos key"):
+        chaoslib.parse_chaos_spec("explode_at=3")
+    with pytest.raises(ValueError, match="key=value"):
+        chaoslib.parse_chaos_spec("nan_at_step")
+    with pytest.raises(ValueError, match="must be int"):
+        chaoslib.parse_chaos_spec("nan_at_step=soon")
+
+
+def test_cli_preempt_poll_steps_override():
+    from types import SimpleNamespace
+
+    from distributed_tensorflow_models_tpu.harness import cli
+
+    args = SimpleNamespace(
+        train_steps=None, batch_size=None, seed=None, preempt_poll_steps=7
+    )
+    assert cli._overrides(args)["preempt_poll_steps"] == 7
+
+
+def test_chaos_injector_memoized_per_scope_and_fires_once():
+    spec = {"pipeline_fail_at_batch": 1}
+    a = chaoslib.get_injector(spec, seed=0, scope="/tmp/scope-a-xyz")
+    b = chaoslib.get_injector(spec, seed=0, scope="/tmp/scope-a-xyz")
+    c = chaoslib.get_injector(spec, seed=0, scope="/tmp/scope-b-xyz")
+    assert a is b and a is not c
+    assert chaoslib.get_injector({}, seed=0, scope="x") is None
+
+    class TwoBatch:
+        def __init__(self):
+            self.i = 0
+
+        def next_work(self):
+            self.i += 1
+            return self.i - 1
+
+        def assemble(self, work):
+            return {"x": np.zeros(1)}
+
+    ds = a.wrap_dataset(TwoBatch())
+    assert ds.assemble(ds.next_work()) is not None  # batch 0 fine
+    with pytest.raises(chaoslib.ChaosPipelineError):
+        ds.assemble(ds.next_work())  # batch 1 faults...
+    assert ds.assemble(ds.next_work()) is not None  # ...exactly once
+
+
+def test_chaos_pipeline_fault_warns_on_mid_process_reposition(caplog):
+    """An armed pipeline fault counts dispatches, not stream batches —
+    a mid-process cursor rewind (rollback replay) shifts its position,
+    and that must be said out loud, not silently misfire."""
+    import logging
+
+    class DS:
+        def __init__(self):
+            self.i = 0
+
+        def next_work(self):
+            self.i += 1
+            return self.i - 1
+
+        def assemble(self, work):
+            return {"x": np.zeros(1)}
+
+        def get_state(self):
+            return {"i": self.i}
+
+        def set_state(self, s):
+            self.i = s["i"]
+
+    inj = chaoslib.ChaosInjector(
+        chaoslib.ChaosConfig(pipeline_fail_at_batch=5)
+    )
+    ds = inj.wrap_dataset(DS())
+    with caplog.at_level(logging.WARNING, logger="dtm"):
+        ds.set_state({"i": 0})  # no dispatches yet: entry restore, silent
+        assert "still armed" not in caplog.text
+        ds.assemble(ds.next_work())
+        ds.set_state({"i": 0})  # mid-process rewind: warn
+    assert "still armed" in caplog.text
+
+
+def test_metrics_schema_resilience_keys():
+    check_lines = _load_script("check_metrics_schema").check_lines
+
+    good = json.dumps(
+        {
+            "step": 1, "time": 1.0,
+            "restarts": 0, "rollbacks": 1, "skipped_batches": 4,
+        }
+    )
+    errors, rows, _ = check_lines([good])
+    assert errors == [] and rows == 1
+    errors, _, _ = check_lines(
+        [json.dumps({"step": 1, "time": 1.0, "rollbacks": 1})]
+    )
+    assert any("partial resilience key set" in e for e in errors)
+    errors, _, _ = check_lines(
+        [
+            json.dumps(
+                {
+                    "step": 1, "time": 1.0,
+                    "restarts": -1, "rollbacks": 0, "skipped_batches": 0,
+                }
+            )
+        ]
+    )
+    assert any("negative" in e for e in errors)
