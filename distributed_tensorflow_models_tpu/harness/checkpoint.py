@@ -22,6 +22,16 @@ atomic rename, pruned alongside orbax's keep-k GC) and restores from its
 own sidecar — exact per-process resume even for the file-sharded ImageNet
 stream, where every process's shard position differs.  The reference's
 queue pipeline cannot resume input position at all (SURVEY.md §5.4).
+
+Every fleet-visible *decision* about the shared checkpoint directory —
+the save skip/replace choice, the restore walk's step pick, and
+restore-vs-fresh-init — is **chief-decided**: process 0 computes it from
+its own storage view and broadcasts it
+(``resilience/consensus.py``; exact no-op single-process), so storage
+with cross-host visibility skew (object stores, replicated NFS) cannot
+put two processes into different collectives.  A follower whose local
+view disagrees obeys the chief, logs the skew, and counts it into
+``fleet/consensus_overrides``.
 """
 
 from __future__ import annotations
@@ -30,18 +40,25 @@ import json
 import logging
 import os
 import shutil
-from typing import Any, Optional
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import orbax.checkpoint as ocp
 
 from distributed_tensorflow_models_tpu import telemetry
 from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.resilience import consensus as conslib
 from distributed_tensorflow_models_tpu.resilience import fsck as fscklib
 
 log = logging.getLogger("dtm")
 
 PyTree = Any
+
+# Chief-broadcast save decision codes (ints — broadcastable).
+_SAVE_PROCEED = 0
+_SAVE_SKIP_INFLIGHT = 1
+_SAVE_SKIP_EXISTS = 2
+_SAVE_REPLACE = 3
 
 
 class NoValidCheckpointError(FileNotFoundError):
@@ -78,6 +95,8 @@ class CheckpointManager:
         process_index: Optional[int] = None,
         process_count: Optional[int] = None,
         registry: Optional[telemetry.MetricsRegistry] = None,
+        consensus: Optional[conslib.Consensus] = None,
+        step_filter: Optional[Callable[[Sequence[int]], Sequence[int]]] = None,
     ):
         self._registry = (
             registry if registry is not None else telemetry.get_registry()
@@ -99,12 +118,73 @@ class CheckpointManager:
         self._nproc = (
             jax.process_count() if process_count is None else process_count
         )
+        # Consensus defaults to the LIVE process facts, not the injected
+        # ones: the injectable pid/nproc exist so sidecar paths are
+        # unit-testable in a single process, and such a test must not be
+        # handed a backend that would try real collectives.  Tests that
+        # want the fleet decision protocol inject a scripted backend.
+        self._consensus = (
+            conslib.Consensus() if consensus is None else consensus
+        )
+        # View filter (chaos visibility-skew simulation): applied to
+        # every *listing* this manager reasons from — never to reads,
+        # which is the real shape of object-store metadata lag.
+        self._step_filter = step_filter
+
+    @property
+    def consensus(self) -> conslib.Consensus:
+        return self._consensus
+
+    def _visible_steps(self) -> list[int]:
+        steps: Sequence[int] = sorted(self._mgr.all_steps())
+        if self._step_filter is not None:
+            steps = sorted(self._step_filter(steps))
+        return list(steps)
 
     def _sidecar(self, step: int, pid: Optional[int] = None) -> str:
         pid = self._pid if pid is None else pid
         return os.path.join(
             self._dir, "dataset_states", str(step), f"p{pid}.json"
         )
+
+    def _local_save_decision(self, step: int) -> int:
+        """This process's view of what ``save(step)`` should do.  The
+        acting decision is the chief's (broadcast in :meth:`save`) —
+        orbax saves are collective, so the fleet must skip together or
+        save together; a per-process choice under storage-visibility
+        skew would strand the skipping processes out of the barrier."""
+        if step not in self._visible_steps():
+            return _SAVE_PROCEED
+        step_dir = self._step_dir(step)
+        if not os.path.isdir(step_dir):
+            # Listed but no finalized dir yet: an in-flight async
+            # save of this very step (orbax registers the step while
+            # still writing the tmp dir).  It IS this state —
+            # deterministic in step — so skip; deleting/overwriting
+            # would corrupt the write in progress.
+            return _SAVE_SKIP_INFLIGHT
+        if not fscklib.validate_step_dir(step_dir):
+            # Idempotent by construction: training is deterministic
+            # in step, so a VALID checkpoint for this step IS this
+            # state.  Orbax raises StepAlreadyExistsError here
+            # (force=True included), which would turn e.g. a
+            # preemption's emergency save at a boundary the cadence
+            # save just wrote into a crash.
+            return _SAVE_SKIP_EXISTS
+        # A FINALIZED dir that fails validation is damage, not a
+        # checkpoint — treating it as one would silently suppress a
+        # real save (e.g. the emergency save "succeeding" while
+        # resume walks back past the damage).  Replace it.
+        return _SAVE_REPLACE
+
+    def _agree_int(self, value: int, label: str) -> int:
+        """Chief-decides broadcast with the skew audit: a follower whose
+        local decision is overridden bumps ``fleet/consensus_overrides``
+        (the consensus module logs the specifics)."""
+        agreed = self._consensus.broadcast_int(value, label=label)
+        if agreed != value:
+            self._registry.counter(telemetry.CONSENSUS_OVERRIDES).inc()
+        return agreed
 
     def save(
         self,
@@ -114,46 +194,43 @@ class CheckpointManager:
         force: bool = False,
     ) -> bool:
         step = int(state.step)
-        # Known multi-host limitation: the skip/replace decision below
-        # reads the shared checkpoint dir per-process.  Orbax saves are
-        # collective, so on storage with cross-host visibility skew
-        # (e.g. object stores) processes could in principle decide
-        # differently and de-sync the save; the fix, if skew is ever
-        # observed, is a chief-decides broadcast like CheckpointHook's
-        # clock poll.  Same-filesystem fleets (and every drill here)
-        # see one consistent view.
-        if step in self._mgr.all_steps():
-            step_dir = self._step_dir(step)
-            if not os.path.isdir(step_dir):
-                # Listed but no finalized dir yet: an in-flight async
-                # save of this very step (orbax registers the step while
-                # still writing the tmp dir).  It IS this state —
-                # deterministic in step — so skip; deleting/overwriting
-                # would corrupt the write in progress.
-                log.info(
-                    "checkpoint at step %d is still being written; "
-                    "skipping duplicate save", step,
-                )
-                return False
-            if not fscklib.validate_step_dir(step_dir):
-                # Idempotent by construction: training is deterministic
-                # in step, so a VALID checkpoint for this step IS this
-                # state.  Orbax raises StepAlreadyExistsError here
-                # (force=True included), which would turn e.g. a
-                # preemption's emergency save at a boundary the cadence
-                # save just wrote into a crash.
-                log.info(
-                    "checkpoint at step %d already exists; skipping save",
-                    step,
-                )
-                return False
-            # A FINALIZED dir that fails validation is damage, not a
-            # checkpoint — treating it as one would silently suppress a
-            # real save (e.g. the emergency save "succeeding" while
-            # resume walks back past the damage).  Replace it.
+        decision = self._local_save_decision(step)
+        if self._consensus.active:
+            decision = self._agree_int(decision, f"save-decision@{step}")
+        if decision == _SAVE_SKIP_INFLIGHT:
+            log.info(
+                "checkpoint at step %d is still being written; "
+                "skipping duplicate save", step,
+            )
+            return False
+        if decision == _SAVE_SKIP_EXISTS:
+            log.info(
+                "checkpoint at step %d already exists; skipping save",
+                step,
+            )
+            return False
+        if decision == _SAVE_REPLACE:
             log.warning(
                 "existing checkpoint at step %d is torn; replacing it",
                 step,
+            )
+            self.delete(step)
+        elif step in self._mgr.all_steps():
+            # Chief said PROCEED but this process's *unfiltered* listing
+            # already has the step (the chief's view lags ours — the
+            # reverse skew): reconcile by clearing the local registration
+            # so the collective save cannot die on StepAlreadyExists.
+            if not os.path.isdir(self._step_dir(step)):
+                # Listed-but-no-dir = OUR async save of this step is
+                # still flushing; deleting now would corrupt the write
+                # in progress.  Make it durable first — the delete then
+                # removes a finalized checkpoint of this very state,
+                # which the chief-decided re-save recreates.
+                self.wait()
+            log.warning(
+                "chief-decided save at step %d but the step exists in "
+                "this process's view; clearing it to rejoin the "
+                "collective save", step,
             )
             self.delete(step)
         # The span covers the *blocking* portion only — orbax finishes the
@@ -192,11 +269,14 @@ class CheckpointManager:
                 shutil.rmtree(os.path.join(base, name), ignore_errors=True)
 
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        steps = self._visible_steps()
+        return steps[-1] if steps else None
 
     def all_steps(self) -> list[int]:
-        """Ascending retained steps (rollback and fsck candidates)."""
-        return sorted(self._mgr.all_steps())
+        """Ascending retained steps (rollback and fsck candidates), as
+        seen through this process's view (``step_filter`` applied — the
+        chaos visibility-skew seam)."""
+        return self._visible_steps()
 
     def delete(self, step: int) -> None:
         """Remove one retained step (best-effort).  The rollback path
@@ -250,11 +330,37 @@ class CheckpointManager:
         validation), unrestorable, and — when ``accept(state)`` is given
         — rejected candidates (the rollback path passes a finiteness
         gate).  Raises :class:`NoValidCheckpointError` when nothing
-        survives.  (Same multi-host caveat as :meth:`save`: the walk
-        validates per-process; cross-host storage visibility skew could
-        pick different steps on different hosts — chief-decides
-        broadcast is the upgrade path if that is ever observed.)"""
-        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        survives.
+
+        Multi-host the walk is **chief-decided**: process 0 validates
+        against its own storage view, names the step, and broadcasts it;
+        followers restore that step *strictly* (their own listings are
+        never consulted for the pick — under visibility skew the listing
+        lags but the read goes through).  Restore failures and
+        ``accept`` rejections are agreed with an any-host reduction, so
+        every process walks back together or returns together — two
+        hosts settling on different steps is a de-synced fleet, not a
+        degraded restore.  The chief prefers *fleet-valid* candidates
+        (every process's dataset sidecar present and parseable) and
+        falls back to structurally-valid-only steps — an approximate
+        resume for the sidecar-less peers — when no candidate clears
+        the higher bar."""
+        if self._consensus.active:
+            return self._restore_newest_valid_fleet(
+                template, accept, accept_name
+            )
+        return self._restore_newest_valid_local(
+            template, accept, accept_name
+        )
+
+    def _restore_newest_valid_local(
+        self,
+        template: TrainState,
+        accept=None,
+        accept_name: str = "",
+    ) -> tuple[TrainState, dict]:
+        """Single-process walk (the PR-4 behavior, bit-for-bit)."""
+        candidates = sorted(self._visible_steps(), reverse=True)
         if not candidates:
             raise FileNotFoundError("no checkpoint found")
         last_error: Optional[BaseException] = None
@@ -294,6 +400,94 @@ class CheckpointManager:
             f"no valid checkpoint among steps {candidates} under "
             f"{self._dir}"
         ) from last_error
+
+    def _walk_order(self) -> list[int]:
+        """Candidate order for the fleet walk, from THIS process's view:
+        newest-first within two tiers — fleet-valid steps (structural +
+        every peer sidecar) first, then structurally-valid-only steps.
+        Only the chief's order decides; followers compute theirs anyway
+        so a disagreement (visibility skew) is logged and counted."""
+        structural = [
+            s
+            for s in sorted(self._visible_steps(), reverse=True)
+            if not fscklib.validate_step_dir(self._step_dir(s))
+        ]
+        complete = [
+            s
+            for s in structural
+            if fscklib.fleet_sidecars_complete(self._dir, s, self._nproc)
+        ]
+        done = set(complete)
+        return complete + [s for s in structural if s not in done]
+
+    def _restore_newest_valid_fleet(
+        self,
+        template: TrainState,
+        accept=None,
+        accept_name: str = "",
+    ) -> tuple[TrainState, dict]:
+        """The chief-decides walk (``restore_newest_valid`` docstring).
+        Every round is: broadcast the chief's next candidate (−1 =
+        exhausted → everyone raises together), all processes enter the
+        collective restore of that step, then agree on failure/rejection
+        with any-host reductions before accepting."""
+        queue = self._walk_order()
+        newest = queue[0] if queue else None
+        tried: set[int] = set()
+        last_error: Optional[BaseException] = None
+        while True:
+            # −1 = candidates existed but the walk exhausted them; −2 =
+            # the chief saw no checkpoints at all.  The *agreed* code
+            # picks the exception, so every process raises the same
+            # class — a follower whose local view disagrees must not
+            # crash differently from its chief.
+            if any(s not in tried for s in queue):
+                local_pick = next(s for s in queue if s not in tried)
+            else:
+                local_pick = -2 if not queue else -1
+            step = self._agree_int(local_pick, "restore-pick")
+            if step == -2:
+                raise FileNotFoundError("no checkpoint found")
+            if step < 0:
+                raise NoValidCheckpointError(
+                    f"no valid checkpoint among steps {sorted(tried)} "
+                    f"under {self._dir} (chief-decided walk exhausted)"
+                ) from last_error
+            tried.add(step)
+            failed = False
+            out: Optional[tuple[TrainState, dict]] = None
+            try:
+                out = self._restore_step(template, step)
+            except Exception as e:  # noqa: BLE001 — damage fsck can't see
+                last_error = e
+                failed = True
+                log.warning(
+                    "chief-decided step %d failed to restore here (%s)",
+                    step, e,
+                )
+            if self._consensus.any_flag(failed, label="restore-failed"):
+                if not failed:
+                    log.warning(
+                        "a peer failed to restore chief-decided step %d; "
+                        "walking back with the fleet", step,
+                    )
+                continue
+            assert out is not None
+            rejected = accept is not None and not accept(out[0])
+            if self._consensus.any_flag(rejected, label="restore-rejected"):
+                log.warning(
+                    "checkpoint step %d rejected by the fleet (%s); "
+                    "walking back",
+                    step, accept_name or "accept predicate",
+                )
+                continue
+            if newest is not None and step != newest:
+                log.warning(
+                    "restored step %d instead of the newest step %d "
+                    "(newer candidates torn/unrestorable/rejected/"
+                    "sidecar-incomplete)", step, newest,
+                )
+            return out
 
     def _restore_step(
         self, template: TrainState, step: int
@@ -386,8 +580,20 @@ def restore_or_init(
     structurally valid but poisoned — without the gate it becomes the
     newest checkpoint and every rerun restores NaN and dies, bricking
     the workdir.  (Eval/generate restore via ``manager.restore`` and
-    stay ungated — they read only params/EMA.)"""
-    if manager.latest_step() is None:
+    stay ungated — they read only params/EMA.)
+
+    Multi-host, restore-vs-init is itself **chief-decided**: whether any
+    checkpoint exists is read from process 0's view and broadcast, so a
+    fleet where one host's listing lags (visibility skew) still makes
+    one choice — all restore (the chief-decided walk names the step) or
+    all init fresh."""
+    cons = manager.consensus
+    has_checkpoint = manager.latest_step() is not None
+    if cons.active:
+        has_checkpoint = bool(
+            cons.broadcast_int(int(has_checkpoint), label="restore-or-init")
+        )
+    if not has_checkpoint:
         return template, {}, False
     from distributed_tensorflow_models_tpu.core.train_loop import (
         state_is_finite,

@@ -113,6 +113,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "abort attempt instead of warnings only",
     )
     p.add_argument(
+        "--checkpoint-every-steps", type=int, default=None,
+        help="additionally checkpoint every N steps (step cadence is "
+        "deterministic — needed for reproducible drills and exact "
+        "multi-host restart points; the 600s clock cadence stays "
+        "active alongside)",
+    )
+    p.add_argument(
         "--preempt-poll-steps", type=int, default=None,
         help="multi-host preemption-notice poll cadence in steps (the "
         "poll is a collective; default 20).  Keep poll_steps x step_time "
@@ -153,6 +160,8 @@ def _overrides(args) -> dict:
         out["watchdog_timeout_s"] = args.watchdog_timeout_s
     if getattr(args, "watchdog_abort", None) is not None:
         out["watchdog_abort"] = args.watchdog_abort
+    if getattr(args, "checkpoint_every_steps", None) is not None:
+        out["checkpoint_every_steps"] = args.checkpoint_every_steps
     if getattr(args, "preempt_poll_steps", None) is not None:
         out["preempt_poll_steps"] = args.preempt_poll_steps
     if getattr(args, "chaos", None) is not None:

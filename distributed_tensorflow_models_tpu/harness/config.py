@@ -133,6 +133,12 @@ class ExperimentConfig:
     data_workers: int = 1
     log_every_steps: int = 100
     checkpoint_every_secs: float = 600.0
+    # Step-cadence checkpointing (None = clock-only).  Deterministic in
+    # step, so it needs no multi-host clock broadcast and — unlike the
+    # wall clock — reproduces exactly across restarts and replays;
+    # chaos drills and bit-identity tests depend on that.  Both cadences
+    # can be active at once (a save fires when either is due).
+    checkpoint_every_steps: Optional[int] = None
     keep_checkpoints: int = 5
     # Divergence policy (harness/train.py::fit).  "abort" = the reference
     # NanTensorHook behavior: a non-finite loss kills the run.  "rollback"
@@ -161,7 +167,10 @@ class ExperimentConfig:
     # Deterministic chaos injection (resilience/chaos.py) — OFF when
     # empty.  Keys: pipeline_fail_at_batch, nan_at_step,
     # torn_checkpoint_at_step, sigterm_at_step (ints; each fires at most
-    # once per process per workdir).  CLI: --chaos "nan_at_step=50,...".
+    # once per process per workdir), plus the cross-host faults
+    # kill_at_step (durably at-most-once per workdir), hide_newest_ckpt,
+    # straggler_delay_ms — targeted at the process whose index is
+    # chaos_host.  CLI: --chaos "nan_at_step=50,...".
     chaos: dict[str, Any] = dataclasses.field(default_factory=dict)
     eval_every_steps: Optional[int] = None
     eval_batches: Optional[int] = None

@@ -457,6 +457,82 @@ class TelemetryHook(Hook):
             metrics.update(out)
 
 
+class FleetHook(Hook):
+    """Chief-only fleet-health gauges from the heartbeat directory
+    (``resilience/heartbeat.py``): every ``every_steps`` it reads the
+    peers' heartbeat files — plain shared-filesystem reads, never a
+    collective — and injects/records
+
+    - ``fleet/peers_alive``     — processes with a fresh heartbeat,
+    - ``fleet/step_lag``        — max−min step among alive peers (the
+      straggler / slowest-host skew),
+    - ``fleet/heartbeat_age_s`` — the worst heartbeat age,
+
+    into the metrics row (→ metrics.jsonl / TensorBoard via the writer
+    hooks downstream — order this before them, like TelemetryHook) and
+    the registry (→ telemetry.json).  A dead host shows up here within
+    one cadence of its heartbeat going stale, with its process index in
+    the chief's log — per-host failure attribution without ssh."""
+
+    def __init__(
+        self,
+        registry: telemetry.MetricsRegistry,
+        directory: str,
+        num_processes: int,
+        every_steps: int = 100,
+        *,
+        stale_after_s: float = 15.0,
+    ):
+        self._reg = registry
+        self._dir = directory
+        self._nproc = num_processes
+        self._every = max(1, every_steps)
+        self._stale = stale_after_s
+        self._warned_dead: set[int] = set()
+
+    def wants_step(self, step):
+        return step % self._every == 0
+
+    def after_step(self, state, metrics, step):
+        if step % self._every:
+            return
+        from distributed_tensorflow_models_tpu.resilience import heartbeat
+
+        try:
+            views = heartbeat.read_fleet(self._dir, self._nproc)
+            # One snapshot for both the per-peer warnings and the
+            # gauges — a second read could classify a peer differently
+            # mid-walk.
+            summary = heartbeat.fleet_summary(
+                self._dir, self._nproc, stale_after_s=self._stale,
+                views=views,
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never kill a run
+            log.exception("fleet heartbeat read failed")
+            return
+        for i, view in enumerate(views):
+            stale = view is None or view["age_s"] > self._stale
+            if stale and i not in self._warned_dead:
+                self._warned_dead.add(i)
+                log.warning(
+                    "fleet: process %d heartbeat is %s (last step %s)",
+                    i,
+                    "missing" if view is None else f"{view['age_s']:.1f}s stale",
+                    "?" if view is None else view.get("step"),
+                )
+            elif not stale:
+                self._warned_dead.discard(i)
+        out = {
+            telemetry.FLEET_PEERS_ALIVE: float(summary["peers_alive"]),
+            telemetry.FLEET_STEP_LAG: float(summary["step_lag"]),
+            telemetry.FLEET_HEARTBEAT_AGE: float(summary["heartbeat_age_s"]),
+        }
+        for key, value in out.items():
+            self._reg.gauge(key).set(value)
+        if isinstance(metrics, MutableMapping):
+            metrics.update(out)
+
+
 class CheckpointHook(Hook):
     """Save every ``every_secs`` (default 600 s, the reference's
     CheckpointSaverHook default — TF monitored_session.py:525-528) and at

@@ -413,7 +413,16 @@ def fit(
       watchdog diagnosing silent stalls (hung collective / pipeline
       deadlock) instead of letting them look like slow steps.
     - **Chaos** — ``cfg.chaos`` (off by default) injects deterministic
-      faults at these exact seams (``resilience/chaos.py``).
+      faults at these exact seams (``resilience/chaos.py``), including
+      the cross-host kill/visibility-skew/straggler drills.
+    - **Multi-host coordination** — every fleet-visible checkpoint
+      decision (save skip/replace, restore-walk step pick,
+      restore-vs-init, the rollback's any-host divergence verdict) is
+      chief-decided via ``resilience/consensus.py`` so storage
+      visibility skew cannot de-sync the fleet; under a fleet
+      supervisor (``launch.py``) each process heartbeats
+      (``resilience/heartbeat.py``) and the chief exports ``fleet/*``
+      gauges.
     """
     if cfg.nan_policy not in ("abort", "rollback"):
         raise ValueError(
@@ -427,8 +436,18 @@ def fit(
         mesh = mesh_from_config(cfg)
     state = build_state(cfg, mesh)
     manager = ckptlib.CheckpointManager(
-        workdir, keep=cfg.keep_checkpoints, registry=registry
+        workdir,
+        keep=cfg.keep_checkpoints,
+        registry=registry,
+        # Chaos visibility-skew simulation: the hidden step vanishes
+        # from this process's listings, never from reads — the manager's
+        # chief-decides consensus is what keeps the fleet in agreement.
+        step_filter=chaos.step_filter() if chaos is not None else None,
     )
+    # Every fleet-visible decision (save skip/replace, restore-walk step
+    # pick, restore-vs-init, any-host divergence below) goes through
+    # this chief-decides broadcast; single-process it is an exact no-op.
+    consensus = manager.consensus
     state, data_state, restored = ckptlib.restore_or_init(manager, state)
 
     from distributed_tensorflow_models_tpu.parallel import tensor as tensorlib
@@ -561,7 +580,28 @@ def fit(
             tear_hook = chaos.tear_hook(save_fn, final_step=cfg.train_steps)
             if tear_hook is not None:
                 chaos_hooks.append(tear_hook)
+            kill_hook = chaos.kill_hook()
+            if kill_hook is not None:
+                chaos_hooks.append(kill_hook)
+            straggler_hook = chaos.straggler_hook()
+            if straggler_hook is not None:
+                chaos_hooks.append(straggler_hook)
         nproc = jax.process_count()
+        # Fleet-health gauges (chief only): peers alive / step lag /
+        # heartbeat age, read from the launcher's heartbeat directory —
+        # plain file reads, present exactly when a supervisor started us
+        # with heartbeats on (launch.py sets DTM_HEARTBEAT_DIR).
+        hb_writer = resilience.heartbeat.active_writer()
+        fleet_hooks: list[hooklib.Hook] = (
+            [
+                hooklib.FleetHook(
+                    registry, hb_writer.directory, nproc,
+                    cfg.log_every_steps,
+                )
+            ]
+            if is_chief and nproc > 1 and hb_writer is not None
+            else []
+        )
         preempt_poll_steps = max(
             1, int(cfg.preempt_poll_steps or PREEMPT_POLL_STEPS)
         )
@@ -572,10 +612,13 @@ def fit(
             # dict for the writers to record.  Runs on every process — its
             # multi-host aggregation is a collective.
             hooklib.TelemetryHook(registry, cfg.log_every_steps),
+            *fleet_hooks,
             *chief_hooks,
             hooklib.NanGuardHook(cfg.log_every_steps),
             hooklib.CheckpointHook(
-                save_fn, every_secs=cfg.checkpoint_every_secs
+                save_fn,
+                every_secs=cfg.checkpoint_every_secs,
+                every_steps=cfg.checkpoint_every_steps,
             ),
             *chaos_hooks,
             *extra_hooks,
@@ -658,6 +701,11 @@ def fit(
         _close_quietly(host, manager)
         raise
 
+    # Sentinel for "no divergence seen here" in the any-host agreement
+    # below (min-reduced, so it must exceed any real step while fitting
+    # the consensus layer's int32 wire).
+    _NO_BAD_STEP = 2**31 - 1
+
     def _check_chunk_finite(loss_rows, chunk_start: int, n: int) -> None:
         """Rollback mode guards EVERY chunk, not only the NaN guard's
         log-cadence walks: the skip ledger's exactness rests on detection
@@ -666,17 +714,38 @@ def fit(
         while the real poison replays on every rewind until the budget
         dies.  Cost: one small device→host read per chunk, paid only
         under ``nan_policy="rollback"``.  Raised BEFORE the hook walk, so
-        the checkpoint hook can never persist the poisoned state."""
-        if loss_rows is None:
-            return
-        import numpy as np
+        the checkpoint hook can never persist the poisoned state.
 
-        arr = np.atleast_1d(np.asarray(loss_rows))[:n]
-        bad = ~np.isfinite(arr)
-        if bad.any():
-            i = int(np.argmax(bad))
+        Multi-host the verdict is **fleet-agreed** (one allgather per
+        chunk, rollback mode only): any host seeing a non-finite loss
+        makes EVERY host raise, at the earliest step any host saw — so
+        the fleet enters ``_rollback``'s collectives together with one
+        shared skip ledger, instead of trusting that every host's
+        readback of the (nominally global) loss classifies the same
+        way."""
+        bad_step = _NO_BAD_STEP
+        bad_value = None
+        if loss_rows is not None:
+            import numpy as np
+
+            arr = np.atleast_1d(np.asarray(loss_rows))[:n]
+            bad = ~np.isfinite(arr)
+            if bad.any():
+                i = int(np.argmax(bad))
+                bad_step = chunk_start + 1 + i
+                bad_value = arr[i]
+        if consensus.active:
+            agreed = min(
+                consensus.allgather_int(bad_step, label="chunk-finite")
+            )
+            if agreed < _NO_BAD_STEP:
+                raise FloatingPointError(
+                    f"loss is {bad_value if agreed == bad_step else 'non-finite on a peer'}"
+                    f" at step {agreed} (fleet-agreed divergence)"
+                )
+        elif bad_step < _NO_BAD_STEP:
             raise FloatingPointError(
-                f"loss is {arr[i]} at step {chunk_start + 1 + i}"
+                f"loss is {bad_value} at step {bad_step}"
             )
 
     def _discard_batches(n: int) -> int:
@@ -765,6 +834,10 @@ def fit(
         return True
 
     try:
+        # First beat carries the (possibly restored) entry step, so the
+        # supervisor and peers see "looping, at step N" before the first
+        # chunk — which may take a full XLA compile — completes.
+        resilience.heartbeat.beat(step)
         while step < cfg.train_steps:
             if _preempt_due(step):
                 log.warning(
@@ -878,6 +951,7 @@ def fit(
                 continue
             if watchdog is not None:
                 watchdog.beat(step)
+            resilience.heartbeat.beat(step)
             if not ok:
                 break
     except BaseException:
@@ -894,7 +968,11 @@ def fit(
                 log.exception("hook %r abort() failed during error cleanup", h)
         _close_quietly(host, manager)
         # A goodput report from a crashed run is exactly what the
-        # post-mortem wants (was it stalling before it died?).
+        # post-mortem wants (was it stalling before it died?).  The
+        # armed-but-unfired chaos count rides along: a crash drill whose
+        # fault never injected should say so in its post-mortem too.
+        if chaos is not None:
+            chaos.export_unfired(registry)
         _write_telemetry_report(workdir, registry, t_run0, steps_run)
         raise
     else:
@@ -914,7 +992,10 @@ def fit(
         finally:
             _close_quietly(host, manager)
         # After close: the report's checkpoint split includes the final
-        # save's wait-until-durable time.
+        # save's wait-until-durable time.  chaos/armed_unfired is set
+        # first so the gauge lands in the report's registry snapshot.
+        if chaos is not None:
+            chaos.export_unfired(registry)
         _write_telemetry_report(workdir, registry, t_run0, steps_run)
         if chaos is not None and not preempted:
             # A drill whose fault never injected must not exit 0 looking
@@ -1048,28 +1129,12 @@ def is_transient_error(e: BaseException) -> bool:
     return not any(m in msg for m in _DETERMINISTIC_MARKERS)
 
 
-def restart_backoff(
-    attempt: int, *, base_s: float = 1.0, max_s: float = 60.0, seed: int = 0
-) -> float:
-    """Delay before restart ``attempt`` (1-based): exponential backoff
-    with *deterministic* jitter.
-
-    The raw delay ``min(max_s, base_s · 2^(attempt−1))`` is scaled into
-    ``[0.5, 1.0)`` of itself by a hash of ``(seed, attempt)`` — jitter
-    that de-synchronizes a fleet tripped by one shared fault (no
-    thundering-herd re-slamming the coordinator/storage on the same
-    second) while keeping every run's timeline replayable and testable,
-    matching the repo-wide determinism contract.  ``base_s <= 0``
-    disables backoff entirely (tests, and callers with their own
-    scheduler-level backoff)."""
-    if base_s <= 0:
-        return 0.0
-    import hashlib
-
-    raw = min(max_s, base_s * (2.0 ** (attempt - 1)))
-    digest = hashlib.sha256(f"{seed}:{attempt}".encode()).digest()
-    frac = int.from_bytes(digest[:8], "big") / 2.0**64
-    return raw * (0.5 + 0.5 * frac)
+# The deterministic-jitter restart schedule moved to
+# ``resilience/backoff.py`` so the fleet supervisor
+# (``launch.supervise_local``, which never imports jax/harness) can
+# share it; re-exported here because this is its historical home and
+# ``recoverable_fit``'s callers reach it as ``trainlib.restart_backoff``.
+restart_backoff = resilience.restart_backoff
 
 
 def recoverable_fit(

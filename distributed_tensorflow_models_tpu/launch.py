@@ -18,7 +18,12 @@ ICI/DCN, SURVEY.md §5.8).  This module provides:
 - :func:`launch_local` — spawn an N-process cluster on localhost
   (the analogue of TF's in-process fake clusters via
   ``Server.create_local_server``, SURVEY.md §4: multi-node protocol tests
-  on one machine with no real cluster),
+  on one machine with no real cluster), now a *supervisor*: children
+  heartbeat (``resilience/heartbeat.py``) and a dead or stalled child
+  tears the fleet down in seconds (SIGTERM → grace → SIGKILL) instead
+  of leaving survivors hung in collectives,
+- :func:`supervise_local` — the fleet restart loop (relaunch +
+  checkpoint auto-resume, deterministic-jitter backoff),
 - a CLI: ``python -m distributed_tensorflow_models_tpu.launch``.
 
 On managed TPU slices none of this is needed — ``jax.distributed
@@ -29,10 +34,14 @@ command; use the CLI only for manual clusters and localhost tests.
 from __future__ import annotations
 
 import argparse
+import logging
 import os
+import signal
 import subprocess
 import sys
-from typing import Mapping, Sequence
+from typing import Mapping, Optional, Sequence
+
+log = logging.getLogger("dtm")
 
 ENV_COORDINATOR = "DTM_COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "DTM_NUM_PROCESSES"
@@ -40,6 +49,14 @@ ENV_PROCESS_ID = "DTM_PROCESS_ID"
 ENV_CPU_DEVICES = "DTM_CPU_DEVICES_PER_PROCESS"
 
 DEFAULT_PORT = 9671
+
+# How long a SIGTERM'd fleet gets to drain (emergency checkpoints) before
+# the supervisor SIGKILLs the stragglers.  A host hung in a dead peer's
+# collective never reaches its chunk-boundary preemption poll — the KILL
+# is what actually ends it; a healthy host exits resumable well inside
+# the default.
+DEFAULT_TERM_GRACE_S = 15.0
+_MONITOR_POLL_S = 0.2
 
 # Exit code a preempted-but-checkpointed training process uses (BSD
 # EX_TEMPFAIL): the run wrote an emergency checkpoint on SIGTERM and
@@ -95,6 +112,16 @@ def initialize_from_env() -> bool:
     coord = os.environ.get(ENV_COORDINATOR)
     nproc = os.environ.get(ENV_NUM_PROCESSES)
     pid = os.environ.get(ENV_PROCESS_ID)
+
+    # Fleet heartbeat (DTM_HEARTBEAT_DIR, set by the supervising
+    # launcher): started HERE — before the heavy jax/backend imports
+    # below — so the supervisor sees a first beat within ~a second of
+    # spawn and a child that dies during initialization is still
+    # attributable.  No-op when the env var is absent.
+    from distributed_tensorflow_models_tpu.resilience import heartbeat
+
+    heartbeat.start_from_env(int(pid) if pid else 0)
+
     if not (coord and nproc and pid):
         return False
 
@@ -110,6 +137,43 @@ def initialize_from_env() -> bool:
     return True
 
 
+def _terminate_fleet(
+    procs: Sequence[subprocess.Popen],
+    codes: dict[int, int],
+    grace_s: float,
+) -> None:
+    """SIGTERM every still-running child (→ their preemption-grace
+    emergency checkpoints, where reachable), wait up to ``grace_s``,
+    SIGKILL the stragglers (a host hung in a dead peer's collective
+    never reaches its chunk-boundary poll).  Fills ``codes``."""
+    import time
+
+    for i, p in enumerate(procs):
+        if i not in codes and p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:  # already reaped
+                pass
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if all(
+            i in codes or p.poll() is not None for i, p in enumerate(procs)
+        ):
+            break
+        time.sleep(_MONITOR_POLL_S)
+    for i, p in enumerate(procs):
+        if i in codes:
+            continue
+        if p.poll() is None:
+            sys.stderr.write(
+                f"--- fleet: process {i} did not exit within the "
+                f"{grace_s:.0f}s grace period; killing it ---\n"
+            )
+            p.kill()
+            p.wait()
+        codes[i] = p.returncode
+
+
 def launch_local(
     num_processes: int,
     argv: Sequence[str],
@@ -118,6 +182,8 @@ def launch_local(
     cpu_devices_per_process: int | None = None,
     extra_env: Mapping[str, str] | None = None,
     timeout: float | None = None,
+    heartbeat_timeout: float | None = None,
+    term_grace_s: float = DEFAULT_TERM_GRACE_S,
 ) -> list[int]:
     """Spawn ``num_processes`` copies of ``argv`` as a localhost cluster.
 
@@ -128,18 +194,40 @@ def launch_local(
     back-pressures a chatty child into blocking mid-step, which stalls the
     whole cluster at its next collective.  ``timeout`` bounds the *total*
     wall time of the cluster, not each child.  Returns the exit codes.
+
+    **Supervision.**  The launcher polls the fleet instead of waiting on
+    children in order: the moment any child dies with a real failure
+    (exit not 0/75 — e.g. a ``kill -9``), the survivors are SIGTERM'd
+    promptly and SIGKILL'd after ``term_grace_s`` — seconds of teardown
+    instead of every peer hanging to its collective timeout.  Each child
+    also gets a heartbeat directory (``DTM_HEARTBEAT_DIR``;
+    ``resilience/heartbeat.py`` — written by ``initialize_from_env``,
+    stepped by ``fit``, and read back by the chief's ``fleet/*``
+    gauges); with ``heartbeat_timeout`` set, a child whose heartbeat
+    goes stale that long (wedged, not dead) triggers the same fleet
+    teardown, attributed to its process index.  Only pass
+    ``heartbeat_timeout`` for commands that actually heartbeat — i.e.
+    anything calling ``initialize_from_env`` — and size it over the
+    slowest expected gap (initial jax import + first XLA compile beat
+    the interval automatically; the writer thread starts pre-import).
     """
+    import shutil
     import tempfile
     import time
 
+    from distributed_tensorflow_models_tpu.resilience import heartbeat
+
     procs: list[subprocess.Popen] = []
     logs: list = [None]
+    hb_dir = tempfile.mkdtemp(prefix="dtm-heartbeat-")
+    t0_wall = time.time()
     try:
         for i in range(num_processes):
             env = dict(os.environ)
             env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
             env[ENV_NUM_PROCESSES] = str(num_processes)
             env[ENV_PROCESS_ID] = str(i)
+            env[heartbeat.ENV_HEARTBEAT_DIR] = hb_dir
             if cpu_devices_per_process is not None:
                 env[ENV_CPU_DEVICES] = str(cpu_devices_per_process)
             if extra_env:
@@ -159,29 +247,74 @@ def launch_local(
                 )
             )
         deadline = None if timeout is None else time.monotonic() + timeout
-        codes = []
-        for i, p in enumerate(procs):
-            remaining = (
-                None if deadline is None else deadline - time.monotonic()
-            )
-            if remaining is not None and remaining <= 0:
+        codes: dict[int, int] = {}
+        failure: Optional[tuple[int, str]] = None
+        while len(codes) < num_processes:
+            if deadline is not None and time.monotonic() > deadline:
                 raise subprocess.TimeoutExpired(argv, timeout)
-            p.wait(timeout=remaining)
-            codes.append(p.returncode)
-            if p.returncode == RESUMABLE_EXIT_CODE:
+            for i, p in enumerate(procs):
+                if i in codes:
+                    continue
+                rc = p.poll()
+                if rc is None:
+                    continue
+                codes[i] = rc
+                if rc not in (0, RESUMABLE_EXIT_CODE) and failure is None:
+                    try:
+                        why = f"died on {signal.Signals(-rc).name}"
+                    except ValueError:
+                        why = f"exited {rc}"
+                    failure = (i, why)
+            if failure is not None:
+                break
+            if heartbeat_timeout is not None and len(codes) < num_processes:
+                views = heartbeat.read_fleet(hb_dir, num_processes)
+                for i, p in enumerate(procs):
+                    if i in codes:
+                        continue
+                    view = views[i]
+                    age = (
+                        view["age_s"]
+                        if view is not None
+                        else time.time() - t0_wall
+                    )
+                    if age > heartbeat_timeout:
+                        failure = (
+                            i,
+                            f"heartbeat stale for {age:.1f}s "
+                            f"(> {heartbeat_timeout:.1f}s; last step "
+                            f"{'?' if view is None else view.get('step')})",
+                        )
+                        break
+            if failure is not None:
+                break
+            time.sleep(_MONITOR_POLL_S)
+        if failure is not None:
+            i, why = failure
+            sys.stderr.write(
+                f"--- fleet: process {i} {why}; terminating the rest of "
+                "the fleet (survivors take the emergency-checkpoint "
+                "grace path where reachable) ---\n"
+            )
+            # A stalled (still-running) culprit gets the same
+            # SIGTERM-then-SIGKILL as its peers.
+            _terminate_fleet(procs, codes, term_grace_s)
+        code_list = [codes[i] for i in range(num_processes)]
+        for i, rc in enumerate(code_list):
+            if rc == RESUMABLE_EXIT_CODE:
                 # Preemption grace, not a failure: the child checkpointed
                 # and asked to be rerun — don't dump its log as a crash.
                 sys.stderr.write(
-                    f"--- process {i} preempted (exit {p.returncode}): "
+                    f"--- process {i} preempted (exit {rc}): "
                     "resumable — rerun the same command ---\n"
                 )
-            elif p.returncode != 0 and i != 0:
+            elif rc != 0 and i != 0:
                 logs[i].seek(0)
                 sys.stderr.write(
-                    f"--- process {i} (exit {p.returncode}) ---\n"
+                    f"--- process {i} (exit {rc}) ---\n"
                     f"{logs[i].read()}\n"
                 )
-        return codes
+        return code_list
     except BaseException:
         for p in procs:
             if p.poll() is None:
@@ -191,6 +324,68 @@ def launch_local(
         for log in logs:
             if log is not None:
                 log.close()
+        shutil.rmtree(hb_dir, ignore_errors=True)
+
+
+def supervise_local(
+    num_processes: int,
+    argv: Sequence[str],
+    *,
+    max_restarts: int = 2,
+    backoff_base_s: float = 1.0,
+    backoff_max_s: float = 60.0,
+    seed: int = 0,
+    port: int = DEFAULT_PORT,
+    **launch_kwargs,
+) -> int:
+    """``launch_local`` under the fleet restart loop: a fleet torn down
+    for a real failure (one host killed/stalled) is relaunched — same
+    command, so every child auto-resumes from the latest checkpoint —
+    up to ``max_restarts`` times, spaced by the deterministic-jitter
+    backoff ``recoverable_fit`` uses for in-process restarts
+    (``resilience/backoff.py``).  Per-host failure attribution goes to
+    stderr each round.  Returns the final aggregate exit code; an
+    all-preempted fleet (aggregate 75) returns immediately — the fleet
+    was *told* to die, and the rerun belongs to whoever told it.
+
+    Each relaunch bumps the coordinator port by one: the dead chief's
+    listener can linger in TIME_WAIT, and a bind failure would burn a
+    whole restart on launcher misfortune.
+    """
+    import time
+
+    from distributed_tensorflow_models_tpu.resilience import backoff
+
+    attempt = 0
+    while True:
+        codes = launch_local(
+            num_processes, argv, port=port + attempt, **launch_kwargs
+        )
+        agg = aggregate_exit_codes(codes)
+        if agg in (0, RESUMABLE_EXIT_CODE):
+            return agg
+        failed = {
+            i: c
+            for i, c in enumerate(codes)
+            if c not in (0, RESUMABLE_EXIT_CODE)
+        }
+        attempt += 1
+        if attempt > max_restarts:
+            sys.stderr.write(
+                f"--- fleet: giving up after {max_restarts} restart(s); "
+                f"failed processes {failed} ---\n"
+            )
+            return agg
+        delay = backoff.restart_backoff(
+            attempt, base_s=backoff_base_s, max_s=backoff_max_s, seed=seed
+        )
+        sys.stderr.write(
+            f"--- fleet: process(es) {sorted(failed)} failed "
+            f"(exit codes {failed}); relaunching the whole fleet in "
+            f"{delay:.2f}s (restart {attempt}/{max_restarts}, "
+            f"coordinator port {port + attempt}) ---\n"
+        )
+        time.sleep(delay)
 
 
 def main(argv: Sequence[str] | None = None) -> int:
@@ -223,6 +418,29 @@ def main(argv: Sequence[str] | None = None) -> int:
         default=None,
         help="force N fake CPU devices per process (test clusters)",
     )
+    parser.add_argument(
+        "--max-restarts",
+        type=int,
+        default=0,
+        help="localhost mode: relaunch the whole fleet (auto-resuming "
+        "from checkpoints) up to N times after a real failure — the "
+        "fleet-level recoverable_fit (0 = launch once)",
+    )
+    parser.add_argument(
+        "--heartbeat-timeout",
+        type=float,
+        default=None,
+        help="localhost mode: tear the fleet down when any child's "
+        "heartbeat goes stale this many seconds (stalled-host "
+        "detection; only for commands that initialize_from_env)",
+    )
+    parser.add_argument(
+        "--term-grace",
+        type=float,
+        default=DEFAULT_TERM_GRACE_S,
+        help="seconds a SIGTERM'd fleet gets to write emergency "
+        f"checkpoints before SIGKILL (default {DEFAULT_TERM_GRACE_S:g})",
+    )
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
@@ -245,11 +463,23 @@ def main(argv: Sequence[str] | None = None) -> int:
                 f"--coordinator host ({host!r}) requires --process-id "
                 "(run once per host)"
             )
+        if args.max_restarts > 0:
+            return supervise_local(
+                args.num_processes,
+                command,
+                max_restarts=args.max_restarts,
+                port=int(port_str),
+                cpu_devices_per_process=args.cpu_devices_per_process,
+                heartbeat_timeout=args.heartbeat_timeout,
+                term_grace_s=args.term_grace,
+            )
         codes = launch_local(
             args.num_processes,
             command,
             port=int(port_str),
             cpu_devices_per_process=args.cpu_devices_per_process,
+            heartbeat_timeout=args.heartbeat_timeout,
+            term_grace_s=args.term_grace,
         )
         return aggregate_exit_codes(codes)
 

@@ -23,17 +23,38 @@ deterministic enough to assert in tier-1 tests:
 - :mod:`watchdog` — step-progress watchdog: hung collectives and
   pipeline deadlocks produce a diagnosis (and optionally an abort)
   instead of a silent stall.
+- :mod:`consensus` — chief-decides broadcast: every fleet-visible
+  checkpoint decision (save skip/replace, restore-walk step pick,
+  restore-vs-init, any-host divergence) is made once by process 0 and
+  obeyed everywhere, so cross-host storage-visibility skew cannot
+  de-sync the fleet.  Exact no-op single-process.
+- :mod:`heartbeat` — per-process heartbeat files + fleet summaries: the
+  launch supervisor detects a dead/stalled host in seconds instead of a
+  collective-timeout hang, and the chief exports ``fleet/*`` gauges.
+- :mod:`backoff` — the deterministic-jitter restart schedule, shared by
+  ``recoverable_fit`` (in-process) and ``launch.supervise_local``
+  (whole-fleet relaunch).
 
 Layering: this package imports only stdlib + :mod:`telemetry` (+ jax for
-array poisoning), never :mod:`harness` — the harness wires it in.
+array poisoning and, multi-process only, the consensus allgather), never
+:mod:`harness` — the harness wires it in.
 """
 
+from distributed_tensorflow_models_tpu.resilience.backoff import (  # noqa: F401
+    restart_backoff,
+)
 from distributed_tensorflow_models_tpu.resilience.chaos import (  # noqa: F401
     ChaosConfig,
     ChaosInjector,
     ChaosPipelineError,
     get_injector,
     parse_chaos_spec,
+)
+from distributed_tensorflow_models_tpu.resilience.consensus import (  # noqa: F401
+    Consensus,
+)
+from distributed_tensorflow_models_tpu.resilience import (  # noqa: F401
+    heartbeat,
 )
 from distributed_tensorflow_models_tpu.resilience.fsck import (  # noqa: F401
     fsck_checkpoints,

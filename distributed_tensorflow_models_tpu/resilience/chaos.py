@@ -18,7 +18,9 @@ injection points, all **off by default** and driven by
   so an armed-but-unfired fault's position shifts (warned at
   ``set_state`` time) — combine it with the other faults accordingly.
 - ``nan_at_step=k`` — the batch feeding train step *k* is poisoned with
-  NaN (float leaves only), driving the real NaN-guard path.
+  NaN (float leaves only), driving the real NaN-guard path.  Fires on
+  the ``chaos_host`` process only (default 0 — single-process runs are
+  unaffected): multi-host, the drill is ONE host's shard going bad.
 - ``torn_checkpoint_at_step=k`` — after the step-*k* checkpoint is
   durable, files are deleted from its directory, simulating
   post-finalization damage the restore hardening must walk back over.
@@ -26,12 +28,36 @@ injection points, all **off by default** and driven by
   after step *k* (via a hook, so the fused loop's chunk ends exactly
   there), driving the preemption-grace path end-to-end.
 
+Cross-host faults (ISSUE 5) target ONE process of a fleet — the one
+whose index equals ``chaos_host`` (default 0; set it to pick the
+victim).  Drillable from two-process ``launch_local`` runs:
+
+- ``kill_at_step=k`` — the target host SIGKILLs itself after step *k*:
+  no grace, no teardown — the supervisor's dead-peer detection and
+  fleet restart are what recover.  **Durably at-most-once per
+  workdir** (a marker file under ``<workdir>/.chaos_fired/``): unlike
+  the in-process faults, the recovery from a kill is a *new process*
+  re-traversing step *k*, so per-process memoization would re-kill on
+  every restart and the drill would never complete.
+- ``hide_newest_ckpt=1`` — the target host's checkpoint *view*
+  (``CheckpointManager.all_steps``/``latest_step`` and the restore-walk
+  candidates) omits the newest step, simulating cross-host
+  storage-visibility skew: the listing lags but reads go through —
+  exactly the de-sync chief-decides consensus absorbs (the chief names
+  the step; the skewed follower restores it strictly, and the read
+  succeeds).
+- ``straggler_delay_ms=d`` — the target host sleeps *d* ms in every
+  hook walk, slowing the lock-step fleet to its pace: the drill that
+  proves delay changes wall time and the ``fleet/*``/``hosts/*``
+  gauges, never results.
+
 **Once per process per workdir**: injectors are memoized on
 ``(workdir, spec, seed)`` and each fault fires at most once, so the
 recovery that follows — a ``recoverable_fit`` restart, a rollback replay
 — re-traverses the same positions *without* re-faulting.  A genuinely
 new process (real preemption resume) re-arms, which is exactly the
-at-least-once behavior a chaos drill wants.
+at-least-once behavior a chaos drill wants.  (``kill_at_step`` is the
+one exception — durable at-most-once, above.)
 
 ``seed`` is carried for future randomized modes (and keys the memo); the
 current injection points are all positional, so runs are bit-reproducible
@@ -64,7 +90,14 @@ _FIELDS = (
     "nan_at_step",
     "torn_checkpoint_at_step",
     "sigterm_at_step",
+    "kill_at_step",
+    "hide_newest_ckpt",
+    "straggler_delay_ms",
+    "chaos_host",
 )
+
+# Fault fields proper (everything but the cross-host target selector).
+_FAULT_FIELDS = tuple(f for f in _FIELDS if f != "chaos_host")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +106,12 @@ class ChaosConfig:
     nan_at_step: Optional[int] = None
     torn_checkpoint_at_step: Optional[int] = None
     sigterm_at_step: Optional[int] = None
+    # Cross-host faults: fire only on the process whose index is
+    # ``chaos_host`` (module docstring).
+    kill_at_step: Optional[int] = None
+    hide_newest_ckpt: Optional[int] = None
+    straggler_delay_ms: Optional[int] = None
+    chaos_host: int = 0
     seed: int = 0
 
     @classmethod
@@ -239,18 +278,118 @@ class _SigtermAtStep:
     def abort(self, state) -> None: ...
 
 
+class _KillAtStep:
+    """Duck-typed hook SIGKILLing the *target host* after step k — the
+    ungraceful death (no teardown, no emergency checkpoint) whose
+    recovery is the supervisor's dead-peer detection + fleet restart.
+
+    ``wants_step`` must be identical on every host (chunk boundaries
+    feed the compiled scan program), so it keys on (step, durable
+    fired-marker) — both fleet-consistent — and the host check happens
+    only inside ``after_step``.  The marker is written *before* the
+    SIGKILL: a marker with no kill is a skipped drill (visible via the
+    unfired audit), a kill with no marker is an infinite kill-loop
+    across supervisor restarts."""
+
+    def __init__(self, injector: "ChaosInjector", step: int):
+        self._injector = injector
+        self._step = step
+
+    def begin(self, state) -> None: ...
+
+    def wants_step(self, step: int) -> bool:
+        return step == self._step and not self._injector._kill_fired()
+
+    def after_step(self, state, metrics, step: int) -> None:
+        inj = self._injector
+        if step != self._step or inj._kill_fired():
+            return
+        if not inj._on_target_host():
+            return
+        inj._mark_kill_fired()
+        log.warning(
+            "chaos: SIGKILLing this process (host %d) after step %d",
+            inj.config.chaos_host, step,
+        )
+        import os
+
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def end(self, state) -> None: ...
+
+    def abort(self, state) -> None: ...
+
+
+class _StragglerDelay:
+    """Duck-typed hook sleeping ``delay_s`` in every hook walk on the
+    target host — the one-slow-host drill.  ``wants_step`` is True
+    uniformly (host-independent, as chunk alignment requires), which
+    degrades fused loops to per-step walks on EVERY host — uniform, so
+    programs stay in lock-step; the drill measures the fleet slowing to
+    the straggler's pace, never a result change."""
+
+    def __init__(self, injector: "ChaosInjector", delay_s: float):
+        self._injector = injector
+        self._delay = delay_s
+
+    def begin(self, state) -> None: ...
+
+    def wants_step(self, step: int) -> bool:
+        return True
+
+    def after_step(self, state, metrics, step: int) -> None:
+        inj = self._injector
+        if inj._on_target_host():
+            if not inj._straggler_fired:
+                inj._straggler_fired = True
+                log.warning(
+                    "chaos: straggler delay %.0f ms/step active on host %d",
+                    1000 * self._delay, inj.config.chaos_host,
+                )
+            import time
+
+            time.sleep(self._delay)
+
+    def end(self, state) -> None: ...
+
+    def abort(self, state) -> None: ...
+
+
 class ChaosInjector:
     """One injector per (workdir, spec, seed); all fired-state lives here
-    so recovery replays within the process do not re-fault."""
+    so recovery replays within the process do not re-fault.
+    (``kill_at_step`` alone persists its fired-state to
+    ``<scope>/.chaos_fired/`` — the module docstring's durable
+    at-most-once.)"""
 
-    def __init__(self, config: ChaosConfig):
+    def __init__(self, config: ChaosConfig, scope: str = ""):
         self.config = config
+        self._scope = scope
         self._lock = threading.Lock()
         self._dispatch_count = 0
         self._pipeline_fired = False
         self._nan_fired = False
         self._tear_fired = False
         self._sigterm_fired = False
+        self._kill_fired_mem = False  # fallback when scope is empty
+        self._hide_fired = False
+        self._straggler_fired = False
+        self._process_index: Optional[int] = None
+
+    # -- cross-host targeting ---------------------------------------------
+
+    def _on_target_host(self) -> bool:
+        """Is this process the cross-host faults' victim?  Resolved
+        lazily so single-process unit tests never need a cluster (and a
+        jax-free context reads as process 0)."""
+        if self._process_index is None:
+            try:
+                import jax
+
+                self._process_index = jax.process_index()
+            except Exception:  # noqa: BLE001 — no backend = process 0
+                self._process_index = 0
+        return self._process_index == self.config.chaos_host
 
     # -- pipeline worker fault --------------------------------------------
 
@@ -286,12 +425,19 @@ class ChaosInjector:
         falls in steps ``[first_step, first_step + k)``.  ``k > 1`` means a
         stacked fused chunk (leading axis = chunk row); ``k == 1`` a plain
         batch.  Only float leaves are poisoned (int token streams cannot
-        carry NaN — a config pointing chaos at one gets a warning)."""
+        carry NaN — a config pointing chaos at one gets a warning).
+
+        Fires only on the ``chaos_host`` process (default 0 — every
+        single-process run is its own target): the multi-host drill is
+        *one host's* shard going bad, with the fleet-agreed divergence
+        verdict — not the fleet-wide NaN of poisoning every shard —
+        rolling every host back together."""
         target = self.config.nan_at_step
         if (
             target is None
             or self._nan_fired
             or not first_step <= target < first_step + k
+            or not self._on_target_host()
         ):
             return batch
         self._nan_fired = True
@@ -380,21 +526,122 @@ class ChaosInjector:
             return None
         return _TearAtStep(self, k, save_fn)
 
+    # -- cross-host: kill -9 -----------------------------------------------
+
+    def _kill_marker(self) -> str:
+        import os
+
+        return os.path.join(self._scope, ".chaos_fired", "kill_at_step")
+
+    def _kill_fired(self) -> bool:
+        if self._kill_fired_mem:
+            return True
+        if not self._scope:
+            return False
+        import os
+
+        return os.path.exists(self._kill_marker())
+
+    def _mark_kill_fired(self) -> None:
+        self._kill_fired_mem = True
+        if not self._scope:
+            return
+        import os
+
+        path = self._kill_marker()
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                f.write(str(self.config.kill_at_step))
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:  # the kill still proceeds; worst case re-fires
+            log.exception("chaos: failed to persist kill fired-marker")
+
+    def kill_hook(self):
+        """The hook ``fit`` appends when ``kill_at_step`` is set."""
+        if self.config.kill_at_step is None:
+            return None
+        return _KillAtStep(self, self.config.kill_at_step)
+
+    # -- cross-host: straggler ---------------------------------------------
+
+    def straggler_hook(self):
+        """The hook ``fit`` appends when ``straggler_delay_ms`` > 0."""
+        if not self.config.straggler_delay_ms:
+            return None
+        return _StragglerDelay(self, self.config.straggler_delay_ms / 1000.0)
+
+    # -- cross-host: checkpoint-visibility skew ----------------------------
+
+    def step_filter(self):
+        """``CheckpointManager`` view filter for ``hide_newest_ckpt``:
+        on the target host the newest retained step vanishes from
+        listings (``all_steps``/``latest_step``/restore-walk
+        candidates) while the files stay readable — metadata lag, the
+        real shape of object-store visibility skew.  None when off."""
+        if not self.config.hide_newest_ckpt:
+            return None
+
+        def _filter(steps):
+            steps = list(steps)
+            if not steps or not self._on_target_host():
+                return steps
+            newest = max(steps)
+            if not self._hide_fired:
+                self._hide_fired = True
+                log.warning(
+                    "chaos: hiding newest checkpoint step %d from host "
+                    "%d's view (visibility-skew simulation)",
+                    newest, self.config.chaos_host,
+                )
+            return [s for s in steps if s != newest]
+
+        return _filter
+
     # -- drill accounting --------------------------------------------------
 
     def unfired(self) -> list[str]:
-        """Configured-but-never-fired faults, as ``key=value`` strings."""
+        """Configured-but-never-fired faults, as ``key=value`` strings.
+        A zero value on the flag-like fields (``hide_newest_ckpt``,
+        ``straggler_delay_ms``) means *off*, not armed-at-zero."""
         flags = {
             "pipeline_fail_at_batch": self._pipeline_fired,
             "nan_at_step": self._nan_fired,
             "torn_checkpoint_at_step": self._tear_fired,
             "sigterm_at_step": self._sigterm_fired,
+            "kill_at_step": self._kill_fired(),
+            "hide_newest_ckpt": self._hide_fired,
+            "straggler_delay_ms": self._straggler_fired,
         }
-        return [
-            f"{field}={getattr(self.config, field)}"
-            for field in _FIELDS
-            if getattr(self.config, field) is not None and not flags[field]
-        ]
+        zero_is_off = ("hide_newest_ckpt", "straggler_delay_ms")
+        # Host-targeted faults with purely local fired-state can only be
+        # audited on their target host — a non-target process reporting
+        # them "unfired" would be a false alarm.  (kill_at_step's
+        # durable marker is fleet-wide, so every host audits it.)
+        target_only = (
+            "hide_newest_ckpt", "straggler_delay_ms", "nan_at_step",
+        )
+        out = []
+        for field in _FAULT_FIELDS:
+            value = getattr(self.config, field)
+            if value is None or (field in zero_is_off and value == 0):
+                continue
+            if field in target_only and not self._on_target_host():
+                continue
+            if not flags[field]:
+                out.append(f"{field}={value}")
+        return out
+
+    def export_unfired(self, registry) -> None:
+        """Set the ``chaos/armed_unfired`` gauge (→ telemetry.json via
+        the registry snapshot the goodput report embeds): an exit-0
+        drill with this nonzero exercised nothing."""
+        from distributed_tensorflow_models_tpu import telemetry
+
+        registry.gauge(telemetry.CHAOS_ARMED_UNFIRED).set(
+            float(len(self.unfired()))
+        )
 
     def warn_unfired(self) -> None:
         """End-of-run audit: a drill whose fault never injected must not
@@ -431,6 +678,6 @@ def get_injector(
     with _INJECTORS_LOCK:
         inj = _INJECTORS.get(key)
         if inj is None:
-            inj = _INJECTORS[key] = ChaosInjector(config)
+            inj = _INJECTORS[key] = ChaosInjector(config, scope=scope)
             log.warning("chaos injection ACTIVE: %s", config)
         return inj

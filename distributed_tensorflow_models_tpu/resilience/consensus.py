@@ -1,0 +1,152 @@
+"""Chief-decides consensus: one fleet, one view of every shared decision.
+
+Multi-host training shares exactly one piece of mutable state outside
+the SPMD program: the checkpoint directory.  Orbax operations on it are
+*collective* (every process enters save/restore together), but until
+this module the *decisions* feeding those collectives — skip or replace
+an existing step, which step the restore walk settles on, whether any
+checkpoint exists at all, whether this chunk diverged — were each made
+from a **per-process view** of storage.  On a same-filesystem fleet the
+views agree; on storage with cross-host visibility skew (object stores,
+replicated NFS) they can differ, and two processes entering different
+collectives is not a degraded run, it is a hung or corrupted fleet.
+
+The fix is the same shape the harness already used for the checkpoint
+clock (``CheckpointHook``'s chief-broadcast poll): the **chief decides,
+everyone obeys**.  :class:`Consensus` packages that as two allgather-
+based primitives —
+
+- :meth:`broadcast_int` — every process contributes its local value,
+  every process returns the *chief's* (process 0's);
+- :meth:`allgather_int` — every process returns the full per-process
+  vector (for any-host / earliest-host reductions);
+
+plus :meth:`any_flag` built on them.  Single-process (the common case,
+and every unit test) both are **exact no-ops** — no jax import, no
+collective, the local value straight back — so the PR-4 behavior of
+every consumer is bit-identical when ``process_count == 1``.
+
+The default backend is ``jax.experimental.multihost_utils`` (lazy
+import, only ever touched with more than one process).  ``backend`` is
+injectable so a scripted bus can simulate a skewed two-host fleet in a
+single test process (``tests/test_fleet.py``).
+
+Every consensus point is a collective: callers must reach it on every
+process or none (the same contract as any other collective in the
+harness).  Decisions are encoded as ints (steps, enum codes, flags) —
+small, loggable, and trivially broadcastable.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+log = logging.getLogger("dtm")
+
+
+class Backend:
+    """Collective transport for :class:`Consensus` (injectable).
+
+    ``allgather(value) -> list[int]`` returns every process's value,
+    index == process index.  The default implementation rides
+    ``multihost_utils.process_allgather``.
+    """
+
+    def allgather(self, value: int) -> Sequence[int]:
+        # int32 on the wire: with jax's default x64-disabled config an
+        # int64 array is silently truncated to int32 inside the
+        # collective, so values MUST fit int32 — callers use sentinels
+        # inside that range (consensus payloads are steps, enum codes,
+        # and flags).
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        if not -(2**31) <= int(value) < 2**31:
+            raise ValueError(
+                f"consensus value {value} does not fit the int32 wire"
+            )
+        gathered = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray(value, np.int32)
+            )
+        )
+        return [int(v) for v in gathered.reshape(-1)]
+
+
+class Consensus:
+    """Chief-decides broadcast over an allgather backend.
+
+    ``process_index``/``process_count`` default to the live jax values
+    (resolved lazily, so constructing one in a single-process program
+    that never initialized ``jax.distributed`` costs nothing); both are
+    injectable, with ``backend``, for tests simulating a fleet.
+    """
+
+    def __init__(
+        self,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+        backend: Optional[Backend] = None,
+    ):
+        if process_index is None or process_count is None:
+            import jax
+
+            process_index = (
+                jax.process_index() if process_index is None else process_index
+            )
+            process_count = (
+                jax.process_count() if process_count is None else process_count
+            )
+        self._pid = process_index
+        self._nproc = process_count
+        self._backend = backend
+
+    @property
+    def process_index(self) -> int:
+        return self._pid
+
+    @property
+    def process_count(self) -> int:
+        return self._nproc
+
+    @property
+    def is_chief(self) -> bool:
+        return self._pid == 0
+
+    @property
+    def active(self) -> bool:
+        """True when decisions actually cross processes.  False is the
+        single-process no-op path: primitives return their inputs and
+        never touch the backend."""
+        return self._nproc > 1
+
+    def allgather_int(self, value: int, *, label: str = "") -> list[int]:
+        """Every process's ``value`` (index == process index).
+        Single-process: ``[value]``, no collective."""
+        if not self.active:
+            return [int(value)]
+        if self._backend is None:
+            self._backend = Backend()
+        return list(self._backend.allgather(int(value)))
+
+    def broadcast_int(self, value: int, *, label: str = "") -> int:
+        """The chief's ``value``, on every process.  Single-process: the
+        local value back.  When the local value disagrees with the
+        chief's the divergence is logged — that log line IS the
+        visibility-skew detector."""
+        agreed = self.allgather_int(value, label=label)[0]
+        if agreed != int(value):
+            log.warning(
+                "consensus%s: local decision %d overridden by chief's %d "
+                "(process %d; cross-host view skew)",
+                f" [{label}]" if label else "", int(value), agreed, self._pid,
+            )
+        return agreed
+
+    def any_flag(self, flag: bool, *, label: str = "") -> bool:
+        """True iff ANY process passed True (allgather-OR).
+        Single-process: ``flag`` back."""
+        if not self.active:
+            return bool(flag)
+        return max(self.allgather_int(int(bool(flag)), label=label)) > 0

@@ -66,13 +66,22 @@ def sidecar_issues(
     ckpt_dir: str, step: int, process_count: Optional[int] = None
 ) -> list[str]:
     """Degraded (non-fatal) issues with a step's per-process dataset
-    sidecars: unparseable JSON, or a topology stamp that disagrees with
-    ``process_count`` (when given) — both make resume *approximate*
-    (primary-position fallback), not impossible."""
+    sidecars: unparseable JSON, a topology stamp that disagrees with
+    ``process_count`` (when given), or — also only when
+    ``process_count`` is given — a missing peer sidecar (the step is
+    then not *fleet-valid*: some process would resume from the
+    primary's approximate position).  All make resume approximate,
+    not impossible."""
     issues: list[str] = []
     base = os.path.join(ckpt_dir, "dataset_states", str(step))
     if not os.path.isdir(base):
+        if process_count is not None and process_count > 1:
+            issues.append(
+                f"no dataset_states/{step}/ sidecar directory for a "
+                f"{process_count}-process topology (approximate resume)"
+            )
         return issues  # single-process runs write no sidecars: fine
+    present: set[int] = set()
     for name in sorted(os.listdir(base)):
         if not name.endswith(".json"):  # skips .json.tmp in-flight writes
             continue
@@ -83,6 +92,11 @@ def sidecar_issues(
         except (OSError, ValueError) as e:
             issues.append(f"sidecar {name}: unreadable ({e})")
             continue
+        if name.startswith("p"):
+            try:
+                present.add(int(name[1:-5]))
+            except ValueError:
+                pass
         stamp = wrapped.get("nproc") if isinstance(wrapped, dict) else None
         if (
             stamp is not None
@@ -93,7 +107,50 @@ def sidecar_issues(
                 f"sidecar {name}: topology stamp nproc={stamp} != "
                 f"{process_count} (approximate resume)"
             )
+    if process_count is not None:
+        missing = [p for p in range(process_count) if p not in present]
+        if missing:
+            issues.append(
+                "missing peer sidecar(s) for process(es) "
+                f"{missing} (step is not fleet-valid)"
+            )
     return issues
+
+
+def sidecar_presence(ckpt_dir: str, step: int) -> list[int]:
+    """Process ids with a *parseable* dataset sidecar at ``step``
+    (ascending).  A present-but-unreadable sidecar does not count — it
+    degrades to the primary's position at restore time exactly like a
+    missing one."""
+    base = os.path.join(ckpt_dir, "dataset_states", str(step))
+    if not os.path.isdir(base):
+        return []
+    pids: list[int] = []
+    for name in os.listdir(base):
+        if not (name.startswith("p") and name.endswith(".json")):
+            continue
+        try:
+            pid = int(name[1:-5])
+        except ValueError:
+            continue
+        try:
+            with open(os.path.join(base, name)) as f:
+                json.load(f)
+        except (OSError, ValueError):
+            continue
+        pids.append(pid)
+    return sorted(pids)
+
+
+def fleet_sidecars_complete(
+    ckpt_dir: str, step: int, process_count: int
+) -> bool:
+    """True when every process id in ``range(process_count)`` has a
+    parseable sidecar at ``step`` — the *fleet-valid* bar the multi-host
+    restore walk prefers (a step missing a peer sidecar forces that
+    peer onto the primary's approximate position)."""
+    present = set(sidecar_presence(ckpt_dir, step))
+    return all(p in present for p in range(process_count))
 
 
 def fsck_checkpoints(
@@ -101,30 +158,51 @@ def fsck_checkpoints(
 ) -> dict:
     """Sweep every step under an orbax checkpoint root.
 
-    Returns ``{"steps": [{"step", "valid", "issues", "sidecar_issues"},
-    ...] (ascending), "latest_step", "newest_valid_step"}`` —
-    ``newest_valid_step`` is what a hardened restore would pick; it
-    differs from ``latest_step`` exactly when the restore would walk
-    back.
+    Returns ``{"steps": [{"step", "valid", "issues", "sidecar_issues",
+    "sidecar_procs", "fleet_valid"}, ...] (ascending), "latest_step",
+    "newest_valid_step", "newest_fleet_valid_step"}`` —
+    ``newest_valid_step`` is what a hardened single-process restore
+    would pick (differs from ``latest_step`` exactly when the restore
+    would walk back); ``sidecar_procs`` lists the process ids with a
+    parseable dataset sidecar; ``fleet_valid`` (and the newest-such
+    summary) additionally requires, when ``process_count`` is given,
+    every peer's sidecar — the bar a multi-host chief-decides restore
+    prefers.
     """
     steps: list[int] = []
     if os.path.isdir(ckpt_dir):
         for name in os.listdir(ckpt_dir):
             if name.isdigit() and os.path.isdir(os.path.join(ckpt_dir, name)):
                 steps.append(int(name))
-    report: dict = {"steps": [], "latest_step": None, "newest_valid_step": None}
+    report: dict = {
+        "steps": [],
+        "latest_step": None,
+        "newest_valid_step": None,
+        "newest_fleet_valid_step": None,
+    }
     for step in sorted(steps):
         issues = validate_step_dir(os.path.join(ckpt_dir, str(step)))
         side = sidecar_issues(ckpt_dir, step, process_count)
+        # One parse pass feeds both fields (remote checkpoint roots make
+        # repeated sidecar reads the sweep's dominant cost).
+        procs = sidecar_presence(ckpt_dir, step)
+        fleet_valid = not issues and (
+            process_count is None
+            or all(p in procs for p in range(process_count))
+        )
         report["steps"].append(
             {
                 "step": step,
                 "valid": not issues,
                 "issues": issues,
                 "sidecar_issues": side,
+                "sidecar_procs": procs,
+                "fleet_valid": fleet_valid,
             }
         )
         report["latest_step"] = step
         if not issues:
             report["newest_valid_step"] = step
+        if fleet_valid:
+            report["newest_fleet_valid_step"] = step
     return report

@@ -24,12 +24,17 @@ durations, ``harness/hooks.py::TelemetryHook`` snapshots everything into
 """
 
 from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
+    CHAOS_ARMED_UNFIRED,
     CKPT_RESTORE,
     CKPT_SAVE,
     CKPT_WAIT,
     COMPILE,
+    CONSENSUS_OVERRIDES,
     DATA_WAIT,
     DISPATCH,
+    FLEET_HEARTBEAT_AGE,
+    FLEET_PEERS_ALIVE,
+    FLEET_STEP_LAG,
     FLOPS_PER_STEP,
     FLOPS_TOTAL,
     HOOK_WALKS,

@@ -69,6 +69,22 @@ RESTARTS = "train/restarts"  # counter
 ROLLBACKS = "train/rollbacks"  # counter
 SKIPPED_BATCHES = "train/skipped_batches"  # counter
 WATCHDOG_LAST_PROGRESS = "train/watchdog_last_progress_s"  # gauge
+# Fleet health (multi-host; resilience/heartbeat.py read by the chief's
+# FleetHook).  PEERS_ALIVE counts processes with a fresh heartbeat;
+# STEP_LAG is max−min step among alive peers (straggler skew);
+# HEARTBEAT_AGE the worst heartbeat age.  CONSENSUS_OVERRIDES counts
+# checkpoint decisions where this process's local storage view disagreed
+# with the chief's broadcast (nonzero = cross-host visibility skew
+# observed — the de-sync chief-decides exists to absorb).
+FLEET_PEERS_ALIVE = "fleet/peers_alive"  # gauge
+FLEET_STEP_LAG = "fleet/step_lag"  # gauge
+FLEET_HEARTBEAT_AGE = "fleet/heartbeat_age_s"  # gauge
+CONSENSUS_OVERRIDES = "fleet/consensus_overrides"  # counter
+# Chaos drill audit: configured-but-never-fired fault count at report
+# time (resilience/chaos.py::ChaosInjector.unfired, exported by fit into
+# telemetry.json) — a drill that exits 0 with this nonzero exercised
+# nothing.
+CHAOS_ARMED_UNFIRED = "chaos/armed_unfired"  # gauge
 
 
 class Counter:

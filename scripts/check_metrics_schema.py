@@ -20,6 +20,16 @@ Checks, per line:
   (not checked monotonic: a recoverable_fit restart resets the per-run
   counters mid-file, legally);
 
+- fleet gauges (``fleet/peers_alive``, ``fleet/step_lag``,
+  ``fleet/heartbeat_age_s`` — the chief's FleetHook under a supervising
+  launcher, README "Robustness" → "Multi-host"): injected as a full
+  set, each non-negative; ``fleet/peers_alive`` additionally at most
+  the fleet size is not checkable here (the file does not carry the
+  topology), so only non-negativity is enforced;
+
+- chaos keys (``chaos/*`` — e.g. ``chaos/armed_unfired``): any present
+  value must be a non-negative number;
+
 and, across the file with ``--require-telemetry``: at least one row
 carries the full telemetry key set (``data_wait_s``, ``step_time_s``,
 ``mfu``) — the TelemetryHook injects them together, so a partial set on
@@ -44,6 +54,14 @@ TELEMETRY_KEYS = ("data_wait_s", "step_time_s", "mfu")
 # restarts, so only non-negativity (not monotonicity) is checkable
 # across a whole file.  Injected as a full set, like TELEMETRY_KEYS.
 RESILIENCE_KEYS = ("restarts", "rollbacks", "skipped_batches")
+# Fleet-health gauges the chief's FleetHook injects together (README
+# "Robustness" → "Multi-host"); like the sets above, a partial set on a
+# row is always a writer bug.  Only present under a supervising launcher
+# (heartbeats on), so absence across the whole file is fine.
+FLEET_KEYS = ("fleet/peers_alive", "fleet/step_lag", "fleet/heartbeat_age_s")
+# Prefix for chaos-drill accounting keys (chaos/armed_unfired today):
+# values must be non-negative numbers wherever they appear.
+CHAOS_PREFIX = "chaos/"
 
 
 def _is_number(v) -> bool:
@@ -120,6 +138,23 @@ def check_lines(
                 errors.append(
                     f"line {i}: resilience counter {key!r} is negative: "
                     f"{value!r}"
+                )
+        fleet_present = [k for k in FLEET_KEYS if k in row]
+        if fleet_present and len(fleet_present) != len(FLEET_KEYS):
+            errors.append(
+                f"line {i}: partial fleet key set {fleet_present} "
+                f"(expected all of {list(FLEET_KEYS)} together)"
+            )
+        for key in fleet_present:
+            value = row[key]
+            if _is_number(value) and value < 0:
+                errors.append(
+                    f"line {i}: fleet gauge {key!r} is negative: {value!r}"
+                )
+        for key, value in row.items():
+            if key.startswith(CHAOS_PREFIX) and _is_number(value) and value < 0:
+                errors.append(
+                    f"line {i}: chaos key {key!r} is negative: {value!r}"
                 )
     return errors, rows, telemetry_rows
 

@@ -1,0 +1,367 @@
+#!/usr/bin/env python
+"""Two-process fleet chaos drills, runnable outside pytest.
+
+Each drill spawns a real 2-process localhost cluster (2 fake CPU devices
+per process, gloo collectives — ``launch.launch_local``) on the tiny
+LeNet config, injects one cross-host fault, and verifies the recovery
+contract from ISSUE 5's acceptance list:
+
+- ``baseline``  — fault-free reference run; both hosts must already
+  agree bit-identically on the final params/opt_state.
+- ``skew``      — train 3 steps, then resume to 6 with the newest
+  checkpoint HIDDEN from host 1's listings
+  (``hide_newest_ckpt=1,chaos_host=1``): the chief-decided restore must
+  put both hosts on the chief's step and the end state must be
+  bit-identical to the no-skew baseline.
+- ``kill``      — host 1 SIGKILLs itself after step 3
+  (``kill_at_step=3``): the supervisor must tear the fleet down within
+  the grace window (no collective-timeout hang), relaunch it
+  (``supervise_local``), and the recovered run must be bit-identical to
+  the baseline.
+- ``straggler`` — host 1 sleeps 40 ms per step
+  (``straggler_delay_ms=40``): slower, never different — end state
+  bit-identical to the baseline.
+- ``nan``       — host 1's batch for step 3 is NaN-poisoned under
+  ``nan_policy=rollback``: BOTH hosts must roll back together (the
+  fleet-agreed divergence), complete with exactly 1 rollback and
+  exactly 1 skipped batch each, and agree bit-identically on the end
+  state.
+
+Every worker (both hosts, not just the chief) writes a
+``result-p<i>.json`` with sha256 digests of its final params and
+opt_state, so cross-host agreement is itself part of every drill's
+verdict.  Exit status: 0 when every requested drill passes, 1
+otherwise.
+
+Usage::
+
+    python scripts/fleet_drill.py [--drills skew,kill,nan] [--keep]
+
+The parent process never imports jax (safe on a login host); all
+training happens in the spawned workers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO)
+
+from distributed_tensorflow_models_tpu import launch  # noqa: E402
+
+# Ports are per-drill so a crashed drill's TIME_WAIT listener cannot
+# trip the next one (supervise_local additionally bumps per restart).
+PORTS = {
+    "baseline": 9811,
+    "skew": 9821,
+    "kill": 9831,
+    "straggler": 9851,
+    "nan": 9861,
+}
+
+STEPS = 6
+CKPT_EVERY = 2
+
+WORKER = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, {repo!r})
+    import hashlib, json, os
+    from distributed_tensorflow_models_tpu import launch
+    assert launch.initialize_from_env(), "cluster env missing"
+    import jax
+    import numpy as np
+    from distributed_tensorflow_models_tpu.harness import train as trainlib
+    from distributed_tensorflow_models_tpu.harness.config import get_config
+
+    cfg = get_config("lenet_mnist", **json.loads({overrides_json!r}))
+    res = trainlib.fit(cfg, {workdir!r})
+
+    def tree_sha(tree):
+        h = hashlib.sha256()
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        for path, leaf in sorted(leaves, key=lambda kv: str(kv[0])):
+            h.update(str(path).encode())
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest()
+
+    out = {{
+        "step": int(res.state.step),
+        "loss": float(res.final_metrics.get("loss", float("nan"))),
+        "params_sha": tree_sha(res.state.params),
+        "opt_sha": tree_sha(res.state.opt_state),
+        "rollbacks": res.rollbacks,
+        "skipped_batches": res.skipped_batches,
+        "preempted": res.preempted,
+    }}
+    path = os.path.join(
+        {outdir!r}, "result-p%d.json" % jax.process_index()
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f)
+    os.replace(tmp, path)
+    sys.exit(launch.RESUMABLE_EXIT_CODE if res.preempted else 0)
+    """
+)
+
+
+def _base_overrides(**extra) -> dict:
+    out = dict(
+        train_steps=STEPS,
+        global_batch_size=32,
+        log_every_steps=2,
+        checkpoint_every_secs=1e9,  # deterministic step cadence only
+        checkpoint_every_steps=CKPT_EVERY,
+        preempt_poll_steps=2,
+    )
+    out.update(extra)
+    return out
+
+
+def run_fleet(
+    scratch: str,
+    name: str,
+    overrides: dict,
+    workdir: str,
+    *,
+    port: int,
+    supervised: bool = False,
+    max_restarts: int = 0,
+    timeout: float = 420.0,
+):
+    """One 2-process phase.  Returns ``(aggregate_code, results)`` where
+    results[i] is host i's result dict (None if it never finished)."""
+    outdir = os.path.join(scratch, f"{name}-out")
+    os.makedirs(outdir, exist_ok=True)
+    script = os.path.join(scratch, f"{name}-worker.py")
+    with open(script, "w") as f:
+        f.write(
+            WORKER.format(
+                repo=_REPO,
+                overrides_json=json.dumps(overrides),
+                workdir=workdir,
+                outdir=outdir,
+            )
+        )
+    argv = [sys.executable, script]
+    kwargs = dict(
+        port=port,
+        cpu_devices_per_process=2,
+        timeout=timeout,
+        term_grace_s=8.0,
+    )
+    if supervised:
+        agg = launch.supervise_local(
+            2, argv, max_restarts=max_restarts, backoff_base_s=0.0,
+            **kwargs,
+        )
+    else:
+        agg = launch.aggregate_exit_codes(
+            launch.launch_local(2, argv, **kwargs)
+        )
+    results = []
+    for i in range(2):
+        path = os.path.join(outdir, f"result-p{i}.json")
+        results.append(json.load(open(path)) if os.path.exists(path) else None)
+    return agg, results
+
+
+def _check(cond: bool, what: str, errors: list[str]) -> None:
+    if not cond:
+        errors.append(what)
+
+
+def _check_host_agreement(results, errors: list[str]) -> None:
+    _check(
+        all(r is not None for r in results),
+        f"missing per-host results: {results}",
+        errors,
+    )
+    if not all(r is not None for r in results):
+        return
+    for key in ("step", "params_sha", "opt_sha", "rollbacks",
+                "skipped_batches"):
+        _check(
+            results[0][key] == results[1][key],
+            f"hosts disagree on {key}: "
+            f"{results[0][key]!r} vs {results[1][key]!r}",
+            errors,
+        )
+
+
+def drill_baseline(scratch: str) -> tuple[list[str], dict]:
+    errors: list[str] = []
+    agg, results = run_fleet(
+        scratch, "baseline", _base_overrides(),
+        os.path.join(scratch, "baseline-wd"), port=PORTS["baseline"],
+    )
+    _check(agg == 0, f"baseline fleet exit {agg}", errors)
+    _check_host_agreement(results, errors)
+    ref = results[0] or {}
+    _check(ref.get("step") == STEPS, f"baseline ended at {ref}", errors)
+    return errors, ref
+
+
+def _compare_to_baseline(results, ref: dict, errors: list[str]) -> None:
+    _check_host_agreement(results, errors)
+    if results[0] is None:
+        return
+    for key in ("step", "params_sha", "opt_sha"):
+        _check(
+            results[0][key] == ref.get(key),
+            f"{key} differs from the fault-free baseline: "
+            f"{results[0][key]!r} vs {ref.get(key)!r}",
+            errors,
+        )
+
+
+def drill_skew(scratch: str, ref: dict) -> list[str]:
+    errors: list[str] = []
+    workdir = os.path.join(scratch, "skew-wd")
+    agg, _ = run_fleet(
+        scratch, "skew-phase1", _base_overrides(train_steps=3),
+        workdir, port=PORTS["skew"],
+    )
+    _check(agg == 0, f"skew phase-1 fleet exit {agg}", errors)
+    agg, results = run_fleet(
+        scratch, "skew-phase2",
+        _base_overrides(
+            chaos={"hide_newest_ckpt": 1, "chaos_host": 1}
+        ),
+        workdir, port=PORTS["skew"] + 1,
+    )
+    _check(agg == 0, f"skew phase-2 fleet exit {agg}", errors)
+    _compare_to_baseline(results, ref, errors)
+    return errors
+
+
+def drill_kill(scratch: str, ref: dict) -> list[str]:
+    errors: list[str] = []
+    agg, results = run_fleet(
+        scratch, "kill",
+        _base_overrides(chaos={"kill_at_step": 3, "chaos_host": 1}),
+        os.path.join(scratch, "kill-wd"), port=PORTS["kill"],
+        supervised=True, max_restarts=2,
+    )
+    _check(agg == 0, f"kill drill supervisor exit {agg}", errors)
+    _compare_to_baseline(results, ref, errors)
+    return errors
+
+
+def drill_straggler(scratch: str, ref: dict) -> list[str]:
+    errors: list[str] = []
+    agg, results = run_fleet(
+        scratch, "straggler",
+        _base_overrides(
+            chaos={"straggler_delay_ms": 40, "chaos_host": 1}
+        ),
+        os.path.join(scratch, "straggler-wd"), port=PORTS["straggler"],
+    )
+    _check(agg == 0, f"straggler fleet exit {agg}", errors)
+    _compare_to_baseline(results, ref, errors)
+    return errors
+
+
+def drill_nan(scratch: str, ref: dict) -> list[str]:
+    errors: list[str] = []
+    agg, results = run_fleet(
+        scratch, "nan",
+        _base_overrides(
+            nan_policy="rollback",
+            rollback_budget=2,
+            chaos={"nan_at_step": 3, "chaos_host": 1},
+        ),
+        os.path.join(scratch, "nan-wd"), port=PORTS["nan"],
+    )
+    _check(agg == 0, f"nan drill fleet exit {agg}", errors)
+    _check_host_agreement(results, errors)
+    if all(r is not None for r in results):
+        for i, r in enumerate(results):
+            _check(
+                r["rollbacks"] == 1,
+                f"host {i}: expected exactly 1 rollback, got "
+                f"{r['rollbacks']}",
+                errors,
+            )
+            _check(
+                r["skipped_batches"] == 1,
+                f"host {i}: expected exactly 1 skipped batch, got "
+                f"{r['skipped_batches']}",
+                errors,
+            )
+            _check(r["step"] == STEPS, f"host {i} ended at {r['step']}", errors)
+    return errors
+
+
+DRILLS = ("skew", "kill", "straggler", "nan")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--drills", default=",".join(DRILLS),
+        help=f"comma-separated subset of {DRILLS} (baseline always runs)",
+    )
+    p.add_argument(
+        "--scratch", default=None,
+        help="working directory (default: a fresh temp dir)",
+    )
+    p.add_argument(
+        "--keep", action="store_true",
+        help="keep the scratch dir (checkpoints, logs, results)",
+    )
+    args = p.parse_args(argv)
+    wanted = [d.strip() for d in args.drills.split(",") if d.strip()]
+    unknown = set(wanted) - set(DRILLS)
+    if unknown:
+        p.error(f"unknown drills {sorted(unknown)}; have {DRILLS}")
+
+    scratch = args.scratch or tempfile.mkdtemp(prefix="dtm-fleet-drill-")
+    os.makedirs(scratch, exist_ok=True)
+    failed = False
+    try:
+        print(f"fleet drills in {scratch}: baseline + {wanted}")
+        errors, ref = drill_baseline(scratch)
+        _report("baseline", errors)
+        failed |= bool(errors)
+        if errors:
+            print("baseline failed; dependent drills skipped", file=sys.stderr)
+            return 1
+        for name in wanted:
+            fn = {
+                "skew": drill_skew,
+                "kill": drill_kill,
+                "straggler": drill_straggler,
+                "nan": drill_nan,
+            }[name]
+            errors = fn(scratch, ref)
+            _report(name, errors)
+            failed |= bool(errors)
+        return 1 if failed else 0
+    finally:
+        if not args.keep and not failed and args.scratch is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+        elif failed:
+            print(f"artifacts kept in {scratch}", file=sys.stderr)
+
+
+def _report(name: str, errors: list[str]) -> None:
+    if errors:
+        print(f"DRILL {name}: FAIL", file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+    else:
+        print(f"DRILL {name}: PASS")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

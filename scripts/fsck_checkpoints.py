@@ -6,8 +6,13 @@ Validates every retained orbax step under ``<workdir>/checkpoints`` (or
 a checkpoints dir given directly) with the same structural checks
 ``CheckpointManager.restore`` applies before auto-resume —
 finalization marker, state-item metadata/manifest — plus the degraded
-(non-fatal) per-process dataset-sidecar checks: unparseable JSON, and
-topology stamps that disagree with ``--process-count`` when given.
+(non-fatal) per-process dataset-sidecar checks: unparseable JSON,
+topology stamps that disagree with ``--process-count`` when given, and
+— with ``--process-count`` — per-process sidecar *completeness* (a step
+missing any peer's sidecar is not fleet-valid: the multi-host
+chief-decided restore prefers the newest step where every process can
+resume exactly; the report/JSON carry per-step ``sidecar_procs`` and
+``fleet_valid``).
 
 Output: one line per step (``OK`` / ``TORN`` / ``DEGRADED``) and a
 summary naming the step a hardened restore would actually use.  Exit 0
@@ -91,7 +96,16 @@ def main(argv=None) -> int:
                 status = "DEGRADED"
             else:
                 status = "OK"
-            print(f"step {entry['step']:>10d}  {status}")
+            procs = entry["sidecar_procs"]
+            detail = ""
+            if args.process_count is not None:
+                detail = (
+                    f"  sidecars {len(procs)}/{args.process_count}"
+                    f"{'' if entry['fleet_valid'] else '  NOT FLEET-VALID'}"
+                )
+            elif procs:
+                detail = f"  sidecars {procs}"
+            print(f"step {entry['step']:>10d}  {status}{detail}")
             for issue in entry["issues"]:
                 print(f"    {issue}")
             for issue in entry["sidecar_issues"]:
@@ -108,6 +122,16 @@ def main(argv=None) -> int:
             )
         else:
             print(f"restore target: step {report['newest_valid_step']}")
+        if (
+            args.process_count is not None
+            and report["newest_fleet_valid_step"] != report["newest_valid_step"]
+        ):
+            print(
+                "multi-host restore would PREFER step "
+                f"{report['newest_fleet_valid_step']} (newest with every "
+                f"process's dataset sidecar; newer steps force peers onto "
+                "the primary's approximate position)"
+            )
 
     ok = (
         report["newest_valid_step"] is not None

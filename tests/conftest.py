@@ -52,3 +52,28 @@ def mesh8():
 
     assert len(jax.devices()) == 8, jax.devices()
     return meshlib.data_parallel_mesh()
+
+
+@pytest.fixture(autouse=True)
+def _serialize_two_proc_tests(request):
+    """Machine-wide serialization of ``two_proc``-marked tests.
+
+    Each such test spawns a 2-process jax cluster (≈3 heavyweight
+    processes with this one).  Two of them overlapping — parallel pytest
+    sessions, a driver verify run racing a manual run — oversubscribes
+    the 1–2 cores this box has and turns a ~60 s test into a 300 s
+    timeout flake.  An exclusive flock on a fixed path means concurrent
+    runs queue instead of thrashing; within one pytest session the lock
+    is uncontended and costs nothing."""
+    if request.node.get_closest_marker("two_proc") is None:
+        yield
+        return
+    import fcntl
+
+    path = os.environ.get("DTM_TWO_PROC_LOCK", "/tmp/dtm-two-proc.lock")
+    with open(path, "a+") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)

@@ -1,0 +1,641 @@
+"""Multi-host coordination unit tests (ISSUE 5): chief-decides consensus
+(no-op single-process, skew-simulated two-manager walks), fleet
+heartbeats + the launch supervisor, cross-host chaos faults, per-process
+sidecar completeness (fsck), and the extended metrics schema — all in
+ONE process: the two-host consensus cases run against a scripted
+allgather bus (record the chief, replay for the follower), and the
+supervisor cases spawn trivial jax-free children.  The real 2-process
+drills live in ``tests/test_zz_fleet_drills.py`` / ``scripts/
+fleet_drill.py`` — named to run last so a load-truncated CI run loses
+the heavyweights, not the seed suite.
+"""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_tensorflow_models_tpu import launch, telemetry
+from distributed_tensorflow_models_tpu.harness import (
+    checkpoint as ckptlib,
+    hooks as hooklib,
+)
+from distributed_tensorflow_models_tpu.resilience import (
+    chaos as chaoslib,
+    consensus as conslib,
+    fsck as fscklib,
+    heartbeat as hblib,
+)
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _load_script(name):
+    from importlib import util as importutil
+
+    spec = importutil.spec_from_file_location(
+        name, os.path.join(_SCRIPTS, f"{name}.py")
+    )
+    mod = importutil.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --- consensus primitives -------------------------------------------------
+
+
+class _Exploding(conslib.Backend):
+    def allgather(self, value):  # pragma: no cover — the assertion
+        raise AssertionError("single-process consensus touched the backend")
+
+
+def test_consensus_single_process_is_exact_noop():
+    """The degenerate case the whole PR-4 test suite rests on: with one
+    process every primitive returns its input and the backend is never
+    consulted — so single-process fit behavior is bit-identical to
+    pre-consensus."""
+    c = conslib.Consensus(
+        process_index=0, process_count=1, backend=_Exploding()
+    )
+    assert not c.active
+    assert c.is_chief
+    assert c.broadcast_int(7) == 7
+    assert c.allgather_int(-3) == [-3]
+    assert c.any_flag(False) is False
+    assert c.any_flag(True) is True
+
+
+class _FixedBus(conslib.Backend):
+    def __init__(self, rows):
+        self.rows = list(rows)
+        self.calls = []
+
+    def allgather(self, value):
+        self.calls.append(value)
+        return self.rows.pop(0)
+
+
+def test_consensus_chief_wins_and_logs_skew(caplog):
+    c = conslib.Consensus(
+        process_index=1, process_count=2, backend=_FixedBus([[5, 9]])
+    )
+    with caplog.at_level("WARNING", logger="dtm"):
+        assert c.broadcast_int(9, label="unit") == 5
+    assert "overridden by chief's" in caplog.text
+    c2 = conslib.Consensus(
+        process_index=0, process_count=2, backend=_FixedBus([[0, 1]])
+    )
+    assert c2.any_flag(False) is True  # any-host OR
+
+
+class _ChiefBus(conslib.Backend):
+    """Chief side of the scripted two-host bus: echoes the chief's own
+    value as the fleet's (valid while no follower flag would differ)
+    and records the agreed sequence for the follower to replay."""
+
+    def __init__(self):
+        self.trace = []
+
+    def allgather(self, value):
+        self.trace.append(int(value))
+        return [int(value), int(value)]
+
+
+class _FollowerBus(conslib.Backend):
+    """Follower side: process 0's slot replays the chief's recorded
+    decision sequence, slot 1 is this process's live value."""
+
+    def __init__(self, trace):
+        self.trace = list(trace)
+
+    def allgather(self, value):
+        return [self.trace.pop(0), int(value)]
+
+
+# --- chief-decides checkpoint walks --------------------------------------
+
+
+def _tiny_state(step=0):
+    from distributed_tensorflow_models_tpu.core.train_state import TrainState
+    from distributed_tensorflow_models_tpu.models import get_model
+    from distributed_tensorflow_models_tpu.ops import optim
+
+    state = TrainState.create(
+        get_model("lenet", num_classes=4),
+        optim.tf_momentum(0.1, 0.9),
+        jax.random.key(0),
+        jnp.zeros((2, 28, 28, 1)),
+    )
+    return state.replace(step=jnp.asarray(step, jnp.int32))
+
+
+def _seed_checkpoints(tmp_path, steps=(2, 3)):
+    mgr = ckptlib.CheckpointManager(str(tmp_path), keep=5)
+    for step in steps:
+        assert mgr.save(_tiny_state(step), {"pos": step}, force=True)
+    mgr.close()
+
+
+def _chief_manager(tmp_path, *, step_filter=None, registry=None):
+    bus = _ChiefBus()
+    mgr = ckptlib.CheckpointManager(
+        str(tmp_path),
+        process_index=0,
+        process_count=2,
+        registry=registry,
+        consensus=conslib.Consensus(0, 2, backend=bus),
+        step_filter=step_filter,
+    )
+    return mgr, bus
+
+
+def _follower_manager(tmp_path, trace, *, step_filter=None, registry=None):
+    return ckptlib.CheckpointManager(
+        str(tmp_path),
+        process_index=1,
+        process_count=2,
+        registry=registry,
+        consensus=conslib.Consensus(1, 2, backend=_FollowerBus(trace)),
+        step_filter=step_filter,
+    )
+
+
+def test_chief_decides_restore_under_follower_skew(tmp_path):
+    """The newest step hidden from the FOLLOWER's listings (visibility
+    skew): the chief names the newest step and the follower restores it
+    strictly — same step on both hosts, and the follower's
+    skew-override is counted."""
+    _seed_checkpoints(tmp_path)
+    hide_newest = lambda steps: [s for s in steps if s != max(steps)]  # noqa: E731
+
+    chief, bus = _chief_manager(tmp_path)
+    restored_chief, _ = chief.restore(_tiny_state())
+    assert int(restored_chief.step) == 3
+    chief.close()
+
+    registry = telemetry.MetricsRegistry()
+    follower = _follower_manager(
+        tmp_path, bus.trace, step_filter=hide_newest, registry=registry
+    )
+    assert follower.latest_step() == 2  # the skewed local view...
+    restored_follower, _ = follower.restore(_tiny_state())
+    assert int(restored_follower.step) == 3  # ...but the chief's step
+    assert registry.snapshot()[telemetry.CONSENSUS_OVERRIDES] >= 1
+    follower.close()
+
+
+def test_chief_decides_restore_under_chief_skew(tmp_path):
+    """The newest step hidden from the CHIEF: both hosts settle on the
+    chief's (older) pick — one step fleet-wide, deterministic replay
+    from there, rather than a de-synced walk."""
+    _seed_checkpoints(tmp_path)
+    hide_newest = lambda steps: [s for s in steps if s != max(steps)]  # noqa: E731
+
+    chief, bus = _chief_manager(tmp_path, step_filter=hide_newest)
+    restored_chief, _ = chief.restore(_tiny_state())
+    assert int(restored_chief.step) == 2
+    chief.close()
+
+    follower = _follower_manager(tmp_path, bus.trace)
+    restored_follower, _ = follower.restore(_tiny_state())
+    assert int(restored_follower.step) == 2
+    follower.close()
+
+
+def test_fleet_walk_prefers_sidecar_complete_step(tmp_path):
+    """A structurally-valid step missing a peer's dataset sidecar is not
+    fleet-valid: the multi-host walk order puts the older-but-complete
+    step first (exact resume for every host beats newest-but-approximate)."""
+    _seed_checkpoints(tmp_path, steps=(1, 2))
+    ckpt_dir = os.path.join(str(tmp_path), "checkpoints")
+    for step, pids in ((1, (0, 1)), (2, (0,))):
+        base = os.path.join(ckpt_dir, "dataset_states", str(step))
+        os.makedirs(base, exist_ok=True)
+        for pid in pids:
+            with open(os.path.join(base, f"p{pid}.json"), "w") as f:
+                json.dump({"nproc": 2, "state": {"pos": step}}, f)
+
+    mgr = ckptlib.CheckpointManager(
+        str(tmp_path),
+        process_index=0,
+        process_count=2,
+        consensus=conslib.Consensus(0, 2, backend=_ChiefBus()),
+    )
+    assert mgr._walk_order() == [1, 2]
+    restored, data = mgr.restore(_tiny_state())
+    assert int(restored.step) == 1  # fleet-valid beats newest
+    assert data == {"pos": 1}
+    mgr.close()
+
+
+def test_save_decision_follower_obeys_chief(tmp_path, caplog):
+    """Reverse skew on save: the chief (lagging view) says PROCEED while
+    the follower already lists a valid checkpoint at that step — the
+    follower must clear its local registration and rejoin the collective
+    save instead of skipping out of the barrier (or crashing on
+    StepAlreadyExists)."""
+    _seed_checkpoints(tmp_path, steps=(3,))
+
+    registry = telemetry.MetricsRegistry()
+    follower = _follower_manager(
+        tmp_path, [ckptlib._SAVE_PROCEED], registry=registry
+    )
+    assert follower._local_save_decision(3) == ckptlib._SAVE_SKIP_EXISTS
+    with caplog.at_level("WARNING", logger="dtm"):
+        assert follower.save(_tiny_state(3), {"pos": "re-save"}, force=True)
+    assert "chief-decided save" in caplog.text
+    assert registry.snapshot()[telemetry.CONSENSUS_OVERRIDES] >= 1
+    restored, data = follower.restore(_tiny_state(), step=3)
+    assert data["pos"] == "re-save"
+    follower.close()
+
+
+def test_single_process_manager_never_broadcasts(tmp_path):
+    """PR-4 parity: a single-process manager wired with an exploding
+    backend must save/restore/walk without ever touching it."""
+    mgr = ckptlib.CheckpointManager(
+        str(tmp_path),
+        consensus=conslib.Consensus(0, 1, backend=_Exploding()),
+    )
+    assert mgr.save(_tiny_state(1), {"pos": 1}, force=True)
+    mgr.wait()
+    assert mgr.save(_tiny_state(1), {"pos": 1}, force=True) is False  # skip
+    restored, _ = mgr.restore(_tiny_state())
+    assert int(restored.step) == 1
+    mgr.close()
+
+
+# --- heartbeats + launch supervision -------------------------------------
+
+
+def test_heartbeat_writer_and_fleet_summary(tmp_path):
+    w = hblib.HeartbeatWriter(str(tmp_path), 0, interval_s=0.05).start()
+    try:
+        w.beat(7)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            views = hblib.read_fleet(str(tmp_path), 2)
+            if views[0] is not None and views[0]["step"] == 7:
+                break
+            time.sleep(0.02)
+        views = hblib.read_fleet(str(tmp_path), 2)
+        assert views[0] is not None and views[0]["step"] == 7
+        assert views[1] is None  # peer never started
+        summary = hblib.fleet_summary(str(tmp_path), 2, stale_after_s=60)
+        assert summary["peers_alive"] == 1
+        assert summary["step_lag"] == 0
+    finally:
+        w.stop()
+
+
+def test_fleet_summary_step_lag_and_staleness(tmp_path):
+    now = time.time()
+    for pid, (age, step) in enumerate(((0.1, 12), (100.0, 4))):
+        with open(os.path.join(str(tmp_path), f"p{pid}.json"), "w") as f:
+            json.dump({"pid": pid, "time": now - age, "step": step}, f)
+    fresh = hblib.fleet_summary(str(tmp_path), 2, stale_after_s=10, now=now)
+    assert fresh["peers_alive"] == 1  # p1 is stale
+    assert fresh["heartbeat_age_s"] == pytest.approx(100.0, abs=1.0)
+    both = hblib.fleet_summary(str(tmp_path), 2, stale_after_s=1000, now=now)
+    assert both["peers_alive"] == 2
+    assert both["step_lag"] == 8
+
+
+def test_fleet_hook_injects_gauges(tmp_path, caplog):
+    now = time.time()
+    with open(os.path.join(str(tmp_path), "p0.json"), "w") as f:
+        json.dump({"pid": 1, "time": now, "step": 10}, f)
+    # p1 missing entirely: a dead peer.
+    registry = telemetry.MetricsRegistry()
+    hook = hooklib.FleetHook(
+        registry, str(tmp_path), 2, every_steps=2, stale_after_s=30
+    )
+    assert hook.wants_step(2) and not hook.wants_step(3)
+    metrics = {}
+    with caplog.at_level("WARNING", logger="dtm"):
+        hook.after_step(None, metrics, 2)
+    assert metrics[telemetry.FLEET_PEERS_ALIVE] == 1.0
+    assert metrics[telemetry.FLEET_STEP_LAG] == 0.0
+    assert telemetry.FLEET_HEARTBEAT_AGE in metrics
+    snap = registry.snapshot()
+    assert snap[telemetry.FLEET_PEERS_ALIVE] == 1.0
+    assert "process 1 heartbeat is missing" in caplog.text
+
+
+def _child(tmp_path, body: str) -> list[str]:
+    script = tmp_path / "child.py"
+    script.write_text(textwrap.dedent(body))
+    return [sys.executable, str(script)]
+
+
+def test_launch_local_tears_fleet_down_on_child_death(tmp_path):
+    """A child dying with a real failure SIGTERMs the rest of the fleet
+    within seconds (the survivors' handler exits resumable), instead of
+    the launcher waiting on a fleet hung in dead collectives."""
+    argv = _child(
+        tmp_path,
+        """
+        import os, signal, sys, time
+        if os.environ["DTM_PROCESS_ID"] == "1":
+            time.sleep(0.3)
+            sys.exit(3)
+        signal.signal(signal.SIGTERM, lambda *a: sys.exit(75))
+        time.sleep(120)
+        """,
+    )
+    t0 = time.monotonic()
+    codes = launch.launch_local(2, argv, port=9901, term_grace_s=5)
+    assert time.monotonic() - t0 < 30
+    assert codes == [75, 3]
+    assert launch.aggregate_exit_codes(codes) == 3
+
+
+def test_launch_local_detects_stalled_child_via_heartbeat(tmp_path):
+    """A wedged (not dead) child is detected by heartbeat staleness:
+    process 1 heartbeats once then freezes its writer; the supervisor
+    attributes the stall to it and tears the fleet down."""
+    argv = _child(
+        tmp_path,
+        """
+        import json, os, signal, sys, time
+        pid = os.environ["DTM_PROCESS_ID"]
+        hb = os.environ["DTM_HEARTBEAT_DIR"]
+        signal.signal(signal.SIGTERM, lambda *a: sys.exit(75))
+
+        def beat(step):
+            path = os.path.join(hb, f"p{pid}.json")
+            json.dump(
+                {"pid": os.getpid(), "time": time.time(), "step": step},
+                open(path + ".tmp", "w"),
+            )
+            os.replace(path + ".tmp", path)
+
+        beat(1)
+        if pid == "1":
+            time.sleep(120)  # wedged: never beats again
+        for step in range(2, 1000):
+            beat(step)
+            time.sleep(0.2)
+        """,
+    )
+    t0 = time.monotonic()
+    codes = launch.launch_local(
+        2, argv, port=9902, heartbeat_timeout=2.0, term_grace_s=3
+    )
+    assert time.monotonic() - t0 < 30
+    assert codes[0] == 75  # healthy host drained gracefully
+    assert codes[1] != 0
+
+
+def test_supervise_local_restarts_fleet_with_attribution(tmp_path, capfd):
+    """The fleet restart loop: first launch fails (child 1 exits 9),
+    relaunch succeeds; stderr names the failed process."""
+    marker = tmp_path / "attempted"
+    argv = _child(
+        tmp_path,
+        f"""
+        import os, sys
+        if os.environ["DTM_PROCESS_ID"] == "1":
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                sys.exit(9)
+        sys.exit(0)
+        """,
+    )
+    rc = launch.supervise_local(
+        2, argv, max_restarts=2, backoff_base_s=0.0, port=9903,
+        term_grace_s=3,
+    )
+    assert rc == 0
+    err = capfd.readouterr().err
+    assert "process(es) [1] failed" in err
+    assert "relaunching the whole fleet" in err
+
+
+def test_supervise_local_gives_up_after_max_restarts(tmp_path):
+    argv = _child(tmp_path, "import sys; sys.exit(7)\n")
+    rc = launch.supervise_local(
+        2, argv, max_restarts=1, backoff_base_s=0.0, port=9904,
+        term_grace_s=2,
+    )
+    assert rc == 7
+
+
+def test_supervise_local_returns_preempted_without_restart(tmp_path):
+    argv = _child(tmp_path, "import sys; sys.exit(75)\n")
+    rc = launch.supervise_local(
+        2, argv, max_restarts=3, backoff_base_s=0.0, port=9905,
+        term_grace_s=2,
+    )
+    assert rc == launch.RESUMABLE_EXIT_CODE
+
+
+# --- cross-host chaos faults ---------------------------------------------
+
+
+def test_chaos_parse_accepts_cross_host_keys():
+    spec = chaoslib.parse_chaos_spec(
+        "kill_at_step=3,hide_newest_ckpt=1,straggler_delay_ms=40,"
+        "chaos_host=1"
+    )
+    cfg = chaoslib.ChaosConfig.from_dict(spec)
+    assert cfg.kill_at_step == 3
+    assert cfg.chaos_host == 1
+    with pytest.raises(ValueError):
+        chaoslib.parse_chaos_spec("explode_at_step=1")
+
+
+def test_chaos_hide_step_filter_targets_one_host():
+    inj = chaoslib.ChaosInjector(
+        chaoslib.ChaosConfig(hide_newest_ckpt=1, chaos_host=0)
+    )
+    inj._process_index = 0
+    assert inj.step_filter()([1, 2, 3]) == [1, 2]
+    assert inj._hide_fired
+    other = chaoslib.ChaosInjector(
+        chaoslib.ChaosConfig(hide_newest_ckpt=1, chaos_host=5)
+    )
+    other._process_index = 0
+    assert other.step_filter()([1, 2, 3]) == [1, 2, 3]  # not the target
+    off = chaoslib.ChaosInjector(chaoslib.ChaosConfig())
+    assert off.step_filter() is None
+
+
+def test_chaos_kill_fired_marker_is_durable(tmp_path):
+    """The kill drill's at-most-once must survive the process dying: a
+    FRESH injector over the same workdir sees the marker and disarms —
+    otherwise every supervisor relaunch would re-kill at step k and the
+    drill could never complete."""
+    scope = str(tmp_path)
+    a = chaoslib.ChaosInjector(
+        chaoslib.ChaosConfig(kill_at_step=3, chaos_host=0), scope=scope
+    )
+    a._process_index = 0
+    hook = a.kill_hook()
+    assert hook.wants_step(3)
+    a._mark_kill_fired()
+    assert a._kill_fired()
+    b = chaoslib.ChaosInjector(  # "the restarted process"
+        chaoslib.ChaosConfig(kill_at_step=3, chaos_host=0), scope=scope
+    )
+    b._process_index = 0
+    assert b._kill_fired()
+    assert not b.kill_hook().wants_step(3)
+    assert b.unfired() == []  # fired (durably) — not an unfired fault
+
+
+def test_chaos_straggler_hook_delays_only_target(monkeypatch):
+    inj = chaoslib.ChaosInjector(
+        chaoslib.ChaosConfig(straggler_delay_ms=30, chaos_host=0)
+    )
+    inj._process_index = 0
+    hook = inj.straggler_hook()
+    assert hook.wants_step(1) and hook.wants_step(2)
+    t0 = time.perf_counter()
+    hook.after_step(None, {}, 1)
+    assert time.perf_counter() - t0 >= 0.025
+    assert inj._straggler_fired
+
+    bystander = chaoslib.ChaosInjector(
+        chaoslib.ChaosConfig(straggler_delay_ms=500, chaos_host=3)
+    )
+    bystander._process_index = 0
+    t0 = time.perf_counter()
+    bystander.straggler_hook().after_step(None, {}, 1)
+    assert time.perf_counter() - t0 < 0.2
+    # Non-target hosts do not audit a peer's local-state fault.
+    assert bystander.unfired() == []
+
+
+def test_chaos_export_unfired_gauge():
+    inj = chaoslib.ChaosInjector(
+        chaoslib.ChaosConfig(nan_at_step=10_000, hide_newest_ckpt=1,
+                             chaos_host=0)
+    )
+    inj._process_index = 0
+    registry = telemetry.MetricsRegistry()
+    inj.export_unfired(registry)
+    snap = registry.snapshot()
+    assert snap[telemetry.CHAOS_ARMED_UNFIRED] == 2.0
+    inj._nan_fired = True
+    inj._hide_fired = True
+    inj.export_unfired(registry)
+    assert registry.snapshot()[telemetry.CHAOS_ARMED_UNFIRED] == 0.0
+
+
+# --- fsck: per-process sidecar completeness ------------------------------
+
+
+def _fake_step(ckpt_dir, step, sidecar_pids=(), nproc=2):
+    step_dir = os.path.join(ckpt_dir, str(step))
+    os.makedirs(os.path.join(step_dir, "state"), exist_ok=True)
+    for name in ("_CHECKPOINT_METADATA",):
+        open(os.path.join(step_dir, name), "w").close()
+    for name in ("_METADATA", "manifest.ocdbt"):
+        open(os.path.join(step_dir, "state", name), "w").close()
+    base = os.path.join(ckpt_dir, "dataset_states", str(step))
+    if sidecar_pids:
+        os.makedirs(base, exist_ok=True)
+        for pid in sidecar_pids:
+            with open(os.path.join(base, f"p{pid}.json"), "w") as f:
+                json.dump({"nproc": nproc, "state": {"pos": step}}, f)
+
+
+def test_fsck_flags_missing_peer_sidecars(tmp_path):
+    ckpt = str(tmp_path)
+    _fake_step(ckpt, 1, sidecar_pids=(0, 1))
+    _fake_step(ckpt, 2, sidecar_pids=(0,))
+    assert fscklib.fleet_sidecars_complete(ckpt, 1, 2)
+    assert not fscklib.fleet_sidecars_complete(ckpt, 2, 2)
+    issues = fscklib.sidecar_issues(ckpt, 2, process_count=2)
+    assert any("not fleet-valid" in i for i in issues)
+    assert fscklib.sidecar_issues(ckpt, 1, process_count=2) == []
+
+    report = fscklib.fsck_checkpoints(ckpt, process_count=2)
+    by_step = {e["step"]: e for e in report["steps"]}
+    assert by_step[1]["fleet_valid"] and by_step[1]["sidecar_procs"] == [0, 1]
+    assert not by_step[2]["fleet_valid"]
+    assert by_step[2]["sidecar_procs"] == [0]
+    assert report["newest_valid_step"] == 2
+    assert report["newest_fleet_valid_step"] == 1
+
+
+def test_fsck_script_reports_fleet_validity(tmp_path, capsys):
+    ckpt = str(tmp_path / "checkpoints")
+    _fake_step(ckpt, 1, sidecar_pids=(0, 1))
+    _fake_step(ckpt, 2, sidecar_pids=(1,))
+    fsck_script = _load_script("fsck_checkpoints")
+
+    rc = fsck_script.main([str(tmp_path), "--process-count", "2", "--json"])
+    out = capsys.readouterr().out
+    report = json.loads(out)
+    assert report["newest_fleet_valid_step"] == 1
+    assert {e["step"]: e["fleet_valid"] for e in report["steps"]} == {
+        1: True, 2: False,
+    }
+    assert rc == 0  # newest step is structurally valid
+
+    rc = fsck_script.main([str(tmp_path), "--process-count", "2"])
+    out = capsys.readouterr().out
+    assert "NOT FLEET-VALID" in out
+    assert "multi-host restore would PREFER step 1" in out
+
+
+def test_fsck_unchanged_without_process_count(tmp_path):
+    """Single-process sweeps keep their PR-4 shape: no sidecar dir is
+    not an issue, and fleet validity degenerates to structural."""
+    ckpt = str(tmp_path)
+    _fake_step(ckpt, 1)
+    assert fscklib.sidecar_issues(ckpt, 1) == []
+    report = fscklib.fsck_checkpoints(ckpt)
+    assert report["steps"][0]["fleet_valid"]
+    assert report["newest_fleet_valid_step"] == 1
+
+
+# --- metrics schema: fleet/* + chaos/* -----------------------------------
+
+
+def _row(**extra):
+    row = {"step": 2, "time": 123.0}
+    row.update(extra)
+    return json.dumps(row)
+
+
+def test_schema_accepts_full_fleet_key_set():
+    schema = _load_script("check_metrics_schema")
+    line = _row(
+        **{
+            "fleet/peers_alive": 2,
+            "fleet/step_lag": 0,
+            "fleet/heartbeat_age_s": 0.5,
+            "chaos/armed_unfired": 0,
+        }
+    )
+    errors, rows, _ = schema.check_lines([line])
+    assert errors == [] and rows == 1
+
+
+def test_schema_rejects_partial_or_negative_fleet_keys():
+    schema = _load_script("check_metrics_schema")
+    errors, _, _ = schema.check_lines([_row(**{"fleet/peers_alive": 2})])
+    assert any("partial fleet key set" in e for e in errors)
+    errors, _, _ = schema.check_lines(
+        [
+            _row(
+                **{
+                    "fleet/peers_alive": -1,
+                    "fleet/step_lag": 0,
+                    "fleet/heartbeat_age_s": 0.0,
+                }
+            )
+        ]
+    )
+    assert any("is negative" in e for e in errors)
+    errors, _, _ = schema.check_lines([_row(**{"chaos/armed_unfired": -2})])
+    assert any("chaos key" in e for e in errors)

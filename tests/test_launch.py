@@ -50,6 +50,7 @@ WORKER = textwrap.dedent(
 )
 
 
+@pytest.mark.two_proc
 def test_two_process_localhost_cluster_psum(tmp_path):
     marker = str(tmp_path / "psum_ok")
     script = tmp_path / "worker.py"
@@ -164,6 +165,7 @@ FIT_WORKER = textwrap.dedent(
 )
 
 
+@pytest.mark.two_proc
 def test_two_process_fit_matches_single_process(tmp_path):
     """A real 2-process ``fit`` on disjoint per-process data shards must
     reproduce the single-process trajectory at the same global batch —
@@ -237,7 +239,7 @@ TFRECORD_FIT_WORKER = textwrap.dedent(
         model="resnet32_cifar",
         dataset="imagenet",
         image_size=32,
-        global_batch_size=8,
+        global_batch_size=4,
         optimizer=OptimizerConfig(name="sgd", learning_rate=0.01),
         train_steps=2,
         log_every_steps=1,
@@ -256,11 +258,19 @@ TFRECORD_FIT_WORKER = textwrap.dedent(
 )
 
 
+@pytest.mark.two_proc
 def test_two_process_fit_on_file_sharded_tfrecords(tmp_path):
     """End-to-end multi-host ingestion on the reference's flagship input
     path: each process consumes its own TFRecord shard files (SURVEY.md
     §3.4 per-worker readers) and a 2-process ``fit`` trains on the
-    assembled global batch."""
+    assembled global batch.
+
+    Sized for the 1-core CI box (ISSUE 5 deflake): 4 records per shard
+    at batch 4 — the run's cost is process startup + one compile, so the
+    data volume adds nothing but decode time — plus the ``two_proc``
+    lock (conftest) so concurrent suites queue instead of thrashing, and
+    a timeout with headroom over the healthy-but-loaded case instead of
+    one the test is expected to brush against."""
     import numpy as np
 
     from distributed_tensorflow_models_tpu.data import (
@@ -275,13 +285,13 @@ def test_two_process_fit_on_file_sharded_tfrecords(tmp_path):
     rs = np.random.RandomState(0)
     for s in range(2):
         recs = []
-        for i in range(8):
+        for i in range(4):
             img = (rs.rand(40, 40, 3) * 255).astype(np.uint8)
             recs.append(
                 example_proto.build_example(
                     {
                         "image/encoded": [augment.encode_jpeg(img)],
-                        "image/class/label": [1 + (s * 8 + i) % 10],
+                        "image/class/label": [1 + (s * 4 + i) % 10],
                     }
                 )
             )
@@ -305,7 +315,7 @@ def test_two_process_fit_on_file_sharded_tfrecords(tmp_path):
         [sys.executable, str(script)],
         port=9767,
         cpu_devices_per_process=2,
-        timeout=300,
+        timeout=600,
     )
     assert codes == [0, 0]
     import json
