@@ -355,11 +355,20 @@ class InstrumentedStep:
         self,
         step_fn: Callable,
         registry: Optional[telemetry.MetricsRegistry] = None,
+        aot: Optional[object] = None,
     ):
         self._fn = step_fn
         self._registry = (
             registry if registry is not None else telemetry.get_registry()
         )
+        # Optional ahead-of-time handle (harness/startup.py::AotTrainStep):
+        # when its batch signature matches a call's, the pre-compiled
+        # executable runs instead of the jit dispatch.  The FIRST AOT use
+        # is accounted as the run's compile event (one train/compile
+        # record covering the join-on-in-flight-compile remainder plus
+        # that dispatch) so compile/dispatch counts stay exactly what the
+        # jit path produces — per-signature: one compile, then dispatches.
+        self._aot = aot
         self._flops_by_sig: dict = {}
         self.flops_per_step: Optional[float] = None
 
@@ -394,6 +403,46 @@ class InstrumentedStep:
             self._registry.gauge(telemetry.FLOPS_PER_STEP).set(flops)
         return flops
 
+    def _call_timed(self, sig, state, batch, rng):
+        """Run the step via the AOT executable (signature match) or the
+        jit fn, timed into exactly one compile-or-dispatch record.  The
+        compile classification covers both triggers: a jit cache growth,
+        or the first use of the AOT program (whose record includes any
+        blocking on the still-in-flight background compile)."""
+        before = self._cache_size()
+        t0 = time.perf_counter()
+        fn, used_aot, aot_first = self._fn, False, False
+        if self._aot is not None:
+            exe, aot_first = self._aot.acquire(sig)
+            if exe is not None:
+                fn, used_aot = exe, True
+        try:
+            out = fn(state, batch, rng)
+        except TypeError:
+            if not used_aot:
+                raise
+            # An AOT executable is stricter than jit: it REJECTS inputs
+            # whose avals/shardings drifted with a TypeError raised
+            # BEFORE executing, so no buffers were consumed and the jit
+            # retry is safe even under donation.  Deliberately narrow —
+            # a mid-execution runtime failure may already have
+            # invalidated donated inputs, and retrying would mask the
+            # real error with "Array has been deleted"; those propagate.
+            log.warning(
+                "AOT train-step executable rejected the call; falling "
+                "back to the jit path", exc_info=True,
+            )
+            self._aot.disable()
+            out = self._fn(state, batch, rng)
+        dt = time.perf_counter() - t0
+        compiled = aot_first or (
+            before is not None and self._cache_size() != before
+        )
+        self._registry.timer(
+            telemetry.COMPILE if compiled else telemetry.DISPATCH
+        ).record(dt)
+        return out
+
     def __call__(self, state, batch, rng):
         reg = self._registry
         sig = self._signature(batch)
@@ -410,16 +459,7 @@ class InstrumentedStep:
             flops = self._flops_by_sig[sig] = self._record_flops(
                 state, batch, rng
             )
-        before = self._cache_size()
-        t0 = time.perf_counter()
-        out = self._fn(state, batch, rng)
-        dt = time.perf_counter() - t0
-        compiled = (
-            before is not None and self._cache_size() != before
-        )
-        reg.timer(
-            telemetry.COMPILE if compiled else telemetry.DISPATCH
-        ).record(dt)
+        out = self._call_timed(sig, state, batch, rng)
         if flops:
             reg.counter(telemetry.FLOPS_TOTAL).inc(flops)
         return out
@@ -455,8 +495,9 @@ class InstrumentedMultiStep(InstrumentedStep):
         multi_fn: Callable,
         flops_step_fn: Optional[Callable] = None,
         registry: Optional[telemetry.MetricsRegistry] = None,
+        aot: Optional[object] = None,
     ):
-        super().__init__(multi_fn, registry)
+        super().__init__(multi_fn, registry, aot=aot)
         self._flops_fn = (
             jax.jit(flops_step_fn) if flops_step_fn is not None else None
         )
@@ -504,14 +545,7 @@ class InstrumentedMultiStep(InstrumentedStep):
             flops = self._flops_by_sig[sig] = self._record_flops(
                 state, batches, rng
             )
-        before = self._cache_size()
-        t0 = time.perf_counter()
-        out = self._fn(state, batches, rng)
-        dt = time.perf_counter() - t0
-        compiled = before is not None and self._cache_size() != before
-        reg.timer(
-            telemetry.COMPILE if compiled else telemetry.DISPATCH
-        ).record(dt)
+        out = self._call_timed(sig, state, batches, rng)
         if flops:
             reg.counter(telemetry.FLOPS_TOTAL).inc(flops * k)
         return out

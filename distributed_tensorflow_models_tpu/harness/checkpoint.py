@@ -130,6 +130,13 @@ class CheckpointManager:
         # every *listing* this manager reasons from — never to reads,
         # which is the real shape of object-store metadata lag.
         self._step_filter = step_filter
+        # Pre-create the fence timer: it records only when a save
+        # actually blocked on a previous in-flight save, so without this
+        # a run whose cadence never outran the background writer would
+        # have NO checkpoint/fence entry in telemetry.json — and "zero
+        # fences" (the healthy reading) would be indistinguishable from
+        # "fence not instrumented".
+        self._registry.timer(telemetry.CKPT_FENCE)
 
     @property
     def consensus(self) -> conslib.Consensus:
@@ -233,9 +240,17 @@ class CheckpointManager:
                 "collective save", step,
             )
             self.delete(step)
-        # The span covers the *blocking* portion only — orbax finishes the
-        # write async; the remainder lands in checkpoint/wait when
-        # wait()/close() blocks on durability.  Goodput sums both.
+        # Overlapped-save structure: orbax would otherwise block INSIDE
+        # _mgr.save until the previous async save is durable, charging
+        # that durability wait to the save span on the step path.  Fence
+        # first (its own metric, skipped when nothing is pending) so
+        # CKPT_SAVE times only the irreducible blocking portion — the
+        # device→host snapshot + orbax dispatch — and a tightened
+        # checkpoint_every_steps shows its true cost as checkpoint/fence
+        # time rather than mysteriously fat saves.  The write itself
+        # still finishes in the background; wait()/close() (teardown,
+        # emergency, rollback) remain the explicit durability points.
+        self.fence()
         with self._registry.span(telemetry.CKPT_SAVE):
             saved = self._mgr.save(
                 step,
@@ -550,8 +565,33 @@ class CheckpointManager:
                 )
         return state, data
 
+    def is_saving(self) -> bool:
+        """True while a previously dispatched async save is still being
+        written in the background."""
+        try:
+            return bool(self._mgr.is_saving_in_progress())
+        except Exception:  # noqa: BLE001 — orbax API drift: assume pending
+            return True
+
+    def fence(self) -> None:
+        """Durability fence for the *overlap* path: block until pending
+        async saves finish, recorded under ``checkpoint/fence``.  No-op
+        (and no metric record) when nothing is in flight, so the timer's
+        count is the number of times the save cadence actually outran
+        the background writer and its total is the wall time that
+        overrun cost — the exact number the ``checkpoint_every_steps``
+        tightening trade is priced on.  Teardown/emergency paths use
+        :meth:`wait` instead (always recorded: their block is the point).
+        """
+        if not self.is_saving():
+            return
+        with self._registry.span(telemetry.CKPT_FENCE):
+            self._mgr.wait_until_finished()
+
     def wait(self) -> None:
-        """Block until pending async saves are durable."""
+        """Block until pending async saves are durable (the explicit
+        fence of the emergency-save / rollback / chaos-tear / teardown
+        paths — always recorded, under ``checkpoint/wait``)."""
         with self._registry.span(telemetry.CKPT_WAIT):
             self._mgr.wait_until_finished()
 

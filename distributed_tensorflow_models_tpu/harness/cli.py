@@ -120,6 +120,21 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "active alongside)",
     )
     p.add_argument(
+        "--xla-cache-dir", type=str, default=None,
+        help="persistent XLA compilation cache dir for relaunch-to-"
+        "first-step MTTR (default <workdir>/xla_cache unless the "
+        "process already configured one; '' disables) — README "
+        "'Performance'",
+    )
+    p.add_argument(
+        "--aot-compile", action=argparse.BooleanOptionalAction,
+        default=None,
+        help="AOT-compile the train step concurrently with the "
+        "checkpoint restore (default on; bit-identical to the jit "
+        "path).  --no-aot-compile reverts to lazy first-step "
+        "compilation",
+    )
+    p.add_argument(
         "--preempt-poll-steps", type=int, default=None,
         help="multi-host preemption-notice poll cadence in steps (the "
         "poll is a collective; default 20).  Keep poll_steps x step_time "
@@ -162,6 +177,10 @@ def _overrides(args) -> dict:
         out["watchdog_abort"] = args.watchdog_abort
     if getattr(args, "checkpoint_every_steps", None) is not None:
         out["checkpoint_every_steps"] = args.checkpoint_every_steps
+    if getattr(args, "xla_cache_dir", None) is not None:
+        out["xla_cache_dir"] = args.xla_cache_dir
+    if getattr(args, "aot_compile", None) is not None:
+        out["aot_compile"] = args.aot_compile
     if getattr(args, "preempt_poll_steps", None) is not None:
         out["preempt_poll_steps"] = args.preempt_poll_steps
     if getattr(args, "chaos", None) is not None:

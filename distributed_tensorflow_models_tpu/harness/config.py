@@ -21,6 +21,12 @@ import optax
 
 from distributed_tensorflow_models_tpu.ops import optim
 
+# Default multi-host preemption-notice poll cadence in steps — THE one
+# definition: harness/train.py's loop fallback and harness/startup.py's
+# dominant-chunk-length mirror must agree, or multi-host AOT compiles
+# would target a chunk length the loop never produces.
+PREEMPT_POLL_STEPS_DEFAULT = 20
+
 
 @dataclasses.dataclass(frozen=True)
 class OptimizerConfig:
@@ -140,6 +146,18 @@ class ExperimentConfig:
     # can be active at once (a save fires when either is due).
     checkpoint_every_steps: Optional[int] = None
     keep_checkpoints: int = 5
+    # Restart-MTTR knobs (harness/startup.py; README "Performance").
+    # xla_cache_dir: persistent XLA compilation cache for the production
+    # path — a supervisor relaunch deserializes the train-step program
+    # instead of recompiling it.  None = default to <workdir>/xla_cache
+    # unless the process already configured a cache (that setting wins);
+    # "" disables.  aot_compile: lower().compile() the train-step
+    # program on a background thread *while the checkpoint restore
+    # runs*, so a relaunch overlaps its two dominant serial costs; the
+    # executable is bit-identical to the jit path's and a batch-spec
+    # mismatch falls back to jit with only a wasted background compile.
+    xla_cache_dir: Optional[str] = None
+    aot_compile: bool = True
     # Divergence policy (harness/train.py::fit).  "abort" = the reference
     # NanTensorHook behavior: a non-finite loss kills the run.  "rollback"
     # = restore the last finite checkpoint, advance the dataset cursor
@@ -163,7 +181,7 @@ class ExperimentConfig:
     # lands before the flag is ever observed — lower it for slow-step
     # runs.  Single-process runs check the flag at every chunk boundary
     # and ignore this.
-    preempt_poll_steps: int = 20
+    preempt_poll_steps: int = PREEMPT_POLL_STEPS_DEFAULT
     # Deterministic chaos injection (resilience/chaos.py) — OFF when
     # empty.  Keys: pipeline_fail_at_batch, nan_at_step,
     # torn_checkpoint_at_step, sigterm_at_step (ints; each fires at most

@@ -340,7 +340,14 @@ class TelemetryHook(Hook):
     - ``mfu``            — FLOPs retired / (interval wall × peak);
       0.0 when the device has no known peak (CPU) or FLOPs are unknown
     - ``compile_count`` / ``compile_s`` — cumulative compile events
-    - ``checkpoint_s``   — cumulative blocking checkpoint time
+    - ``checkpoint_s``   — cumulative blocking checkpoint time (save +
+      restore + wait + the overlapped-save durability fence)
+    - ``checkpoint/fence_s`` — the fence share alone: wall time saves
+      spent blocked on a PREVIOUS async save, i.e. how much tightening
+      ``checkpoint_every_steps`` actually costs
+    - ``startup/restore_s`` / ``startup/aot_compile_s`` /
+      ``startup/time_to_first_step_s`` — the restart-MTTR gauges
+      (always the three together — the schema lint checks the set)
     - ``host_queue_depth`` — producer buffer depth right now
     - ``restarts`` / ``rollbacks`` / ``skipped_batches`` — resilience
       counters (recoverable_fit restarts; nan_policy=rollback rewinds
@@ -427,6 +434,17 @@ class TelemetryHook(Hook):
                 snap.get(f"{telemetry.CKPT_SAVE}/total_s", 0.0)
                 + snap.get(f"{telemetry.CKPT_RESTORE}/total_s", 0.0)
                 + snap.get(f"{telemetry.CKPT_WAIT}/total_s", 0.0)
+                + snap.get(f"{telemetry.CKPT_FENCE}/total_s", 0.0)
+            ),
+            "checkpoint/fence_s": snap.get(
+                f"{telemetry.CKPT_FENCE}/total_s", 0.0
+            ),
+            "startup/restore_s": snap.get(telemetry.STARTUP_RESTORE, 0.0),
+            "startup/aot_compile_s": snap.get(
+                telemetry.STARTUP_AOT_COMPILE, 0.0
+            ),
+            "startup/time_to_first_step_s": snap.get(
+                telemetry.STARTUP_FIRST_STEP, 0.0
             ),
             "host_queue_depth": snap.get(telemetry.HOST_QUEUE_DEPTH, 0.0),
             # Resilience counters (always the three together — the schema

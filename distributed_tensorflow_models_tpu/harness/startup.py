@@ -1,0 +1,372 @@
+"""Cold-start acceleration: persistent compile cache + overlapped AOT.
+
+A supervisor relaunch (``launch.supervise_local``) pays two dominant
+serial costs before the first training step: the checkpoint restore and
+the first XLA compile of the train-step program.  Both are attackable
+without touching training semantics:
+
+- **Persistent compilation cache** (:func:`apply_compile_cache`): the
+  jax on-disk cache the test suite has used since PR 4
+  (``tests/conftest.py``) wired into the *production* path — a relaunch
+  of the same config deserializes the train-step program instead of
+  recompiling it.  ``ExperimentConfig.xla_cache_dir`` controls it:
+  ``None`` defaults to ``<workdir>/xla_cache`` (unless the process
+  already configured a cache — an explicit operator/test setting wins),
+  an explicit path is used as-is, and ``""`` disables.
+- **AOT compile overlapped with restore** (:class:`AotTrainStep`): the
+  train-step program is ``.lower().compile()``'d on a background thread
+  *while the main thread restores the checkpoint*, against input specs
+  derived from the config (:func:`abstract_batch` — the exact global
+  shapes/shardings ``DevicePrefetcher``/``BatchStacker`` will produce).
+  The compiled executable is bit-identical to what the jit path would
+  build (same program, same compiler — pinned in
+  ``tests/test_startup.py``), and the instrumented step uses it only
+  when the live batch signature matches, falling back to the ordinary
+  jit call otherwise — a wrong guess costs a wasted background compile,
+  never a wrong program.
+
+Telemetry: the thread stamps ``startup/aot_compile_s`` (full compile
+duration — mostly hidden behind the restore); only the *non-overlapped
+remainder* the first step actually blocked on lands in the
+``train/compile`` timer (the first AOT use is accounted as the run's
+compile event, mirroring how a persistent-cache hit still records a
+compile event today).  ``fit`` stamps ``startup/restore_s`` and
+``startup/time_to_first_step_s`` around this module; the goodput report
+surfaces all three as its ``startup`` section and ``launch.py`` reads
+the fleet-side equivalent off the heartbeat files.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from distributed_tensorflow_models_tpu import telemetry
+
+log = logging.getLogger("dtm")
+
+PyTree = Any
+
+
+# --------------------------------------------------------------------------
+# Persistent compilation cache
+# --------------------------------------------------------------------------
+
+# Same thresholds the test conftest uses: cache programs costing >= 0.5 s
+# to compile, and let XLA cache its internal artifacts too.
+_MIN_COMPILE_TIME_S = 0.5
+
+
+def configured_cache_dir() -> Optional[str]:
+    """The process's currently configured jax compilation cache dir (or
+    None)."""
+    try:
+        import jax
+
+        return getattr(jax.config, "jax_compilation_cache_dir", None)
+    except Exception:  # noqa: BLE001 — config introspection must not raise
+        return None
+
+
+def apply_compile_cache(
+    xla_cache_dir: Optional[str], workdir: str
+) -> Optional[str]:
+    """Resolve and apply the production compile-cache knob; returns the
+    active cache dir (None = disabled).
+
+    Resolution: an explicit non-empty ``xla_cache_dir`` is applied
+    as-is; ``""`` disables the cache (even one configured earlier in the
+    process); ``None`` defaults to ``<workdir>/xla_cache`` — *unless*
+    the process already configured a cache dir (test conftest, operator
+    sitecustomize), which then stays in force: an explicit setting must
+    not be silently redirected at every ``fit``, and the test suite's
+    shared cache is exactly what keeps its many tiny fits fast.
+
+    Must run before the first trace of the run (``fit`` calls it before
+    ``build_state``, whose ``model.init`` is the first compile).
+    Best-effort: cache-config knob names drift across jax versions, and
+    the cache is an optimization — never the thing that kills training.
+    """
+    import jax
+
+    if xla_cache_dir == "":
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:  # noqa: BLE001
+            log.debug("could not disable the compilation cache", exc_info=True)
+        else:
+            log.info("persistent XLA compilation cache disabled")
+        return None
+    if xla_cache_dir is None:
+        existing = configured_cache_dir()
+        if existing:
+            log.debug(
+                "persistent XLA compilation cache already configured at %s; "
+                "keeping it", existing,
+            )
+            return existing
+        xla_cache_dir = os.path.join(os.path.abspath(workdir), "xla_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", xla_cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", _MIN_COMPILE_TIME_S
+        )
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:  # noqa: BLE001 — knob names drift across jax versions
+        log.warning(
+            "could not enable the persistent XLA compilation cache at %s",
+            xla_cache_dir, exc_info=True,
+        )
+        return None
+    log.info("persistent XLA compilation cache at %s", xla_cache_dir)
+    return xla_cache_dir
+
+
+def cache_entry_count(cache_dir: Optional[str]) -> int:
+    """Number of files under the cache dir (0 when unset/missing) — the
+    before/after delta is the cache-hit signal for the first compile."""
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return 0
+    total = 0
+    for _, _, files in os.walk(cache_dir):
+        total += len(files)
+    return total
+
+
+# --------------------------------------------------------------------------
+# Config-derived input specs (must mirror the live pipeline exactly)
+# --------------------------------------------------------------------------
+
+
+def _leaf_spec(mesh, shape, dtype, seq_dim):
+    """ShapeDtypeStruct with the sharding ``sharding.shard_batch`` gives
+    this leaf (leading data axis; ``seq`` on ``seq_dim`` when divisible)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_models_tpu.core import sharding as shardlib
+    from distributed_tensorflow_models_tpu.core.mesh import AxisNames
+
+    n_seq = mesh.shape[AxisNames.SEQ]
+    if (
+        seq_dim is not None
+        and n_seq > 1
+        and len(shape) > seq_dim
+        and shape[seq_dim] % n_seq == 0
+    ):
+        axes = [AxisNames.DATA] + [None] * (len(shape) - 1)
+        axes[seq_dim] = AxisNames.SEQ
+        sharding = NamedSharding(mesh, P(*axes))
+    else:
+        sharding = shardlib.batch_sharding(mesh, len(shape))
+    return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+
+def abstract_batch(cfg, mesh, seq_dim=None) -> Optional[PyTree]:
+    """Abstract (shape/dtype/sharding) pytree matching the batches
+    ``DevicePrefetcher`` will hand the train step for ``cfg``, or None
+    when the dataset's batch structure is unknown (AOT then stays off —
+    the jit path is always correct).  Shapes are the *global* batch: the
+    prefetcher assembles per-process slices into one global array."""
+    import jax.numpy as jnp
+
+    b = cfg.global_batch_size
+    if cfg.task == "lm":
+        if cfg.dataset != "ptb":
+            return None
+        shape = (b, cfg.num_steps)
+        return {
+            "inputs": _leaf_spec(mesh, shape, jnp.int32, seq_dim),
+            "targets": _leaf_spec(mesh, shape, jnp.int32, seq_dim),
+        }
+    if cfg.dataset not in (
+        "mnist", "cifar10", "imagenet", "imagenet_synthetic"
+    ):
+        return None
+    size = cfg.image_size
+    channels = 3 if size > 28 else 1
+    return {
+        "image": _leaf_spec(
+            mesh, (b, size, size, channels), jnp.float32, seq_dim
+        ),
+        "label": _leaf_spec(mesh, (b,), jnp.int32, seq_dim),
+    }
+
+
+def stacked_batch(batch: PyTree, k: int) -> PyTree:
+    """The K-stacked chunk spec for the fused multi-step program: leading
+    length-``k`` axis, replicated across it (``P(None, <row spec>)``) —
+    the exact layout ``BatchStacker`` emits."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def one(leaf):
+        sharding = NamedSharding(
+            leaf.sharding.mesh, P(None, *tuple(leaf.sharding.spec))
+        )
+        return jax.ShapeDtypeStruct(
+            (k, *leaf.shape), leaf.dtype, sharding=sharding
+        )
+
+    return jax.tree.map(one, batch)
+
+
+def dominant_chunk_len(cfg, nproc: int = 1) -> int:
+    """The chunk length most ``fit`` chunks will have under ``cfg`` —
+    what the AOT compiler targets.  Mirrors ``train._chunk_len``'s
+    config-deterministic shrink triggers (log cadence, train_steps, the
+    step-cadence checkpoint, the multi-host preemption poll); clock-due
+    and user-hook boundaries can still produce other lengths, which
+    simply compile lazily on the jit path as today."""
+    k = max(1, min(int(cfg.steps_per_loop), int(cfg.train_steps)))
+    if cfg.log_every_steps and cfg.log_every_steps > 0:
+        k = min(k, int(cfg.log_every_steps))
+    if cfg.checkpoint_every_steps:
+        k = min(k, int(cfg.checkpoint_every_steps))
+    if nproc > 1:
+        from distributed_tensorflow_models_tpu.harness.config import (
+            PREEMPT_POLL_STEPS_DEFAULT,
+        )
+
+        k = min(
+            k,
+            max(1, int(cfg.preempt_poll_steps or PREEMPT_POLL_STEPS_DEFAULT)),
+        )
+    return max(1, k)
+
+
+# --------------------------------------------------------------------------
+# Background AOT compile
+# --------------------------------------------------------------------------
+
+
+class AotTrainStep:
+    """Ahead-of-time compile of one train-step program on a daemon
+    thread, started while the caller restores a checkpoint.
+
+    ``jit_fn`` is the very jit callable ``fit`` will drive (so the
+    program is identical by construction); ``example_args`` the
+    ``(state, batch, rng)`` it will be called with — a concrete template
+    state (avals only are used; the restored state is re-placed to the
+    same layout) plus the abstract batch spec.  ``acquire(sig)`` hands
+    the executable to the instrumented step when the live batch
+    signature matches the spec'd one, blocking on the thread if the
+    compile is still in flight — that blocked remainder is the only
+    cold-start cost the overlap failed to hide, and the caller accounts
+    it (plus the first dispatch) as the run's compile event.
+
+    Any failure (spec mismatch at trace time, an AOT-unsupported
+    backend) disables the handle with one warning; training proceeds on
+    the jit path unchanged.
+    """
+
+    def __init__(
+        self,
+        jit_fn,
+        example_args: tuple,
+        *,
+        registry: Optional[telemetry.MetricsRegistry] = None,
+        cache_dir: Optional[str] = None,
+        label: str = "train-step",
+    ):
+        self._fn = jit_fn
+        self._args = example_args
+        self._registry = (
+            registry if registry is not None else telemetry.get_registry()
+        )
+        self._cache_dir = cache_dir
+        self._label = label
+        self._sig = self.signature(example_args[1])
+        self._exe = None
+        self._error: Optional[BaseException] = None
+        self._disabled = False
+        self._used = False
+        self._thread = threading.Thread(
+            target=self._compile, name="aot-compile", daemon=True
+        )
+
+    @staticmethod
+    def signature(batch) -> tuple:
+        """Leaf (shape, dtype) signature — the same format
+        ``InstrumentedStep._signature`` computes for live batches."""
+        import jax
+
+        return tuple(
+            (tuple(leaf.shape), str(leaf.dtype))
+            for leaf in jax.tree_util.tree_leaves(batch)
+        )
+
+    def start(self) -> "AotTrainStep":
+        self._thread.start()
+        return self
+
+    def _compile(self) -> None:
+        t0 = time.perf_counter()
+        entries_before = cache_entry_count(self._cache_dir)
+        try:
+            self._exe = self._fn.lower(*self._args).compile()
+        except BaseException as e:  # noqa: BLE001 — surfaced at acquire()
+            self._error = e
+            return
+        finally:
+            dt = time.perf_counter() - t0
+            # Full background duration; the goodput report shows it
+            # beside (not inside) the exclusive wall split — only the
+            # acquire() remainder is wall the main thread lost.
+            self._registry.gauge(telemetry.STARTUP_AOT_COMPILE).set(dt)
+        new_entries = cache_entry_count(self._cache_dir) - entries_before
+        if self._cache_dir is None:
+            cache_note = "persistent cache off"
+        elif new_entries > 0:
+            cache_note = f"persistent cache MISS ({new_entries} new entries)"
+        else:
+            # No new entries: a hit — or a program under the cache's
+            # min-compile-time floor, which costs the same either way.
+            cache_note = "persistent cache hit (no new entries)"
+        log.info(
+            "AOT %s compile finished in %.2fs (%s)", self._label, dt,
+            cache_note,
+        )
+
+    def acquire(self, sig: tuple):
+        """``(executable, first_use)`` when ``sig`` matches the compiled
+        program (blocking on an in-flight compile), else ``(None,
+        False)``."""
+        if self._disabled or sig != self._sig:
+            return None, False
+        if self._thread.is_alive():
+            self._thread.join()
+        if self._error is not None:
+            log.warning(
+                "AOT %s compile failed (%s); falling back to the jit path",
+                self._label, self._error,
+            )
+            self._disabled = True
+            self._error = None
+            return None, False
+        if self._exe is None:  # thread never ran (start() skipped)
+            self._disabled = True
+            return None, False
+        first, self._used = (not self._used), True
+        return self._exe, first
+
+    def disable(self) -> None:
+        """Stop offering the executable (the instrumented step calls this
+        after a failed AOT dispatch so every later call goes via jit)."""
+        self._disabled = True
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for the background thread (teardown hygiene: an XLA
+        compile cannot be cancelled, so an aborted fit must reap the
+        thread rather than leak it into the caller)."""
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                log.warning(
+                    "AOT %s compile still running after %.0fs teardown "
+                    "join; leaving the daemon thread to finish",
+                    self._label, timeout or 0.0,
+                )

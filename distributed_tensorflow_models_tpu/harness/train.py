@@ -35,7 +35,11 @@ from distributed_tensorflow_models_tpu.data import datasets as datalib
 from distributed_tensorflow_models_tpu.data import pipeline as pipelib
 from distributed_tensorflow_models_tpu.harness import checkpoint as ckptlib
 from distributed_tensorflow_models_tpu.harness import hooks as hooklib
-from distributed_tensorflow_models_tpu.harness.config import ExperimentConfig
+from distributed_tensorflow_models_tpu.harness import startup as startuplib
+from distributed_tensorflow_models_tpu.harness.config import (
+    PREEMPT_POLL_STEPS_DEFAULT,
+    ExperimentConfig,
+)
 from distributed_tensorflow_models_tpu.models import get_model
 
 log = logging.getLogger("dtm")
@@ -327,8 +331,10 @@ def _chunk_len(
 # CheckpointHook's clock-broadcast poll.  Single-process runs read the
 # flag directly at every chunk boundary.  Lower it (via the config) when
 # poll_steps x step_time would overrun the fleet's preemption grace
-# window.
-PREEMPT_POLL_STEPS = 20
+# window.  (The value itself lives in config.py — THE one definition —
+# so harness/startup.py's dominant-chunk mirror can never drift from
+# this loop's fallback; the historical name is kept for callers.)
+PREEMPT_POLL_STEPS = PREEMPT_POLL_STEPS_DEFAULT
 
 
 class _PreemptPollHook(hooklib.Hook):
@@ -431,6 +437,10 @@ def fit(
     t_run0 = time.perf_counter()
     registry = telemetry.MetricsRegistry()
     registry.counter(telemetry.RESTARTS).inc(restarts)
+    # Production compile cache, applied before build_state — whose
+    # model.init is this run's first trace (README "Performance";
+    # restart-MTTR: a relaunch deserializes instead of recompiling).
+    startuplib.apply_compile_cache(cfg.xla_cache_dir, workdir)
     chaos = resilience.get_injector(cfg.chaos, seed=cfg.seed, scope=workdir)
     if mesh is None:
         mesh = mesh_from_config(cfg)
@@ -448,7 +458,13 @@ def fit(
     # pick, restore-vs-init, any-host divergence below) goes through
     # this chief-decides broadcast; single-process it is an exact no-op.
     consensus = manager.consensus
-    state, data_state, restored = ckptlib.restore_or_init(manager, state)
+
+    seq_dim = (
+        1
+        if cfg.task == "lm" and mesh.shape[meshlib.AxisNames.SEQ] > 1
+        else None
+    )
+    steps_per_loop = max(1, int(cfg.steps_per_loop))
 
     from distributed_tensorflow_models_tpu.parallel import tensor as tensorlib
 
@@ -461,21 +477,50 @@ def fit(
             s, mesh, tensorlib.get_rules(cfg.param_rules)
         )
 
-    if restored:
-        state = _place(state)
+    raw_step = None
+    aot = None
+    try:
+        # The checkpoint manager is live from here (and the AOT thread
+        # shortly after): a step-build/restore/dataset failure must reap
+        # both rather than leak them into the caller (recoverable_fit
+        # may re-enter fit on the same workdir right away).
+        #
+        # The step program is built from the TEMPLATE state, before the
+        # restore (cheap closure work — no tracing; the loss depends
+        # only on apply_fn, which restore never changes), so the AOT
+        # compiler can lower the very jit callable the loop will drive
+        # *while* the restore reads the checkpoint — a relaunch overlaps
+        # its two dominant serial costs (README "Performance").
+        if steps_per_loop > 1:
+            step_jit, raw_step = build_multi_step(cfg, state)
+        else:
+            step_jit = build_step(cfg, state)
+        aot = _start_aot_compile(
+            cfg, state, mesh, seq_dim, steps_per_loop, step_jit, registry
+        )
 
-    dataset = build_dataset(cfg, "train")
-    if restored and data_state.get("dataset") and hasattr(dataset, "set_state"):
-        dataset.set_state(data_state["dataset"])
-    if chaos is not None:
-        dataset = chaos.wrap_dataset(dataset)
+        t_restore0 = time.perf_counter()
+        state, data_state, restored = ckptlib.restore_or_init(manager, state)
+        if restored:
+            state = _place(state)
+        # Startup restore wall (incl. the re-placement): one of the two
+        # restart-MTTR terms the goodput report's "startup" section
+        # carries.
+        registry.gauge(telemetry.STARTUP_RESTORE).set(
+            time.perf_counter() - t_restore0
+        )
 
-    seq_dim = (
-        1
-        if cfg.task == "lm" and mesh.shape[meshlib.AxisNames.SEQ] > 1
-        else None
-    )
-    steps_per_loop = max(1, int(cfg.steps_per_loop))
+        dataset = build_dataset(cfg, "train")
+        if restored and data_state.get("dataset") and hasattr(
+            dataset, "set_state"
+        ):
+            dataset.set_state(data_state["dataset"])
+        if chaos is not None:
+            dataset = chaos.wrap_dataset(dataset)
+    except BaseException:
+        _close_quietly(None, manager, aot)
+        raise
+
     host = device_it = stacker = data_src = None
 
     def _open_pipeline() -> None:
@@ -514,13 +559,12 @@ def fit(
         # thread blocked forever on its full buffer.
         _open_pipeline()
         if steps_per_loop > 1:
-            multi_fn, raw_step = build_multi_step(cfg, state)
             step_fn = train_loop.InstrumentedMultiStep(
-                multi_fn, raw_step, registry=registry
+                step_jit, raw_step, registry=registry, aot=aot
             )
         else:
             step_fn = train_loop.InstrumentedStep(
-                build_step(cfg, state), registry=registry
+                step_jit, registry=registry, aot=aot
             )
 
         def save_fn(s, _step, *, force: bool = False):
@@ -663,7 +707,7 @@ def fit(
     except BaseException:
         if own_listener:
             listener.uninstall()  # no-op if install never ran
-        _close_quietly(host, manager)
+        _close_quietly(host, manager, aot)
         raise
 
     watchdog = None
@@ -688,8 +732,13 @@ def fit(
             # rewind to.  Gated on ``not restored`` — not on
             # latest_step() — because the fresh-init fallback (torn
             # checkpoints present but nothing restorable) also needs the
-            # anchor.
+            # anchor.  Explicitly fenced: saves are overlapped
+            # (dispatch-only) on the step path, but the anchor must be
+            # DURABLE before training can diverge past it — an async
+            # anchor lost to a crash would leave the first cadence
+            # window with nothing to rewind to.
             save_fn(state, step, force=True)
+            manager.wait()
     except BaseException:
         if watchdog is not None:
             watchdog.stop()
@@ -698,7 +747,7 @@ def fit(
         # The pipeline threads and the checkpoint manager already exist at
         # this point — a setup failure must not leak them into the caller
         # (the producer would sit blocked on its full buffer forever).
-        _close_quietly(host, manager)
+        _close_quietly(host, manager, aot)
         raise
 
     # Sentinel for "no divergence seen here" in the any-host agreement
@@ -846,6 +895,11 @@ def fit(
                     step,
                 )
                 save_fn(state, step, force=True)
+                # Explicit durability fence: the process is about to
+                # exit on the preemption notice — the overlapped
+                # (dispatch-only) save contract does not cover "the
+                # supervisor may SIGKILL us the moment we return".
+                manager.wait()
                 preempted = True
                 break
             while pending_skips and pending_skips[0][0] <= step:
@@ -949,6 +1003,15 @@ def fit(
                 rollbacks_done += 1
                 registry.counter(telemetry.ROLLBACKS).inc()
                 continue
+            if steps_run and registry.gauge(
+                telemetry.STARTUP_FIRST_STEP
+            ).value == 0.0:
+                # Relaunch-to-first-step MTTR, the number the cold-start
+                # work (compile cache + AOT-overlapped restore) exists
+                # to shrink: fit entry → first completed chunk.
+                registry.gauge(telemetry.STARTUP_FIRST_STEP).set(
+                    time.perf_counter() - t_run0
+                )
             if watchdog is not None:
                 watchdog.beat(step)
             resilience.heartbeat.beat(step)
@@ -966,7 +1029,7 @@ def fit(
                 h.abort(state)
             except Exception:
                 log.exception("hook %r abort() failed during error cleanup", h)
-        _close_quietly(host, manager)
+        _close_quietly(host, manager, aot)
         # A goodput report from a crashed run is exactly what the
         # post-mortem wants (was it stalling before it died?).  The
         # armed-but-unfired chaos count rides along: a crash drill whose
@@ -990,7 +1053,7 @@ def fit(
                     if end_error is None:
                         end_error = e
         finally:
-            _close_quietly(host, manager)
+            _close_quietly(host, manager, aot)
         # After close: the report's checkpoint split includes the final
         # save's wait-until-durable time.  chaos/armed_unfired is set
         # first so the gauge lands in the report's registry snapshot.
@@ -1056,7 +1119,46 @@ def _write_telemetry_report(
         log.exception("failed to write telemetry.json")
 
 
-def _close_quietly(host, manager) -> None:
+def _start_aot_compile(
+    cfg, template, mesh, seq_dim, steps_per_loop, jit_fn, registry
+):
+    """Kick off the background AOT compile of the train-step program (the
+    restore that follows overlaps it).  Never raises — AOT is an
+    optimization; any setup failure logs and returns None, leaving the
+    jit path exactly as it was."""
+    if not cfg.aot_compile:
+        return None
+    try:
+        batch = startuplib.abstract_batch(cfg, mesh, seq_dim)
+        if batch is None:
+            log.info(
+                "aot_compile: batch structure unknown for dataset %r; "
+                "staying on the lazy jit path", cfg.dataset,
+            )
+            return None
+        label = "train-step"
+        if steps_per_loop > 1:
+            k = startuplib.dominant_chunk_len(cfg, jax.process_count())
+            batch = startuplib.stacked_batch(batch, k)
+            label = f"{k}-step chunk"
+        # The same rng fit's loop will pass — only its aval matters.
+        rng = jax.random.key(cfg.seed + 1)
+        return startuplib.AotTrainStep(
+            jit_fn,
+            (template, batch, rng),
+            registry=registry,
+            cache_dir=startuplib.configured_cache_dir(),
+            label=label,
+        ).start()
+    except Exception:  # noqa: BLE001 — never the thing that fails training
+        log.warning(
+            "aot_compile setup failed; continuing on the jit path",
+            exc_info=True,
+        )
+        return None
+
+
+def _close_quietly(host, manager, aot=None) -> None:
     # ``host`` is None when teardown runs before (or because) the
     # pipeline build itself failed.
     try:
@@ -1069,6 +1171,16 @@ def _close_quietly(host, manager) -> None:
             manager.close()
         except Exception:
             log.exception("checkpoint manager close failed")
+        if aot is not None:
+            # Reap the compile thread (an XLA compile cannot be
+            # cancelled; an aborted fit must not hand a live thread back
+            # to the caller).  Bounded: a pathological compile leaves a
+            # daemon thread behind with a warning rather than wedging
+            # teardown.
+            try:
+                aot.join(timeout=120.0)
+            except Exception:
+                log.exception("aot compile thread join failed")
 
 
 def default_recoverable_errors() -> tuple[type[BaseException], ...]:

@@ -184,6 +184,7 @@ def launch_local(
     timeout: float | None = None,
     heartbeat_timeout: float | None = None,
     term_grace_s: float = DEFAULT_TERM_GRACE_S,
+    startup_stats: Optional[dict] = None,
 ) -> list[int]:
     """Spawn ``num_processes`` copies of ``argv`` as a localhost cluster.
 
@@ -210,6 +211,18 @@ def launch_local(
     anything calling ``initialize_from_env`` — and size it over the
     slowest expected gap (initial jax import + first XLA compile beat
     the interval automatically; the writer thread starts pre-import).
+
+    **Startup MTTR.**  Pass ``startup_stats`` (a dict, filled in place
+    per process index) to stamp the relaunch-to-first-step milestones
+    off the heartbeat files: ``first_beat_s`` (spawn → first heartbeat,
+    i.e. process up), ``loop_entry_s`` (spawn → step ≥ 0, i.e. restore +
+    setup done, entering the train loop) and ``first_step_s`` (spawn →
+    first observed step *advance* past the entry step).  Readings are at
+    heartbeat-interval resolution — ``supervise_local`` prints them per
+    relaunch, and the precise in-process numbers live in the workdir's
+    ``telemetry.json`` ``startup`` section.  ``first_step_s`` may be
+    absent when chunks outrun the heartbeat cadence (the first observed
+    beat already carries an advanced step).
     """
     import shutil
     import tempfile
@@ -246,12 +259,41 @@ def launch_local(
                     stderr=None if i == 0 else subprocess.STDOUT,
                 )
             )
+        def _stamp_startup() -> None:
+            """Relaunch-to-first-step milestones from the heartbeat
+            files (see the docstring); called once per poll round.
+            Times come from each beat's own write timestamp (payload
+            ``time``), not this reader's clock — a milestone whose beat
+            is only *observed* by a later poll (or the final read after
+            the fleet exits) is still stamped at the moment it was
+            written, bounded by the writer's ~1 s cadence."""
+            for i, view in enumerate(
+                heartbeat.read_fleet(hb_dir, num_processes)
+            ):
+                if view is None:
+                    continue
+                at = round(float(view.get("time", 0.0)) - t0_wall, 3)
+                st = startup_stats.setdefault(i, {})
+                st.setdefault("first_beat_s", at)
+                step = int(view.get("step", -1))
+                if step >= 0 and "loop_entry_s" not in st:
+                    st["loop_entry_s"] = at
+                    st["_entry_step"] = step
+                if (
+                    "loop_entry_s" in st
+                    and "first_step_s" not in st
+                    and step > st["_entry_step"]
+                ):
+                    st["first_step_s"] = at
+
         deadline = None if timeout is None else time.monotonic() + timeout
         codes: dict[int, int] = {}
         failure: Optional[tuple[int, str]] = None
         while len(codes) < num_processes:
             if deadline is not None and time.monotonic() > deadline:
                 raise subprocess.TimeoutExpired(argv, timeout)
+            if startup_stats is not None:
+                _stamp_startup()
             for i, p in enumerate(procs):
                 if i in codes:
                     continue
@@ -299,6 +341,12 @@ def launch_local(
             # A stalled (still-running) culprit gets the same
             # SIGTERM-then-SIGKILL as its peers.
             _terminate_fleet(procs, codes, term_grace_s)
+        if startup_stats is not None:
+            # One last read: the final beats (written right up to child
+            # exit) may carry the first step advance the poll missed.
+            _stamp_startup()
+            for st in startup_stats.values():
+                st.pop("_entry_step", None)
         code_list = [codes[i] for i in range(num_processes)]
         for i, rc in enumerate(code_list):
             if rc == RESUMABLE_EXIT_CODE:
@@ -351,6 +399,13 @@ def supervise_local(
     Each relaunch bumps the coordinator port by one: the dead chief's
     listener can linger in TIME_WAIT, and a bind failure would burn a
     whole restart on launcher misfortune.
+
+    Every round stamps the fleet's startup MTTR (spawn → loop entry →
+    first step, from the heartbeat files — ``launch_local``'s
+    ``startup_stats``) to stderr, so a relaunch's recovery time is
+    visible at the supervisor without opening the workdir; the precise
+    per-process numbers are the ``startup`` section of each run's
+    ``telemetry.json``.
     """
     import time
 
@@ -358,9 +413,28 @@ def supervise_local(
 
     attempt = 0
     while True:
+        stats: dict = {}
         codes = launch_local(
-            num_processes, argv, port=port + attempt, **launch_kwargs
+            num_processes, argv, port=port + attempt,
+            startup_stats=stats, **launch_kwargs
         )
+        if stats:
+            worst = max(
+                (
+                    st.get("first_step_s") or st.get("loop_entry_s") or 0.0
+                    for st in stats.values()
+                ),
+                default=0.0,
+            )
+            sys.stderr.write(
+                f"--- fleet startup MTTR ("
+                f"{'relaunch' if attempt else 'launch'} {attempt}): "
+                f"slowest spawn→first-step {worst:.1f}s; per process "
+                + " ".join(
+                    f"p{i}={stats[i]}" for i in sorted(stats)
+                )
+                + " ---\n"
+            )
         agg = aggregate_exit_codes(codes)
         if agg in (0, RESUMABLE_EXIT_CODE):
             return agg

@@ -25,6 +25,7 @@ durations, ``harness/hooks.py::TelemetryHook`` snapshots everything into
 
 from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
     CHAOS_ARMED_UNFIRED,
+    CKPT_FENCE,
     CKPT_RESTORE,
     CKPT_SAVE,
     CKPT_WAIT,
@@ -46,6 +47,9 @@ from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
     RESTARTS,
     ROLLBACKS,
     SKIPPED_BATCHES,
+    STARTUP_AOT_COMPILE,
+    STARTUP_FIRST_STEP,
+    STARTUP_RESTORE,
     STEP_TIME,
     WATCHDOG_LAST_PROGRESS,
     WORKER_BUSY,

@@ -8,8 +8,16 @@ construction:
 
     compute     = total - data_stall - checkpoint - compile
     data_stall  = train/data_wait        (loop blocked in next(batch))
-    checkpoint  = checkpoint/{save,restore,wait}
+    checkpoint  = checkpoint/{save,restore,wait,fence}
     compile     = train/compile          (explicit XLA compile events)
+
+The report also carries a ``startup`` section — the restart-MTTR
+numbers (``startup/restore_s``, ``startup/aot_compile_s``,
+``startup/time_to_first_step_s`` gauges from ``harness/startup.py`` and
+``fit``).  They are *overlapped* wall readings (the AOT compile runs
+concurrently with the restore), so they are reported alongside — never
+added into — the four exclusive fractions above, which still sum to
+exactly 1.0.
 
 MFU is wall-clock-inclusive (FLOPs retired per second of *total* time over
 peak), i.e. it already prices in every stall — the honest end-to-end
@@ -102,6 +110,7 @@ def goodput_report(
         total(reglib.CKPT_SAVE)
         + total(reglib.CKPT_RESTORE)
         + total(reglib.CKPT_WAIT)
+        + total(reglib.CKPT_FENCE)
     )
     compile_s = total(reglib.COMPILE)
     attributed = data_stall + checkpoint + compile_s
@@ -141,6 +150,15 @@ def goodput_report(
             "compile": compile_s / total_s,
         },
         "compile_events": int(snap.get(f"{reglib.COMPILE}/count", 0.0)),
+        # Restart-MTTR section (overlapped wall readings — reported
+        # beside the exclusive four-way split, never summed into it).
+        "startup": {
+            "restore_s": snap.get(reglib.STARTUP_RESTORE, 0.0),
+            "aot_compile_s": snap.get(reglib.STARTUP_AOT_COMPILE, 0.0),
+            "time_to_first_step_s": snap.get(
+                reglib.STARTUP_FIRST_STEP, 0.0
+            ),
+        },
         "flops_per_step": flops_per_step,
         "flops_total": flops_total,
         "device_kind": kind,

@@ -54,9 +54,25 @@ PREFETCH_DEPTH = "pipeline/prefetch_depth"  # gauge
 # = the serial record cursor is the bottleneck.
 WORKER_BUSY = "pipeline/worker_busy"  # gauge family: /<worker index>
 REASSEMBLY_WAIT = "pipeline/reassembly_wait"  # timer
-CKPT_SAVE = "checkpoint/save"  # timer
+CKPT_SAVE = "checkpoint/save"  # timer: blocking portion (snapshot+dispatch)
 CKPT_RESTORE = "checkpoint/restore"  # timer
-CKPT_WAIT = "checkpoint/wait"  # timer: blocking on async save completion
+CKPT_WAIT = "checkpoint/wait"  # timer: explicit waits (teardown/emergency)
+# Durability fence for overlapped saves: time the step path spent blocked
+# on a PREVIOUS async save before dispatching the next one (checkpoint.py
+# ::CheckpointManager.fence).  Separate from CKPT_SAVE so tightening
+# checkpoint_every_steps shows its true wall cost: save = the
+# device→host snapshot + orbax dispatch (paid per save), fence = how
+# often the cadence outran the background writer (ideally ~0).
+CKPT_FENCE = "checkpoint/fence"  # timer
+# Cold-start / restart-MTTR gauges (harness/startup.py + fit): wall time
+# of the startup restore walk, the background AOT train-step compile
+# (overlapped with the restore — only the non-overlapped remainder lands
+# in train/compile), and process-entry→first-completed-step.  The
+# goodput report surfaces them as its "startup" section and the
+# supervisor's relaunch-to-first-step MTTR is their fleet-side reading.
+STARTUP_RESTORE = "startup/restore_s"  # gauge
+STARTUP_AOT_COMPILE = "startup/aot_compile_s"  # gauge
+STARTUP_FIRST_STEP = "startup/time_to_first_step_s"  # gauge
 # Resilience (harness/train.py + resilience/).  RESTARTS counts
 # recoverable_fit restore-retrain cycles (seeded into each attempt's fresh
 # registry so the final telemetry.json carries the cumulative count);

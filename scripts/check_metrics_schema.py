@@ -30,6 +30,14 @@ Checks, per line:
 - chaos keys (``chaos/*`` — e.g. ``chaos/armed_unfired``): any present
   value must be a non-negative number;
 
+- checkpoint keys (``checkpoint/*`` — today ``checkpoint/fence_s``, the
+  overlapped-save durability-fence share of ``checkpoint_s``): any
+  present value must be a non-negative number;
+
+- startup/MTTR gauges (``startup/restore_s``, ``startup/aot_compile_s``,
+  ``startup/time_to_first_step_s`` — README "Performance", restart
+  MTTR): injected as a full set by TelemetryHook, each non-negative;
+
 and, across the file with ``--require-telemetry``: at least one row
 carries the full telemetry key set (``data_wait_s``, ``step_time_s``,
 ``mfu``) — the TelemetryHook injects them together, so a partial set on
@@ -62,6 +70,17 @@ FLEET_KEYS = ("fleet/peers_alive", "fleet/step_lag", "fleet/heartbeat_age_s")
 # Prefix for chaos-drill accounting keys (chaos/armed_unfired today):
 # values must be non-negative numbers wherever they appear.
 CHAOS_PREFIX = "chaos/"
+# Checkpoint-accounting keys (checkpoint/fence_s today): wall-time
+# shares, non-negative wherever they appear.
+CHECKPOINT_PREFIX = "checkpoint/"
+# Restart-MTTR gauges TelemetryHook injects together (README
+# "Performance"); a partial set on a row is a writer bug, like the sets
+# above.  Values are overlapped wall readings — non-negative seconds.
+STARTUP_KEYS = (
+    "startup/restore_s",
+    "startup/aot_compile_s",
+    "startup/time_to_first_step_s",
+)
 
 
 def _is_number(v) -> bool:
@@ -151,10 +170,29 @@ def check_lines(
                 errors.append(
                     f"line {i}: fleet gauge {key!r} is negative: {value!r}"
                 )
+        startup_present = [k for k in STARTUP_KEYS if k in row]
+        if startup_present and len(startup_present) != len(STARTUP_KEYS):
+            errors.append(
+                f"line {i}: partial startup key set {startup_present} "
+                f"(expected all of {list(STARTUP_KEYS)} together)"
+            )
+        for key in startup_present:
+            value = row[key]
+            if _is_number(value) and value < 0:
+                errors.append(
+                    f"line {i}: startup gauge {key!r} is negative: {value!r}"
+                )
         for key, value in row.items():
-            if key.startswith(CHAOS_PREFIX) and _is_number(value) and value < 0:
+            if not (_is_number(value) and value < 0):
+                continue
+            if key.startswith(CHAOS_PREFIX):
                 errors.append(
                     f"line {i}: chaos key {key!r} is negative: {value!r}"
+                )
+            elif key.startswith(CHECKPOINT_PREFIX):
+                errors.append(
+                    f"line {i}: checkpoint key {key!r} is negative: "
+                    f"{value!r}"
                 )
     return errors, rows, telemetry_rows
 

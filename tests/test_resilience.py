@@ -208,6 +208,59 @@ def test_mid_run_tear_fires_without_save_cadence(mesh8, tmp_path, baseline):
     assert report["newest_valid_step"] == STEPS  # final save intact
 
 
+def test_sigterm_resume_bit_identical_at_tight_cadence(
+    mesh8, tmp_path, baseline
+):
+    """ISSUE 6: overlapped (dispatch-only) saves let checkpoint_every_steps
+    tighten — here 2, under the fused loop — and the kill/resume contract
+    must stay bit-identical: SIGTERM at step 4 (emergency save fenced
+    explicitly), rerun resumes from the step-4 save and finishes equal to
+    the fault-free run."""
+    cfg = _cfg(
+        chaos={"sigterm_at_step": 4},
+        checkpoint_every_steps=2,
+        steps_per_loop=2,
+    )
+    first = trainlib.recoverable_fit(
+        cfg, str(tmp_path), mesh=mesh8, backoff_base_s=0.0
+    )
+    assert first.preempted
+    assert int(first.state.step) == 4
+    second = trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
+    assert second.steps_run == STEPS - 4
+    _assert_states_bit_identical(second.state, baseline.state)
+
+
+def test_torn_newest_walks_back_bit_identical_at_tight_cadence(
+    mesh8, tmp_path, baseline
+):
+    """Tightened cadence (2) + the newest checkpoint (the step-5 end
+    save) torn after finalization: resume walks back to the step-4
+    cadence save — NOT a fresh init, which is exactly the replay-length
+    win the tight cadence buys — and the replayed run is bit-identical
+    to fault-free.  (A torn step that also has a later save at the same
+    step is self-healed by the save path's torn-dir replacement, so the
+    tear targets the run's final save.)"""
+    cfg5 = _cfg(
+        train_steps=5,
+        checkpoint_every_steps=2,
+        chaos={"torn_checkpoint_at_step": 5},
+    )
+    trainlib.fit(cfg5, str(tmp_path), mesh=mesh8)
+    report = fscklib.fsck_checkpoints(
+        os.path.join(str(tmp_path), "checkpoints")
+    )
+    assert report["latest_step"] == 5
+    assert report["newest_valid_step"] == 4  # the end save really torn
+
+    cfg8 = _cfg(
+        checkpoint_every_steps=2, chaos={"torn_checkpoint_at_step": 5}
+    )
+    res = trainlib.fit(cfg8, str(tmp_path), mesh=mesh8)
+    assert res.steps_run == STEPS - 4  # resumed at 4, replayed 5..8
+    _assert_states_bit_identical(res.state, baseline.state)
+
+
 def test_chaos_warns_when_fault_never_fires(mesh8, tmp_path, caplog):
     """A drill whose fault position is never reached must say so — an
     exit-0 run with a silently unfired fault would read as a passed

@@ -1,0 +1,460 @@
+"""Cold-start / restart-MTTR tests (harness/startup.py + fit wiring).
+
+Pins the ISSUE 6 contracts: the AOT-compiled train step is bit-identical
+to the jit path (K=1 and K>1); the config-derived batch specs match what
+the live pipeline produces (so the overlap actually engages); a
+mismatch or failure falls back to jit instead of breaking training; the
+production compile-cache knob resolves as documented; heartbeats stay
+fresh through an artificially slow restore (a steady-state
+``--heartbeat-timeout`` cannot kill a cold-starting child); the
+launcher stamps relaunch-to-first-step MTTR; and the new telemetry
+keys (checkpoint/fence, startup/*) flow through goodput and the schema
+lint.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu import telemetry
+from distributed_tensorflow_models_tpu.core import (
+    sharding as shardlib,
+    train_loop,
+)
+from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.harness import (
+    checkpoint as ckptlib,
+    config as configlib,
+    startup as startuplib,
+    train as trainlib,
+)
+from distributed_tensorflow_models_tpu.ops import optim
+from distributed_tensorflow_models_tpu.resilience import heartbeat
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _load_script(name):
+    from importlib import util as importutil
+
+    spec = importutil.spec_from_file_location(
+        name, os.path.join(_SCRIPTS, f"{name}.py")
+    )
+    mod = importutil.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tiny_setup(mesh):
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False, **kw):
+            x = x.reshape((x.shape[0], -1))
+            return nn.Dense(10)(nn.relu(nn.Dense(16)(x)))
+
+    model = MLP()
+    state = TrainState.create(
+        model, optim.sgd(0.1), jax.random.key(0),
+        jnp.zeros((2, 8, 8, 1), jnp.float32),
+    )
+    state = train_loop.place_state(state, mesh)
+    loss = train_loop.classification_loss_fn(model.apply)
+
+    def batch(i):
+        rng = np.random.RandomState(i)
+        return shardlib.shard_batch(mesh, {
+            "image": rng.rand(16, 8, 8, 1).astype(np.float32),
+            "label": rng.randint(0, 10, (16,)).astype(np.int32),
+        })
+
+    return state, loss, batch
+
+
+def _bit_identical(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _spec_of(batch):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        batch,
+    )
+
+
+# --------------------------------------------------------------------------
+# AOT executable == jit path, bit for bit
+# --------------------------------------------------------------------------
+
+
+def test_aot_step_bit_identical_to_jit_k1(mesh8):
+    state, loss, batch = _tiny_setup(mesh8)
+    jit_fn = train_loop.make_train_step(loss)
+    rng = jax.random.key(7)
+    aot = startuplib.AotTrainStep(
+        jit_fn, (state, _spec_of(batch(0)), rng),
+        registry=telemetry.MetricsRegistry(),
+    ).start()
+    exe, first = aot.acquire(startuplib.AotTrainStep.signature(batch(0)))
+    assert exe is not None and first
+
+    s_aot, s_jit = state, state
+    for i in range(3):
+        s_aot, m_aot = exe(s_aot, batch(i), rng)
+        s_jit, m_jit = jit_fn(s_jit, batch(i), rng)
+    _bit_identical(s_aot.params, s_jit.params)
+    _bit_identical(s_aot.opt_state, s_jit.opt_state)
+    assert float(m_aot["loss"]) == float(m_jit["loss"])
+
+
+def test_aot_step_bit_identical_to_jit_multi(mesh8):
+    state, loss, batch = _tiny_setup(mesh8)
+    multi = train_loop.make_multi_step(loss)
+    rng = jax.random.key(7)
+    K = 3
+    chunk = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *[batch(i) for i in range(K)]
+    )
+    aot = startuplib.AotTrainStep(
+        multi,
+        (state, startuplib.stacked_batch(_spec_of(batch(0)), K), rng),
+        registry=telemetry.MetricsRegistry(),
+    ).start()
+    exe, _ = aot.acquire(startuplib.AotTrainStep.signature(chunk))
+    assert exe is not None
+    s_aot, rows_aot = exe(state, chunk, rng)
+    s_jit, rows_jit = multi(state, chunk, rng)
+    _bit_identical(s_aot.params, s_jit.params)
+    _bit_identical(s_aot.opt_state, s_jit.opt_state)
+    np.testing.assert_array_equal(
+        np.asarray(rows_aot["loss"]), np.asarray(rows_jit["loss"])
+    )
+
+
+def test_aot_mismatch_and_failure_fall_back(mesh8, caplog):
+    import logging
+
+    state, loss, batch = _tiny_setup(mesh8)
+    jit_fn = train_loop.make_train_step(loss)
+    rng = jax.random.key(0)
+    aot = startuplib.AotTrainStep(
+        jit_fn, (state, _spec_of(batch(0)), rng),
+        registry=telemetry.MetricsRegistry(),
+    ).start()
+    wrong_sig = ((("nope",), "float32"),)
+    assert aot.acquire(wrong_sig) == (None, False)
+    good_sig = startuplib.AotTrainStep.signature(batch(0))
+    exe, first = aot.acquire(good_sig)
+    assert exe is not None and first
+    _, again = aot.acquire(good_sig)
+    assert not again  # first_use exactly once: compile-event accounting
+    aot.disable()
+    assert aot.acquire(good_sig) == (None, False)
+
+    # A trace-time failure disables the handle with one warning.
+    def broken(state, batch, rng):
+        raise RuntimeError("boom at trace time")
+
+    bad = startuplib.AotTrainStep(
+        jax.jit(broken), (state, _spec_of(batch(0)), rng),
+        registry=telemetry.MetricsRegistry(),
+    ).start()
+    with caplog.at_level(logging.WARNING, logger="dtm"):
+        assert bad.acquire(good_sig) == (None, False)
+    assert "falling back to the jit path" in caplog.text
+
+
+def test_jit_init_bit_identical_to_eager(mesh8):
+    """TrainState.create's cache-gated jitted init (the relaunch-MTTR
+    init path) must produce byte-identical parameters, BN stats and
+    optimizer slots to the eager init it replaces."""
+    from distributed_tensorflow_models_tpu.models import get_model
+
+    model = get_model("resnet32_cifar")
+    sample = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    a = TrainState.create(
+        model, optim.sgd(0.1), jax.random.key(3), sample, jit_init=False
+    )
+    b = TrainState.create(
+        model, optim.sgd(0.1), jax.random.key(3), sample, jit_init=True
+    )
+    _bit_identical(a.params, b.params)
+    _bit_identical(a.batch_stats, b.batch_stats)
+    _bit_identical(a.opt_state, b.opt_state)
+
+
+# --------------------------------------------------------------------------
+# Config-derived specs must match the live pipeline
+# --------------------------------------------------------------------------
+
+
+def test_abstract_batch_matches_live_classification_batch(mesh8):
+    cfg = configlib.get_config("lenet_mnist", global_batch_size=32)
+    dataset = trainlib.build_dataset(cfg, "train")
+    live = shardlib.shard_batch(mesh8, next(iter(dataset)))
+    spec = startuplib.abstract_batch(cfg, mesh8)
+    assert startuplib.AotTrainStep.signature(
+        spec
+    ) == startuplib.AotTrainStep.signature(live)
+    # Shardings too — an AOT executable rejects sharding drift.
+    for s, l in zip(
+        jax.tree_util.tree_leaves(spec), jax.tree_util.tree_leaves(live)
+    ):
+        assert s.sharding == l.sharding
+
+
+def test_abstract_batch_unknown_dataset_is_none(mesh8):
+    cfg = configlib.get_config("lenet_mnist").replace(dataset="exotic")
+    assert startuplib.abstract_batch(cfg, mesh8) is None
+
+
+def test_dominant_chunk_len_mirrors_chunk_shrink_triggers():
+    cfg = configlib.get_config(
+        "lenet_mnist", steps_per_loop=16, train_steps=1000,
+        log_every_steps=8,
+    )
+    assert startuplib.dominant_chunk_len(cfg) == 8
+    assert startuplib.dominant_chunk_len(
+        cfg.replace(checkpoint_every_steps=2)
+    ) == 2
+    assert startuplib.dominant_chunk_len(
+        cfg.replace(preempt_poll_steps=4), nproc=2
+    ) == 4
+    assert startuplib.dominant_chunk_len(
+        cfg.replace(log_every_steps=0)
+    ) == 16
+    assert startuplib.dominant_chunk_len(cfg.replace(train_steps=3)) == 3
+
+
+# --------------------------------------------------------------------------
+# Compile-cache knob resolution
+# --------------------------------------------------------------------------
+
+
+def test_apply_compile_cache_resolution(tmp_path):
+    old = startuplib.configured_cache_dir()
+    try:
+        # An already-configured cache (the test conftest's) wins over the
+        # workdir default — fit must not redirect the suite's shared
+        # cache at every run.
+        assert old  # conftest configured it
+        assert startuplib.apply_compile_cache(None, str(tmp_path)) == old
+        # Explicit path is applied as-is.
+        explicit = str(tmp_path / "cache-x")
+        assert startuplib.apply_compile_cache(
+            explicit, str(tmp_path)
+        ) == explicit
+        assert startuplib.configured_cache_dir() == explicit
+        # "" disables, even a previously configured cache.
+        assert startuplib.apply_compile_cache("", str(tmp_path)) is None
+        assert not startuplib.configured_cache_dir()
+        # Nothing configured + None -> the workdir default.
+        assert startuplib.apply_compile_cache(
+            None, str(tmp_path)
+        ) == str(tmp_path / "xla_cache")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+
+
+def test_cli_startup_knob_overrides():
+    from types import SimpleNamespace
+
+    from distributed_tensorflow_models_tpu.harness import cli
+
+    args = SimpleNamespace(
+        train_steps=None, batch_size=None, seed=None,
+        xla_cache_dir="/tmp/c", aot_compile=False,
+    )
+    out = cli._overrides(args)
+    assert out["xla_cache_dir"] == "/tmp/c"
+    assert out["aot_compile"] is False
+
+
+# --------------------------------------------------------------------------
+# fit end-to-end: AOT on/off bit-identity + startup telemetry
+# --------------------------------------------------------------------------
+
+
+def test_fit_aot_on_off_bit_identical(mesh8, tmp_path):
+    cfg = configlib.get_config(
+        "lenet_mnist", train_steps=4, global_batch_size=32,
+        log_every_steps=2, checkpoint_every_secs=10_000.0,
+    )
+    on = trainlib.fit(cfg, str(tmp_path / "on"), mesh=mesh8)
+    off = trainlib.fit(
+        cfg.replace(aot_compile=False), str(tmp_path / "off"), mesh=mesh8
+    )
+    _bit_identical(on.state.params, off.state.params)
+    _bit_identical(on.state.opt_state, off.state.opt_state)
+
+    rep = json.load(open(tmp_path / "on" / "telemetry.json"))
+    assert rep["startup"]["aot_compile_s"] > 0  # the thread really ran
+    assert rep["startup"]["time_to_first_step_s"] > 0
+    assert rep["compile_events"] >= 1  # first AOT use counts as compile
+    rep_off = json.load(open(tmp_path / "off" / "telemetry.json"))
+    assert rep_off["startup"]["aot_compile_s"] == 0.0
+
+    # Rows carry the startup set (full set — the schema lint's contract).
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "on" / "metrics.jsonl")
+        .read_text().splitlines()
+    ]
+    telem = [r for r in rows if "data_wait_s" in r]
+    assert telem
+    for r in telem:
+        for key in (
+            "startup/restore_s", "startup/aot_compile_s",
+            "startup/time_to_first_step_s", "checkpoint/fence_s",
+        ):
+            assert key in r, key
+            assert r[key] >= 0
+
+
+# --------------------------------------------------------------------------
+# Heartbeat liveness through a slow cold start
+# --------------------------------------------------------------------------
+
+
+def test_heartbeat_stays_fresh_during_slow_restore(tmp_path):
+    """The heartbeat writer free-runs on its own thread, so a restore +
+    AOT compile of any length keeps the file fresh — a
+    ``--heartbeat-timeout`` sized for steady-state steps can never kill
+    a legitimately cold-starting child.  Simulated: a 0.6 s 'restore'
+    (12x the write interval) against a 0.25 s timeout."""
+    timeout_s = 0.25
+    w = heartbeat.HeartbeatWriter(str(tmp_path), 0, interval_s=0.05).start()
+    try:
+        deadline = time.monotonic() + 0.6  # the artificially slow restore
+        worst = 0.0
+        while time.monotonic() < deadline:
+            view = heartbeat.read_fleet(str(tmp_path), 1)[0]
+            assert view is not None
+            worst = max(worst, view["age_s"])
+            assert view["step"] == -1  # not looping yet — and that's fine
+            time.sleep(0.05)
+        assert worst <= timeout_s, worst
+        summary = heartbeat.fleet_summary(
+            str(tmp_path), 1, stale_after_s=timeout_s
+        )
+        assert summary["peers_alive"] == 1
+    finally:
+        w.stop()
+
+
+def test_launch_local_stamps_startup_mttr(tmp_path):
+    """launch_local's startup_stats: spawn→first-beat→loop-entry→
+    first-step milestones read off the heartbeat files (jax-free child
+    that writes its own heartbeats, like a real worker's writer
+    thread)."""
+    from distributed_tensorflow_models_tpu import launch
+
+    import sys
+
+    child = (
+        "import json, os, time\n"
+        "d = os.environ['DTM_HEARTBEAT_DIR']\n"
+        "i = os.environ['DTM_PROCESS_ID']\n"
+        "def beat(step):\n"
+        "    p = os.path.join(d, f'p{i}.json')\n"
+        "    with open(p + '.tmp', 'w') as f:\n"
+        "        json.dump({'pid': os.getpid(), 'time': time.time(),"
+        " 'step': step}, f)\n"
+        "    os.replace(p + '.tmp', p)\n"
+        "beat(-1); time.sleep(0.3)\n"   # 'restoring'
+        "beat(5); time.sleep(0.3)\n"    # entered the loop at step 5
+        "beat(7); time.sleep(0.3)\n"    # first chunk done
+    )
+    stats: dict = {}
+    codes = launch.launch_local(
+        1, [sys.executable, "-c", child], timeout=30.0,
+        startup_stats=stats,
+    )
+    assert codes == [0]
+    st = stats[0]
+    assert 0 <= st["first_beat_s"] <= st["loop_entry_s"]
+    assert st["loop_entry_s"] <= st["first_step_s"]
+    assert "_entry_step" not in st
+
+
+# --------------------------------------------------------------------------
+# Fence accounting + goodput/schema plumbing
+# --------------------------------------------------------------------------
+
+
+def test_checkpoint_fence_records_only_when_pending(tmp_path):
+    reg = telemetry.MetricsRegistry()
+    mgr = ckptlib.CheckpointManager(
+        str(tmp_path), registry=reg, process_index=0, process_count=1
+    )
+
+    class StubOrbax:
+        def __init__(self):
+            self.pending = True
+
+        def is_saving_in_progress(self):
+            return self.pending
+
+        def wait_until_finished(self):
+            self.pending = False
+
+    mgr._mgr.close()
+    mgr._mgr = StubOrbax()
+    mgr.fence()  # pending -> records one fence
+    mgr.fence()  # idle -> no record
+    snap = reg.snapshot()
+    assert snap["checkpoint/fence/count"] == 1.0
+    # wait() always records — the explicit-fence paths want the block
+    # visible even when it cost nothing.
+    mgr.wait()
+    mgr.wait()
+    assert reg.snapshot()["checkpoint/wait/count"] == 2.0
+
+
+def test_goodput_report_counts_fence_and_carries_startup():
+    reg = telemetry.MetricsRegistry()
+    reg.timer(telemetry.CKPT_SAVE).record(0.05)
+    reg.timer(telemetry.CKPT_FENCE).record(0.15)
+    reg.gauge(telemetry.STARTUP_RESTORE).set(1.5)
+    reg.gauge(telemetry.STARTUP_AOT_COMPILE).set(0.7)
+    reg.gauge(telemetry.STARTUP_FIRST_STEP).set(2.5)
+    rep = telemetry.goodput_report(reg, total_s=1.0, steps=4, kind="CPU")
+    assert rep["fractions"]["checkpoint"] == pytest.approx(0.2)
+    assert sum(rep["fractions"].values()) == pytest.approx(1.0)
+    assert rep["startup"] == {
+        "restore_s": 1.5, "aot_compile_s": 0.7,
+        "time_to_first_step_s": 2.5,
+    }
+
+
+def test_metrics_schema_startup_and_checkpoint_keys():
+    check_lines = _load_script("check_metrics_schema").check_lines
+
+    def row(**kw):
+        return json.dumps({"step": 1, "time": 1.0, **kw})
+
+    full = {
+        "startup/restore_s": 0.5,
+        "startup/aot_compile_s": 0.2,
+        "startup/time_to_first_step_s": 1.0,
+        "checkpoint/fence_s": 0.0,
+    }
+    errors, rows, _ = check_lines([row(**full)])
+    assert errors == [] and rows == 1
+    errors, _, _ = check_lines([row(**{"startup/restore_s": 0.5})])
+    assert any("partial startup key set" in e for e in errors)
+    errors, _, _ = check_lines(
+        [row(**{**full, "startup/restore_s": -1.0})]
+    )
+    assert any("startup gauge" in e and "negative" in e for e in errors)
+    errors, _, _ = check_lines([row(**{"checkpoint/fence_s": -0.1})])
+    assert any("checkpoint key" in e and "negative" in e for e in errors)
