@@ -438,9 +438,18 @@ class InstrumentedStep:
         compiled = aot_first or (
             before is not None and self._cache_size() != before
         )
-        self._registry.timer(
-            telemetry.COMPILE if compiled else telemetry.DISPATCH
-        ).record(dt)
+        name = telemetry.COMPILE if compiled else telemetry.DISPATCH
+        self._registry.timer(name).record(dt)
+        tr = self._registry.trace
+        if tr.enabled:
+            # The dispatch/compile split on the flight-recorder timeline:
+            # compile events are rare and load-bearing (a recompile storm
+            # is visible as a train of them); dispatches bound the ring's
+            # reach, which is the ring's job.
+            tr.complete(
+                name, dt, ts_mono=t0,
+                args={"aot": True} if used_aot else None,
+            )
         return out
 
     def __call__(self, state, batch, rng):

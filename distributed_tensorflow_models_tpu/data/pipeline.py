@@ -49,6 +49,19 @@ PyTree = Any
 
 log = logging.getLogger("dtm")
 
+# Pipeline stage waits below this duration are not traced (they still
+# land in the timers): the tracer's ring exists to hold *stalls* for the
+# flight recorder / fleet timeline, and a healthy pipeline's thousands of
+# sub-millisecond waits would evict exactly the events a post-mortem
+# needs.
+_TRACE_STALL_MIN_S = 1e-3
+
+
+def _trace_stall(reg, name: str, dur_s: float, t0_mono: float) -> None:
+    tr = reg.trace
+    if tr.enabled and dur_s >= _TRACE_STALL_MIN_S:
+        tr.complete(name, dur_s, ts_mono=t0_mono)
+
 
 class _Stop:
     pass
@@ -216,9 +229,9 @@ class HostPipeline:
                 delivered = self._put_stop_aware(
                     self._buffer, (batch, state)
                 )
-                reg.timer(telemetry.PRODUCER_WAIT).record(
-                    time.perf_counter() - t0
-                )
+                dt = time.perf_counter() - t0
+                reg.timer(telemetry.PRODUCER_WAIT).record(dt)
+                _trace_stall(reg, telemetry.PRODUCER_WAIT, dt, t0)
                 reg.gauge(telemetry.HOST_QUEUE_DEPTH).set(
                     self._buffer.qsize()
                 )
@@ -321,9 +334,9 @@ class HostPipeline:
                     except queue.Empty:
                         continue
                     pending[idx] = (payload, state)
-                reg.timer(telemetry.REASSEMBLY_WAIT).record(
-                    time.perf_counter() - t0
-                )
+                dt = time.perf_counter() - t0
+                reg.timer(telemetry.REASSEMBLY_WAIT).record(dt)
+                _trace_stall(reg, telemetry.REASSEMBLY_WAIT, dt, t0)
                 payload, state = pending.pop(next_idx)
                 next_idx += 1
                 if isinstance(payload, _Failure):
@@ -337,9 +350,9 @@ class HostPipeline:
                 delivered = self._put_stop_aware(
                     self._buffer, (payload, state)
                 )
-                reg.timer(telemetry.PRODUCER_WAIT).record(
-                    time.perf_counter() - t0
-                )
+                dt = time.perf_counter() - t0
+                reg.timer(telemetry.PRODUCER_WAIT).record(dt)
+                _trace_stall(reg, telemetry.PRODUCER_WAIT, dt, t0)
                 reg.gauge(telemetry.HOST_QUEUE_DEPTH).set(
                     self._buffer.qsize()
                 )
@@ -517,9 +530,9 @@ class DevicePrefetcher:
                 )
                 self._pending_error = e
                 return
-            reg.timer(telemetry.PREFETCH_FILL).record(
-                time.perf_counter() - t0
-            )
+            dt = time.perf_counter() - t0
+            reg.timer(telemetry.PREFETCH_FILL).record(dt)
+            _trace_stall(reg, telemetry.PREFETCH_FILL, dt, t0)
             state = (
                 self._source.get_state()
                 if hasattr(self._source, "get_state")

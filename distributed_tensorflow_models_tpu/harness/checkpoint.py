@@ -187,10 +187,17 @@ class CheckpointManager:
     def _agree_int(self, value: int, label: str) -> int:
         """Chief-decides broadcast with the skew audit: a follower whose
         local decision is overridden bumps ``fleet/consensus_overrides``
-        (the consensus module logs the specifics)."""
+        (the consensus module logs the specifics) and the override lands
+        on the flight-recorder timeline — which host's storage view
+        disagreed, on which decision, is exactly the cross-host fact a
+        skew post-mortem reconstructs."""
         agreed = self._consensus.broadcast_int(value, label=label)
         if agreed != value:
             self._registry.counter(telemetry.CONSENSUS_OVERRIDES).inc()
+            self._registry.trace.instant(
+                "fleet/consensus_override",
+                {"label": label, "local": value, "agreed": agreed},
+            )
         return agreed
 
     def save(
@@ -220,6 +227,9 @@ class CheckpointManager:
             log.warning(
                 "existing checkpoint at step %d is torn; replacing it",
                 step,
+            )
+            self._registry.trace.instant(
+                "checkpoint/replace_torn", {"step": step}
             )
             self.delete(step)
         elif step in self._mgr.all_steps():
@@ -388,6 +398,7 @@ class CheckpointManager:
                     "reports and can --repair)",
                     step, "; ".join(issues),
                 )
+                self._trace_walk_back(step, "torn")
                 continue
             try:
                 out = self._restore_step(template, step)
@@ -397,12 +408,14 @@ class CheckpointManager:
                     "checkpoint step %d passed validation but failed to "
                     "restore (%s); walking back", step, e,
                 )
+                self._trace_walk_back(step, "unrestorable")
                 continue
             if accept is not None and not accept(out[0]):
                 log.warning(
                     "checkpoint step %d rejected (%s); walking back",
                     step, accept_name or "accept predicate",
                 )
+                self._trace_walk_back(step, accept_name or "rejected")
                 continue
             if i > 0:
                 log.warning(
@@ -415,6 +428,14 @@ class CheckpointManager:
             f"no valid checkpoint among steps {candidates} under "
             f"{self._dir}"
         ) from last_error
+
+    def _trace_walk_back(self, step: int, why: str) -> None:
+        """Torn-dir-walk forensics: each skipped candidate is one instant
+        on the timeline, so a restore that silently landed three steps
+        back is reconstructable from the flight recorder alone."""
+        self._registry.trace.instant(
+            "checkpoint/walk_back", {"step": step, "why": why}
+        )
 
     def _walk_order(self) -> list[int]:
         """Candidate order for the fleet walk, from THIS process's view:
@@ -486,6 +507,9 @@ class CheckpointManager:
                         "a peer failed to restore chief-decided step %d; "
                         "walking back with the fleet", step,
                     )
+                self._trace_walk_back(
+                    step, "unrestorable" if failed else "peer-unrestorable"
+                )
                 continue
             assert out is not None
             rejected = accept is not None and not accept(out[0])
@@ -495,6 +519,7 @@ class CheckpointManager:
                     "walking back",
                     step, accept_name or "accept predicate",
                 )
+                self._trace_walk_back(step, accept_name or "fleet-rejected")
                 continue
             if newest is not None and step != newest:
                 log.warning(

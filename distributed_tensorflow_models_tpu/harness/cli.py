@@ -135,6 +135,27 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "compilation",
     )
     p.add_argument(
+        "--trace-ring-events", type=int, default=None,
+        help="structured event tracer ring size (flight recorder / "
+        "Chrome-trace export; default 4096, 0 disables tracing) — "
+        "README 'Observability'",
+    )
+    p.add_argument(
+        "--trace-export", action=argparse.BooleanOptionalAction,
+        default=None,
+        help="write the event ring as Perfetto-loadable Chrome-trace "
+        "JSON (<workdir>/trace_p<i>.json) at every fit exit; merge "
+        "hosts with scripts/fleet_report.py (default off)",
+    )
+    p.add_argument(
+        "--flight-recorder", action=argparse.BooleanOptionalAction,
+        default=None,
+        help="dump <workdir>/flight_recorder_p<i>.json (last trace "
+        "events + registry snapshot) on abnormal exits — rollback, "
+        "preemption, crash, chaos kill (default on); "
+        "--no-flight-recorder disables",
+    )
+    p.add_argument(
         "--preempt-poll-steps", type=int, default=None,
         help="multi-host preemption-notice poll cadence in steps (the "
         "poll is a collective; default 20).  Keep poll_steps x step_time "
@@ -183,6 +204,12 @@ def _overrides(args) -> dict:
         out["aot_compile"] = args.aot_compile
     if getattr(args, "preempt_poll_steps", None) is not None:
         out["preempt_poll_steps"] = args.preempt_poll_steps
+    if getattr(args, "trace_ring_events", None) is not None:
+        out["trace_ring_events"] = args.trace_ring_events
+    if getattr(args, "trace_export", None) is not None:
+        out["trace_export"] = args.trace_export
+    if getattr(args, "flight_recorder", None) is not None:
+        out["flight_recorder"] = args.flight_recorder
     if getattr(args, "chaos", None) is not None:
         out["chaos"] = args.chaos
     for attr, key in (

@@ -158,6 +158,23 @@ class ExperimentConfig:
     # mismatch falls back to jit with only a wasted background compile.
     xla_cache_dir: Optional[str] = None
     aot_compile: bool = True
+    # Flight-recorder / event-trace knobs (telemetry/trace.py; README
+    # "Observability").  trace_ring_events: bounded in-memory ring of
+    # structured span/instant events — the default keeps tracing ON
+    # (appends are ~1 µs, inside the telemetry 5 µs/step guard, and the
+    # ring never touches disk on the happy path, so tier-1 wall time is
+    # unchanged); 0 disables tracing entirely.  trace_export: write the
+    # ring as Chrome-trace JSON (<workdir>/trace_p<i>.json,
+    # Perfetto-loadable; scripts/fleet_report.py merges hosts) at every
+    # fit exit — off by default (an artifact per fit is drill/debug
+    # tooling, not a production default).  flight_recorder: dump the
+    # ring + a registry snapshot to <workdir>/flight_recorder_p<i>.json
+    # on abnormal exits (rollback, preemption, crash, chaos kill, and —
+    # via the signal watcher — SIGTERM arrival even with the main
+    # thread wedged in a dead peer's collective).
+    trace_ring_events: int = 4096
+    trace_export: bool = False
+    flight_recorder: bool = True
     # Divergence policy (harness/train.py::fit).  "abort" = the reference
     # NanTensorHook behavior: a non-finite loss kills the run.  "rollback"
     # = restore the last finite checkpoint, advance the dataset cursor

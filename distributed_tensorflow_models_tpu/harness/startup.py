@@ -315,8 +315,16 @@ class AotTrainStep:
             dt = time.perf_counter() - t0
             # Full background duration; the goodput report shows it
             # beside (not inside) the exclusive wall split — only the
-            # acquire() remainder is wall the main thread lost.
+            # acquire() remainder is wall the main thread lost.  Traced
+            # from THIS thread, so the flight-recorder timeline shows the
+            # compile overlapping the main thread's restore span — the
+            # overlap is the whole point of the design, and the trace is
+            # where it's visible.
             self._registry.gauge(telemetry.STARTUP_AOT_COMPILE).set(dt)
+            self._registry.trace.complete(
+                "startup/aot_compile", dt, ts_mono=t0,
+                args={"label": self._label, "ok": self._error is None},
+            )
         new_entries = cache_entry_count(self._cache_dir) - entries_before
         if self._cache_dir is None:
             cache_note = "persistent cache off"
@@ -338,7 +346,15 @@ class AotTrainStep:
         if self._disabled or sig != self._sig:
             return None, False
         if self._thread.is_alive():
+            # The non-overlapped remainder: wall the main thread actually
+            # lost to the compile.  Traced separately from the compile
+            # span so the timeline shows hidden vs. paid cold-start cost.
+            t0 = time.perf_counter()
             self._thread.join()
+            self._registry.trace.complete(
+                "startup/aot_join", time.perf_counter() - t0, ts_mono=t0,
+                args={"label": self._label},
+            )
         if self._error is not None:
             log.warning(
                 "AOT %s compile failed (%s); falling back to the jit path",

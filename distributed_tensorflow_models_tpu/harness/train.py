@@ -437,11 +437,50 @@ def fit(
     t_run0 = time.perf_counter()
     registry = telemetry.MetricsRegistry()
     registry.counter(telemetry.RESTARTS).inc(restarts)
+    # Structured event tracing + flight recorder (telemetry/trace.py,
+    # README "Observability"): the run's tracer rides the registry, so
+    # every component the registry already reaches (pipeline, step,
+    # checkpoint, startup) records onto one wall-clock-stamped timeline.
+    tracer = telemetry.Tracer(
+        capacity=max(1, int(cfg.trace_ring_events or 0)),
+        process_index=jax.process_index(),
+        enabled=int(cfg.trace_ring_events or 0) > 0,
+    )
+    registry.trace = tracer
+    # Read by the flight-dump closure below at CALL time (a closure over
+    # fit's local): dumps fired before the loop report the sentinel.
+    step = -1
+
+    def _dump_flight(reason: str) -> None:
+        """Dump the ring + registry to ``flight_recorder_p<i>.json``.
+        Called on every abnormal exit (rollback, preemption, crash, the
+        chaos kill's pre-SIGKILL hook, and the signal watcher's
+        at-arrival dump).  Best-effort: forensics must never be the
+        thing that fails training."""
+        if not cfg.flight_recorder or not tracer.enabled:
+            return
+        try:
+            os.makedirs(workdir, exist_ok=True)
+            tracer.dump_flight_record(
+                telemetry.flight_record_path(workdir, tracer.process_index),
+                reason,
+                registry,
+                extra={"step": step},
+            )
+        except Exception:  # noqa: BLE001
+            log.exception("flight-record dump (%s) failed", reason)
+
+    tracer.instant("fit/entry", {"config": cfg.name, "restarts": restarts})
     # Production compile cache, applied before build_state — whose
     # model.init is this run's first trace (README "Performance";
     # restart-MTTR: a relaunch deserializes instead of recompiling).
     startuplib.apply_compile_cache(cfg.xla_cache_dir, workdir)
     chaos = resilience.get_injector(cfg.chaos, seed=cfg.seed, scope=workdir)
+    if chaos is not None:
+        # (Re)wire the memoized injector to THIS run's forensics: fires
+        # land on the timeline, and the kill fault dumps before SIGKILL.
+        chaos.tracer = tracer
+        chaos.flight_dump = _dump_flight
     if mesh is None:
         mesh = mesh_from_config(cfg)
     state = build_state(cfg, mesh)
@@ -499,6 +538,7 @@ def fit(
             cfg, state, mesh, seq_dim, steps_per_loop, step_jit, registry
         )
 
+        resilience.heartbeat.set_phase("restore")
         t_restore0 = time.perf_counter()
         state, data_state, restored = ckptlib.restore_or_init(manager, state)
         if restored:
@@ -509,6 +549,14 @@ def fit(
         registry.gauge(telemetry.STARTUP_RESTORE).set(
             time.perf_counter() - t_restore0
         )
+        tracer.instant(
+            "fit/restore_done",
+            {"restored": restored, "step": int(state.step)},
+        )
+        # "compile" until the first chunk completes: the gap between
+        # restore-done and first-step is where the (possibly AOT-hidden)
+        # XLA compile lives, and a heartbeat frozen here says so.
+        resilience.heartbeat.set_phase("compile")
 
         dataset = build_dataset(cfg, "train")
         if restored and data_state.get("dataset") and hasattr(
@@ -519,6 +567,8 @@ def fit(
             dataset = chaos.wrap_dataset(dataset)
     except BaseException:
         _close_quietly(None, manager, aot)
+        _dump_flight("setup_failure")
+        _unwire_chaos_forensics(chaos)
         raise
 
     host = device_it = stacker = data_src = None
@@ -550,6 +600,18 @@ def fit(
     own_listener = listener is None
     if own_listener:
         listener = resilience.PreemptionListener()
+    fwatch: Optional[telemetry.FlightWatcher] = None
+
+    def _final_dump(reason: str) -> None:
+        """The terminal flight dump: stop the signal watcher FIRST so a
+        starved watcher thread cannot resume later and overwrite this
+        fuller record with its thinner at-arrival one (`signal_N` over
+        `preempted`) — the watcher's value ends the moment the graceful
+        path is known to run."""
+        if fwatch is not None:
+            fwatch.stop()
+        _dump_flight(reason)
+
     try:
         # The pipeline threads start inside this block, and the rest
         # of the setup below it can fail for real reasons (a hook
@@ -573,13 +635,19 @@ def fit(
             # it) lags the host pipeline by the prefetch depth and reflects
             # exactly the batches the train loop has consumed, so resume
             # never skips.
-            manager.save(s, {"dataset": data_src.get_state()}, force=force)
-            if chaos is not None and chaos.should_tear(int(s.step)):
-                # Chaos torn-write injection damages only *durable* files —
-                # wait for the async save so the tear is the post-finalization
-                # corruption the restore hardening exists for.
-                manager.wait()
-                chaos.tear_checkpoint(manager.directory, int(s.step))
+            prev_phase = resilience.heartbeat.set_phase("save")
+            try:
+                manager.save(s, {"dataset": data_src.get_state()}, force=force)
+                if chaos is not None and chaos.should_tear(int(s.step)):
+                    # Chaos torn-write injection damages only *durable*
+                    # files — wait for the async save so the tear is the
+                    # post-finalization corruption the restore hardening
+                    # exists for.
+                    manager.wait()
+                    chaos.tear_checkpoint(manager.directory, int(s.step))
+            finally:
+                if prev_phase:
+                    resilience.heartbeat.set_phase(prev_phase)
 
         # Writer hooks run on process 0 only (the reference's chief-writes-
         # summaries convention, TF monitored_session.py:566-609); the NaN guard
@@ -606,6 +674,15 @@ def fit(
         # standalone fit owns its own.  Install is a no-op off the main
         # thread — such a caller simply never observes a preemption.
         listener_active = listener.install()
+        if listener_active and cfg.flight_recorder and tracer.enabled:
+            # At-arrival forensics: a SIGTERM'd host wedged in a dead
+            # peer's collective never reaches its chunk-boundary poll
+            # (or any graceful dump) before the supervisor's SIGKILL —
+            # the watcher dumps the flight record the moment the signal
+            # lands, off the wakeup fd, main thread blocked or not.
+            fwatch = telemetry.FlightWatcher(_dump_flight)
+            if not fwatch.install():
+                fwatch = None
 
         chaos_hooks: list[hooklib.Hook] = []
         if chaos is not None:
@@ -705,9 +782,13 @@ def fit(
         step = int(state.step)
 
     except BaseException:
+        if fwatch is not None:
+            fwatch.stop()
         if own_listener:
             listener.uninstall()  # no-op if install never ran
         _close_quietly(host, manager, aot)
+        _dump_flight("setup_failure")
+        _unwire_chaos_forensics(chaos)
         raise
 
     watchdog = None
@@ -742,12 +823,16 @@ def fit(
     except BaseException:
         if watchdog is not None:
             watchdog.stop()
+        if fwatch is not None:
+            fwatch.stop()
         if own_listener:
             listener.uninstall()
         # The pipeline threads and the checkpoint manager already exist at
         # this point — a setup failure must not leak them into the caller
         # (the producer would sit blocked on its full buffer forever).
         _close_quietly(host, manager, aot)
+        _dump_flight("setup_failure")
+        _unwire_chaos_forensics(chaos)
         raise
 
     # Sentinel for "no divergence seen here" in the any-host agreement
@@ -788,11 +873,18 @@ def fit(
                 consensus.allgather_int(bad_step, label="chunk-finite")
             )
             if agreed < _NO_BAD_STEP:
+                tracer.instant(
+                    "train/divergence",
+                    {"step": agreed, "local": agreed == bad_step},
+                )
                 raise FloatingPointError(
                     f"loss is {bad_value if agreed == bad_step else 'non-finite on a peer'}"
                     f" at step {agreed} (fleet-agreed divergence)"
                 )
         elif bad_step < _NO_BAD_STEP:
+            tracer.instant(
+                "train/divergence", {"step": bad_step, "local": True}
+            )
             raise FloatingPointError(
                 f"loss is {bad_value} at step {bad_step}"
             )
@@ -878,6 +970,17 @@ def fit(
             "(steps %d..%d) on replay",
             step, offender_start + 1, offender_start + offender_len,
         )
+        # The rollback's span on the timeline runs from the divergence
+        # instant (train/divergence) through the restore spans to this
+        # marker — fleet_report reads the pair as the rollback window.
+        tracer.instant(
+            "train/rollback",
+            {
+                "restored_step": step,
+                "offender_start": offender_start,
+                "offender_len": offender_len,
+            },
+        )
         if watchdog is not None:
             watchdog.beat(step)
         return True
@@ -894,6 +997,7 @@ def fit(
                     "and exiting (resumable — rerun the same command)",
                     step,
                 )
+                tracer.instant("train/preempted", {"step": step})
                 save_fn(state, step, force=True)
                 # Explicit durability fence: the process is about to
                 # exit on the preemption notice — the overlapped
@@ -901,6 +1005,11 @@ def fit(
                 # supervisor may SIGKILL us the moment we return".
                 manager.wait()
                 preempted = True
+                # The preemption forensics record: the grace path ran,
+                # the emergency save is durable — replaces the signal
+                # watcher's at-arrival dump with the full story (the
+                # watcher is stopped first so it cannot win the race).
+                _final_dump("preempted")
                 break
             while pending_skips and pending_skips[0][0] <= step:
                 skip_at, n = pending_skips.pop(0)
@@ -918,11 +1027,18 @@ def fit(
                 done = _discard_batches(n)
                 skipped_total += done
                 registry.counter(telemetry.SKIPPED_BATCHES).inc(done)
+                tracer.instant(
+                    "train/skip_batches", {"step": step, "n": done}
+                )
                 executed_skips.append((step, done))
                 log.warning(
                     "rollback: advanced the dataset cursor past %d "
                     "offending batch(es) at step %d", done, step,
                 )
+                # Refresh the rollback forensics now that the recovery's
+                # final act (the exact skip) is on the timeline — the
+                # dump written at rewind time predates it.
+                _dump_flight("rollback")
             start = step
             t_iter = time.perf_counter()
             k = 0
@@ -1002,7 +1118,21 @@ def fit(
                 # counter equals restores performed even on exhaustion.
                 rollbacks_done += 1
                 registry.counter(telemetry.ROLLBACKS).inc()
+                # Rollback forensics land even though the run survives:
+                # the drill (or incident) is reconstructable from the
+                # dump whether or not the replay later succeeds.
+                _dump_flight("rollback")
                 continue
+            if tracer.enabled:
+                # One complete event per chunk (dispatch + hook walk):
+                # the step-progress series fleet_report's skew/straggler
+                # attribution is computed from.
+                tracer.complete(
+                    "train/chunk",
+                    time.perf_counter() - t_iter,
+                    ts_mono=t_iter,
+                    args={"start": start, "k": k},
+                )
             if steps_run and registry.gauge(
                 telemetry.STARTUP_FIRST_STEP
             ).value == 0.0:
@@ -1012,18 +1142,22 @@ def fit(
                 registry.gauge(telemetry.STARTUP_FIRST_STEP).set(
                     time.perf_counter() - t_run0
                 )
+                resilience.heartbeat.set_phase("train")
             if watchdog is not None:
                 watchdog.beat(step)
             resilience.heartbeat.beat(step)
             if not ok:
                 break
-    except BaseException:
+    except BaseException as e:
         # Already failing: run abort hooks best-effort (single-process, the
         # CheckpointHook crash-save preserves progress when storage still
         # works; multi-host it skips its collective save — see Hook.abort)
         # but never let cleanup mask the original error or skip releasing
         # the pipeline threads / checkpoint manager — recoverable_fit may
         # re-enter fit on the same workdir right after this.
+        tracer.instant(
+            "fit/abort", {"step": step, "error": repr(e)[:200]}
+        )
         for h in all_hooks:
             try:
                 h.abort(state)
@@ -1036,6 +1170,11 @@ def fit(
         # fault never injected should say so in its post-mortem too.
         if chaos is not None:
             chaos.export_unfired(registry)
+        # Crash forensics: the flight record holds the last events (the
+        # abort hooks' checkpoint spans included) and the trace export /
+        # trace gauges land before the goodput report snapshots them.
+        _final_dump("crash")
+        _export_trace(workdir, registry, cfg)
         _write_telemetry_report(workdir, registry, t_run0, steps_run)
         raise
     else:
@@ -1059,6 +1198,10 @@ def fit(
         # first so the gauge lands in the report's registry snapshot.
         if chaos is not None:
             chaos.export_unfired(registry)
+        tracer.instant(
+            "fit/end", {"steps_run": steps_run, "preempted": preempted}
+        )
+        _export_trace(workdir, registry, cfg)
         _write_telemetry_report(workdir, registry, t_run0, steps_run)
         if chaos is not None and not preempted:
             # A drill whose fault never injected must not exit 0 looking
@@ -1070,11 +1213,18 @@ def fit(
     finally:
         # Both exits: release the signal handlers (the caller's SIGINT
         # behavior must come back — unless the listener is owned by
-        # recoverable_fit, which spans restarts) and the watchdog thread.
+        # recoverable_fit, which spans restarts), the watchdog thread,
+        # the flight watcher (wakeup fd restored, thread joined), and
+        # the memoized injector's forensics wiring (the closure pins the
+        # ring + registry; a stale hook fire must not dump into a
+        # finished run).
         if watchdog is not None:
             watchdog.stop()
+        if fwatch is not None:
+            fwatch.stop()
         if own_listener:
             listener.uninstall()
+        _unwire_chaos_forensics(chaos)
 
     host_metrics = {k: float(v) for k, v in metrics.items()}
     if preempted:
@@ -1090,6 +1240,38 @@ def fit(
         rollbacks=rollbacks_done,
         skipped_batches=skipped_total,
     )
+
+
+def _unwire_chaos_forensics(chaos) -> None:
+    """Detach a (memoized, process-lifetime) injector from a finished
+    run's tracer/flight-dump closure — fit re-wires them at every
+    entry."""
+    if chaos is not None:
+        chaos.tracer = None
+        chaos.flight_dump = None
+
+
+def _export_trace(
+    workdir: str, registry: telemetry.MetricsRegistry, cfg
+) -> None:
+    """Per-process, best-effort: stamp the ``trace/*`` gauges (so the
+    goodput report's snapshot says how far the ring reached and how much
+    it dropped) and — under ``cfg.trace_export`` — write the
+    Chrome-trace JSON ``scripts/fleet_report.py`` merges across hosts.
+    Runs on BOTH exit paths, before the telemetry report snapshots."""
+    tracer = registry.trace
+    if not tracer.enabled:
+        return
+    try:
+        registry.gauge(telemetry.TRACE_EVENTS).set(float(tracer.emitted))
+        registry.gauge(telemetry.TRACE_DROPPED).set(float(tracer.dropped))
+        if cfg.trace_export:
+            os.makedirs(workdir, exist_ok=True)
+            tracer.dump_chrome(
+                telemetry.chrome_trace_path(workdir, tracer.process_index)
+            )
+    except Exception:  # noqa: BLE001 — reporting must never mask training
+        log.exception("trace export failed")
 
 
 def _write_telemetry_report(

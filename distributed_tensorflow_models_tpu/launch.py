@@ -321,11 +321,17 @@ def launch_local(
                         else time.time() - t0_wall
                     )
                     if age > heartbeat_timeout:
+                        # Step + phase from the heartbeat payload: the
+                        # stall is attributed ("frozen at step 40 in
+                        # phase save") without traces — the flight
+                        # recorder / fleet_report.py pick up from here.
                         failure = (
                             i,
                             f"heartbeat stale for {age:.1f}s "
                             f"(> {heartbeat_timeout:.1f}s; last step "
-                            f"{'?' if view is None else view.get('step')})",
+                            f"{'?' if view is None else view.get('step')}, "
+                            "phase "
+                            f"{'?' if view is None else view.get('phase', '?')})",
                         )
                         break
             if failure is not None:

@@ -204,6 +204,9 @@ class _ChaosDataset:
             log.warning(
                 "chaos: failing pipeline assemble at batch %d", work.index
             )
+            self._injector._trace_fire(
+                "pipeline_fail_at_batch", batch=work.index
+            )
             raise ChaosPipelineError(
                 f"chaos: injected pipeline failure at batch {work.index}"
             )
@@ -271,6 +274,7 @@ class _SigtermAtStep:
         if step == self._step and not self._injector._sigterm_fired:
             self._injector._sigterm_fired = True
             log.warning("chaos: delivering SIGTERM after step %d", step)
+            self._injector._trace_fire("sigterm_at_step", step=step)
             signal.raise_signal(signal.SIGTERM)
 
     def end(self, state) -> None: ...
@@ -311,6 +315,15 @@ class _KillAtStep:
             "chaos: SIGKILLing this process (host %d) after step %d",
             inj.config.chaos_host, step,
         )
+        inj._trace_fire("kill_at_step", step=step)
+        # SIGKILL allows no teardown — the flight record must be on disk
+        # BEFORE the kill or the drill leaves no forensics on the victim.
+        fd = inj.flight_dump
+        if fd is not None:
+            try:
+                fd("chaos_kill")
+            except Exception:  # noqa: BLE001 — the kill still proceeds
+                log.exception("pre-kill flight-record dump failed")
         import os
 
         os.kill(os.getpid(), signal.SIGKILL)
@@ -346,6 +359,10 @@ class _StragglerDelay:
                     "chaos: straggler delay %.0f ms/step active on host %d",
                     1000 * self._delay, inj.config.chaos_host,
                 )
+                inj._trace_fire(
+                    "straggler_delay_ms",
+                    step=step, delay_ms=1000 * self._delay,
+                )
             import time
 
             time.sleep(self._delay)
@@ -375,6 +392,22 @@ class ChaosInjector:
         self._hide_fired = False
         self._straggler_fired = False
         self._process_index: Optional[int] = None
+        # Flight-recorder wiring, (re)set by each fit (the injector is
+        # memoized across fits on one workdir): ``tracer`` records every
+        # fire as a ``chaos/*`` instant on the run's event timeline;
+        # ``flight_dump(reason)`` lets the kill fault dump forensics
+        # BEFORE the SIGKILL — the one fault whose process cannot dump
+        # on the way down.
+        self.tracer = None
+        self.flight_dump = None
+
+    def _trace_fire(self, fault: str, **args) -> None:
+        tr = self.tracer
+        if tr is not None:
+            try:
+                tr.instant(f"chaos/{fault}", args or None)
+            except Exception:  # noqa: BLE001 — forensics never fault chaos
+                log.exception("chaos trace event failed")
 
     # -- cross-host targeting ---------------------------------------------
 
@@ -464,6 +497,7 @@ class ChaosInjector:
         out = jax.tree.map(poison, batch)
         if poisoned_any:
             log.warning("chaos: poisoned the batch for step %d with NaN", target)
+            self._trace_fire("nan_at_step", step=target)
         else:
             log.warning(
                 "chaos: nan_at_step=%d found no float leaves to poison "
@@ -490,6 +524,7 @@ class ChaosInjector:
         if not self.should_tear(step):
             return
         self._tear_fired = True
+        self._trace_fire("torn_checkpoint_at_step", step=step)
         state_dir = os.path.join(ckpt_dir, str(step), fscklib._STATE_ITEM)
         removed = []
         for name in fscklib._STATE_REQUIRED:
@@ -595,6 +630,7 @@ class ChaosInjector:
                     "%d's view (visibility-skew simulation)",
                     newest, self.config.chaos_host,
                 )
+                self._trace_fire("hide_newest_ckpt", step=newest)
             return [s for s in steps if s != newest]
 
         return _filter

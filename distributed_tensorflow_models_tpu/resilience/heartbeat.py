@@ -6,11 +6,14 @@ by blocking in the next collective until some transport timeout fires
 heartbeat layer makes both detections prompt and cheap:
 
 - every process runs a :class:`HeartbeatWriter` — a daemon thread
-  atomically rewriting ``<dir>/p<i>.json`` (``{"pid", "time", "step"}``)
-  every ``interval_s``; the train loop feeds it the current step via
-  :func:`beat` at chunk boundaries, so the file distinguishes "process
-  alive but step frozen" (hung collective) from "process gone"
-  (file goes stale entirely);
+  atomically rewriting ``<dir>/p<i>.json``
+  (``{"pid", "time", "step", "phase"}``) every ``interval_s``; the train
+  loop feeds it the current step via :func:`beat` at chunk boundaries
+  and its lifecycle phase (``init``/``restore``/``compile``/``train``/
+  ``save``) via :func:`set_phase`, so the file distinguishes "process
+  alive but step frozen" (hung collective) from "process gone" (file
+  goes stale entirely) — and a stale-heartbeat teardown can say *what*
+  the host was doing when it froze without opening any trace;
 - the supervisor (``launch.launch_local``) and the chief's in-run
   ``FleetHook`` read the directory back via :func:`read_fleet` /
   :func:`fleet_summary` — peers alive, heartbeat ages, per-host step
@@ -64,17 +67,30 @@ class HeartbeatWriter:
         self.process_index = process_index
         self._interval = max(0.05, float(interval_s))
         self._step = -1  # -1 = process up, training not yet looping
+        self._phase = "init"  # restore | compile | train | save | ...
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def beat(self, step: int) -> None:
         self._step = int(step)
 
+    def set_phase(self, phase: str) -> str:
+        """Record the lifecycle phase (a couple of attribute writes —
+        hot-path safe); returns the previous phase so a scoped setter
+        (the save path) can restore it."""
+        prev, self._phase = self._phase, str(phase)
+        return prev
+
+    @property
+    def phase(self) -> str:
+        return self._phase
+
     def _write(self) -> None:
         payload = {
             "pid": os.getpid(),
             "time": time.time(),
             "step": self._step,
+            "phase": self._phase,
         }
         path = _path(self.directory, self.process_index)
         tmp = f"{path}.tmp"
@@ -137,6 +153,15 @@ def beat(step: int) -> None:
     w = _writer
     if w is not None:
         w.beat(step)
+
+
+def set_phase(phase: str) -> str:
+    """Lifecycle-phase touch; returns the previous phase ("" when
+    heartbeats are off, making restore-previous a harmless no-op)."""
+    w = _writer
+    if w is None:
+        return ""
+    return w.set_phase(phase)
 
 
 def read_fleet(

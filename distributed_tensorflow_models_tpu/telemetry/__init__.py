@@ -13,6 +13,12 @@ is the layer every perf PR proves its claims against:
   compile fractions of total wall time (summing to exactly 1.0), live MFU
   from XLA-cost-analysis FLOPs, and compile-event counts so recompile
   storms are diagnosable.
+- :mod:`trace` — the structured event tracer behind the fleet flight
+  recorder: a bounded ring of wall-clock-stamped span/instant events
+  (attached to each registry as ``registry.trace``), dumped as
+  ``flight_recorder_p<i>.json`` on abnormal exits and exportable as
+  Chrome-trace JSON that ``scripts/fleet_report.py`` merges across
+  hosts.
 
 Wiring (all via an injectable registry, defaulting to the process-global
 one): ``data/pipeline.py`` records queue depth / producer wait / prefetch
@@ -51,6 +57,8 @@ from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
     STARTUP_FIRST_STEP,
     STARTUP_RESTORE,
     STEP_TIME,
+    TRACE_DROPPED,
+    TRACE_EVENTS,
     WATCHDOG_LAST_PROGRESS,
     WORKER_BUSY,
     Counter,
@@ -58,6 +66,13 @@ from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
     MetricsRegistry,
     Timer,
     get_registry,
+)
+from distributed_tensorflow_models_tpu.telemetry.trace import (  # noqa: F401
+    NULL_TRACER,
+    FlightWatcher,
+    Tracer,
+    chrome_trace_path,
+    flight_record_path,
 )
 from distributed_tensorflow_models_tpu.telemetry.goodput import (  # noqa: F401
     device_count,

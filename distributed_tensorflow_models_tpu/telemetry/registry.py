@@ -28,6 +28,8 @@ import time
 from contextlib import contextmanager
 from typing import Iterator
 
+from distributed_tensorflow_models_tpu.telemetry import trace as tracelib
+
 # Canonical names.  Timers flatten in snapshots as
 # ``<name>/{total_s,count,mean_s,p50_s,p95_s,max_s}``.
 DATA_WAIT = "train/data_wait"  # timer: loop blocked in next(batch)
@@ -101,6 +103,13 @@ CONSENSUS_OVERRIDES = "fleet/consensus_overrides"  # counter
 # telemetry.json) — a drill that exits 0 with this nonzero exercised
 # nothing.
 CHAOS_ARMED_UNFIRED = "chaos/armed_unfired"  # gauge
+# Flight-recorder / tracer accounting (telemetry/trace.py, stamped by fit
+# before the telemetry.json report): EVENTS = events recorded over the
+# run, DROPPED = how many the bounded ring overwrote — a post-mortem
+# whose interesting window outran the ring says so here (raise
+# trace_ring_events).  Validated non-negative by check_metrics_schema.
+TRACE_EVENTS = "trace/events"  # gauge
+TRACE_DROPPED = "trace/dropped"  # gauge
 
 
 class Counter:
@@ -183,6 +192,13 @@ class MetricsRegistry:
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._timers: dict[str, Timer] = {}
+        # Structured event tracer (telemetry/trace.py), defaulting to the
+        # shared disabled instance: components reach it as
+        # ``registry.trace`` (one attribute hop — no new plumbing), and
+        # ``fit`` swaps in a live per-run tracer when tracing is on.
+        # ``span`` below mirrors every timed block into it, so the sites
+        # the registry already times are traced for free.
+        self.trace = tracelib.NULL_TRACER
 
     def _get(self, table: dict, name: str, cls):
         m = table.get(name)
@@ -203,12 +219,18 @@ class MetricsRegistry:
     @contextmanager
     def span(self, name: str) -> Iterator[None]:
         """Time a ``with`` block into ``timer(name)`` (errors included —
-        a save that dies after 30 s still burned the 30 s)."""
+        a save that dies after 30 s still burned the 30 s).  When a live
+        tracer is attached the block also lands in the event ring as a
+        complete event of the same name — the flight recorder and the
+        Chrome timeline see every site the registry times."""
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.timer(name).record(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.timer(name).record(dt)
+            if self.trace.enabled:
+                self.trace.complete(name, dt, ts_mono=t0)
 
     def snapshot(self) -> dict[str, float]:
         """Flat ``{name: float}`` view of everything recorded so far.

@@ -38,10 +38,23 @@ Checks, per line:
   ``startup/time_to_first_step_s`` — README "Performance", restart
   MTTR): injected as a full set by TelemetryHook, each non-negative;
 
+- tracer accounting (``trace/*`` — ``trace/events``, ``trace/dropped``
+  in telemetry.json snapshots): any present value must be a
+  non-negative number;
+
 and, across the file with ``--require-telemetry``: at least one row
 carries the full telemetry key set (``data_wait_s``, ``step_time_s``,
 ``mfu``) — the TelemetryHook injects them together, so a partial set on
 any row is always an error.
+
+With ``--flight-recorder`` the path is validated as a flight-recorder
+dump (``<workdir>/flight_recorder_p<i>.json``, telemetry/trace.py)
+instead of a metrics file: required keys (``version``, ``reason``,
+``pid``, ``process_index``, ``capacity``, ``events``, ``registry``),
+event count bounded by the declared ring capacity, per-event required
+keys and phases, ``ts_mono`` non-decreasing per thread (the tracer's
+per-thread ordering invariant), non-negative durations, and a
+numbers-only registry snapshot.
 
 Exit 0 on a clean file, 1 with one line per violation on stderr.
 Wired into tier-1 via ``tests/test_telemetry.py``'s smoke run.
@@ -73,6 +86,9 @@ CHAOS_PREFIX = "chaos/"
 # Checkpoint-accounting keys (checkpoint/fence_s today): wall-time
 # shares, non-negative wherever they appear.
 CHECKPOINT_PREFIX = "checkpoint/"
+# Tracer accounting (trace/events, trace/dropped): counts, non-negative
+# wherever they appear.
+TRACE_PREFIX = "trace/"
 # Restart-MTTR gauges TelemetryHook injects together (README
 # "Performance"); a partial set on a row is a writer bug, like the sets
 # above.  Values are overlapped wall readings — non-negative seconds.
@@ -194,12 +210,106 @@ def check_lines(
                     f"line {i}: checkpoint key {key!r} is negative: "
                     f"{value!r}"
                 )
+            elif key.startswith(TRACE_PREFIX):
+                errors.append(
+                    f"line {i}: trace key {key!r} is negative: {value!r}"
+                )
     return errors, rows, telemetry_rows
+
+
+# --------------------------------------------------------------------------
+# Flight-recorder dumps (telemetry/trace.py flight_record schema)
+# --------------------------------------------------------------------------
+
+FLIGHT_REQUIRED = (
+    "version", "reason", "ts_wall", "pid", "process_index", "capacity",
+    "events", "registry",
+)
+FLIGHT_EVENT_REQUIRED = ("ts_wall", "ts_mono", "tid", "name", "ph")
+FLIGHT_PHASES = ("X", "i")
+
+
+def check_flight_record(record) -> list[str]:
+    """Violations in one flight-recorder dump (empty list = clean)."""
+    errors: list[str] = []
+    if not isinstance(record, dict):
+        return ["flight record is not a JSON object"]
+    for key in FLIGHT_REQUIRED:
+        if key not in record:
+            errors.append(f"missing required key {key!r}")
+    if errors:
+        return errors
+    if not isinstance(record["reason"], str) or not record["reason"]:
+        errors.append(f"'reason' must be a non-empty string: {record['reason']!r}")
+    for key in ("pid", "process_index", "capacity"):
+        v = record[key]
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"{key!r} must be a non-negative int, got {v!r}")
+    if isinstance(record["capacity"], int) and record["capacity"] < 1:
+        errors.append("'capacity' must be >= 1")
+    events = record["events"]
+    if not isinstance(events, list):
+        return errors + ["'events' is not a list"]
+    cap = record["capacity"]
+    if isinstance(cap, int) and cap >= 1 and len(events) > cap:
+        errors.append(
+            f"{len(events)} events exceed the declared ring capacity {cap}"
+        )
+    last_mono: dict = {}
+    for i, e in enumerate(events):
+        if not isinstance(e, dict):
+            errors.append(f"event {i}: not a JSON object")
+            continue
+        missing = [k for k in FLIGHT_EVENT_REQUIRED if k not in e]
+        if missing:
+            errors.append(f"event {i}: missing keys {missing}")
+            continue
+        if e["ph"] not in FLIGHT_PHASES:
+            errors.append(
+                f"event {i}: phase {e['ph']!r} not in {list(FLIGHT_PHASES)}"
+            )
+        if e["ph"] == "X":
+            dur = e.get("dur_s")
+            if not _is_number(dur) or dur < 0:
+                errors.append(
+                    f"event {i}: complete event needs non-negative dur_s, "
+                    f"got {dur!r}"
+                )
+        for key in ("ts_wall", "ts_mono"):
+            if not _is_number(e[key]):
+                errors.append(f"event {i}: {key!r} is not a number")
+        # Per-thread monotonicity: perf_counter is monotonic and each
+        # thread appends in order, so a regression means a corrupted or
+        # hand-edited dump.
+        tid = e["tid"]
+        if _is_number(e["ts_mono"]):
+            prev = last_mono.get(tid)
+            if prev is not None and e["ts_mono"] < prev:
+                errors.append(
+                    f"event {i}: ts_mono went backwards for tid {tid} "
+                    f"({prev} -> {e['ts_mono']})"
+                )
+            last_mono[tid] = e["ts_mono"]
+    registry = record["registry"]
+    if not isinstance(registry, dict):
+        errors.append("'registry' is not an object")
+    else:
+        for key, value in registry.items():
+            if not _is_number(value):
+                errors.append(
+                    f"registry value for {key!r} is not a number: {value!r}"
+                )
+            elif value < 0 and key.startswith(TRACE_PREFIX):
+                errors.append(f"registry trace key {key!r} is negative")
+    return errors
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("path", help="path to metrics.jsonl")
+    p.add_argument(
+        "path", help="path to metrics.jsonl (or, with --flight-recorder, "
+        "a flight_recorder_p<i>.json dump)",
+    )
     p.add_argument(
         "--require-telemetry",
         action="store_true",
@@ -212,7 +322,31 @@ def main(argv=None) -> int:
         help="flag step regressions as errors (off by default: a "
         "recoverable_fit restart legitimately rewinds the step)",
     )
+    p.add_argument(
+        "--flight-recorder",
+        action="store_true",
+        help="validate the path as a flight-recorder dump "
+        "(telemetry/trace.py schema) instead of a metrics file",
+    )
     args = p.parse_args(argv)
+    if args.flight_recorder:
+        try:
+            with open(args.path) as f:
+                record = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+            return 1
+        errors = check_flight_record(record)
+        if errors:
+            for e in errors:
+                print(f"{args.path}: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"{args.path}: OK (reason {record['reason']!r}, "
+            f"{len(record['events'])} events, "
+            f"{len(record['registry'])} registry keys)"
+        )
+        return 0
     try:
         with open(args.path) as f:
             lines = f.read().splitlines()

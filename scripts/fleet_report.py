@@ -1,0 +1,505 @@
+#!/usr/bin/env python
+"""Merge per-host flight recorders, event traces, telemetry, and
+heartbeats into ONE fleet timeline with incident attribution.
+
+After a chaos drill or a real incident a workdir holds per-process
+forensics (``flight_recorder_p<i>.json`` from abnormal exits,
+``trace_p<i>.json`` Chrome exports when ``trace_export`` was on,
+``telemetry.json`` from the chief) — each telling one host's story.
+This script answers the fleet question *"what exactly happened, in what
+order, on which host"*:
+
+- **Incidents** — every flight recorder, by host: reason (``chaos_kill``,
+  ``preempted``, ``signal_15``, ``rollback``, ``crash``), wall time, the
+  step it died/rolled back at, and the os pid.  A host whose later trace
+  export carries a *different* os pid was **relaunched** — the
+  supervisor's recovery is read straight off the artifacts.
+- **Timeline** — the merged, wall-clock-ordered stream of instant events
+  (chaos fires, consensus overrides, rollbacks, preemption notices,
+  walk-backs) and long spans (above ``--min-span-ms``), each tagged
+  ``p<i>``.
+- **Step skew** — per-host step-vs-time series from the ``train/chunk``
+  events: the maximum lag, who lagged, and who led.
+- **Stall attribution** — the earliest long stall span in the merged
+  stream (*who stalled first*) plus per-host stall totals (*who
+  followed*): a straggler shows up as the host whose stalls start
+  earliest while its peers' data waits trail it.
+- **Chrome merge** (``--chrome out.json``) — every host's events in one
+  Perfetto-loadable file (pid = process index, timeline rebased to the
+  earliest event).
+
+Like the other fleet-side scripts, this never imports jax — safe on a
+login host against a live or dead workdir.
+
+Usage::
+
+    python scripts/fleet_report.py <workdir> [--chrome out.json]
+        [--json out.json] [--heartbeat-dir DIR] [--min-span-ms 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Optional
+
+# Instant-event names worth a line on the human timeline even when the
+# merged stream is long (spans are filtered by duration instead).
+_NOTABLE_PREFIXES = (
+    "chaos/",
+    "fleet/consensus_override",
+    "checkpoint/walk_back",
+    "checkpoint/replace_torn",
+    "train/divergence",
+    "train/rollback",
+    "train/skip_batches",
+    "train/preempted",
+    "fit/",
+)
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"warning: unreadable {path}: {e}", file=sys.stderr)
+        return None
+
+
+def load_artifacts(workdir: str) -> dict[int, dict]:
+    """``{process_index: {"flight": dict|None, "trace": dict|None}}`` for
+    every index that left either artifact."""
+    procs: dict[int, dict] = {}
+
+    def slot(i: int) -> dict:
+        return procs.setdefault(i, {"flight": None, "trace": None})
+
+    for path in sorted(glob.glob(os.path.join(workdir, "flight_recorder_p*.json"))):
+        m = re.search(r"flight_recorder_p(\d+)\.json$", path)
+        obj = _load_json(path)
+        if m and obj is not None:
+            slot(int(m.group(1)))["flight"] = obj
+    for path in sorted(glob.glob(os.path.join(workdir, "trace_p*.json"))):
+        m = re.search(r"trace_p(\d+)\.json$", path)
+        obj = _load_json(path)
+        if m and obj is not None:
+            slot(int(m.group(1)))["trace"] = obj
+    return procs
+
+
+def merged_events(procs: dict[int, dict]) -> list[dict]:
+    """One chronological stream of ``{proc, t (wall s), name, ph, dur_s,
+    args, tid}`` from every artifact, deduplicated (the flight recorder
+    and the trace export of one run overlap by construction)."""
+    out: list[dict] = []
+    seen: set[tuple] = set()
+
+    def add(proc: int, t: float, name: str, ph: str, dur_s, args, tid):
+        # 0.1 ms rounding: the Chrome export round-trips ts through
+        # seconds*1e6 doubles (ulp ~0.25 µs at epoch scale), so a µs-
+        # precision key would fail to dedup the flight-record copy
+        # against the trace-export copy of the SAME event.  tid and the
+        # serialized args keep genuinely distinct same-name events apart
+        # — two walk-back instants microseconds apart differ only in
+        # their args, and dropping one would hide exactly the forensics
+        # the report exists to show.
+        key = (
+            proc, name, ph, tid, round(t, 4), round(dur_s or 0.0, 4),
+            json.dumps(args, sort_keys=True, default=str) if args else "",
+        )
+        if key in seen:
+            return
+        seen.add(key)
+        out.append(
+            {
+                "proc": proc,
+                "t": t,
+                "name": name,
+                "ph": ph,
+                "dur_s": dur_s,
+                "args": args or {},
+                "tid": tid,
+            }
+        )
+
+    for proc, arts in procs.items():
+        flight = arts.get("flight")
+        if flight:
+            for e in flight.get("events", []):
+                add(
+                    proc,
+                    float(e.get("ts_wall", 0.0)),
+                    e.get("name", "?"),
+                    e.get("ph", "i"),
+                    e.get("dur_s"),
+                    e.get("args"),
+                    e.get("tid"),
+                )
+        trace = arts.get("trace")
+        if trace:
+            for e in trace.get("traceEvents", []):
+                if e.get("ph") == "M":
+                    continue
+                dur = e.get("dur")
+                add(
+                    proc,
+                    float(e.get("ts", 0.0)) / 1e6,
+                    e.get("name", "?"),
+                    e.get("ph", "i"),
+                    dur / 1e6 if dur is not None else None,
+                    e.get("args"),
+                    e.get("tid"),
+                )
+    out.sort(key=lambda e: e["t"])
+    return out
+
+
+def incidents(procs: dict[int, dict]) -> list[dict]:
+    """Per-host incident facts from the flight recorders, with relaunch
+    detection against the (later) trace export's os pid."""
+    out = []
+    for proc in sorted(procs):
+        flight = procs[proc].get("flight")
+        if not flight:
+            continue
+        trace = procs[proc].get("trace") or {}
+        trace_pid = (trace.get("otherData") or {}).get("os_pid")
+        entry = {
+            "proc": proc,
+            "reason": flight.get("reason", "?"),
+            "t": float(flight.get("ts_wall", 0.0)),
+            "step": flight.get("step"),
+            "os_pid": flight.get("pid"),
+            "relaunched": (
+                trace_pid is not None
+                and flight.get("pid") is not None
+                and trace_pid != flight.get("pid")
+            ),
+            "relaunch_os_pid": (
+                trace_pid
+                if trace_pid is not None and trace_pid != flight.get("pid")
+                else None
+            ),
+        }
+        # Timing evidence a drill verdict can quote without re-deriving.
+        snap = flight.get("registry", {})
+        entry["evidence"] = {
+            "checkpoint_fence_total_s": snap.get("checkpoint/fence/total_s"),
+            "startup_time_to_first_step_s": snap.get(
+                "startup/time_to_first_step_s"
+            ),
+            "rollbacks": snap.get("train/rollbacks"),
+        }
+        rollbacks = [
+            e
+            for e in flight.get("events", [])
+            if e.get("name") == "train/rollback"
+        ]
+        if rollbacks:
+            entry["evidence"]["last_rollback"] = rollbacks[-1].get("args")
+        out.append(entry)
+    return out
+
+
+def step_series(events: list[dict]) -> dict[int, list[tuple[float, int]]]:
+    """Per-process (wall time, end step) from ``train/chunk`` events."""
+    series: dict[int, list[tuple[float, int]]] = {}
+    for e in events:
+        if e["name"] != "train/chunk" or e["ph"] != "X":
+            continue
+        args = e["args"]
+        if "start" not in args or "k" not in args:
+            continue
+        end_t = e["t"] + (e["dur_s"] or 0.0)
+        series.setdefault(e["proc"], []).append(
+            (end_t, int(args["start"]) + int(args["k"]))
+        )
+    for s in series.values():
+        s.sort()
+    return series
+
+
+def step_skew(events: list[dict]) -> Optional[dict]:
+    """Maximum observed step lag across hosts: walk the merged chunk
+    completions, tracking each host's latest step; at every completion
+    compare leader vs laggard.  None without ≥2 hosts' series."""
+    series = step_series(events)
+    if len(series) < 2:
+        return None
+    merged = sorted(
+        (t, proc, step) for proc, s in series.items() for t, step in s
+    )
+    latest: dict[int, int] = {}
+    worst = None
+    for t, proc, step in merged:
+        latest[proc] = step
+        if len(latest) < 2:
+            continue
+        leader = max(latest, key=lambda p: latest[p])
+        laggard = min(latest, key=lambda p: latest[p])
+        lag = latest[leader] - latest[laggard]
+        if worst is None or lag > worst["lag"]:
+            worst = {
+                "lag": lag,
+                "t": t,
+                "leader": leader,
+                "laggard": laggard,
+            }
+    return worst
+
+
+# Span names that are WAITS (stall attribution's include-list): the
+# pipeline stages' waits, the loop's input wait, and checkpoint
+# durability blocks.  Compute/compile/dispatch/restore spans are work,
+# not stalls — counting them would make "who stalled first" name the
+# host that merely compiled first.
+_STALL_SPAN_NAMES = (
+    "train/data_wait",
+    "checkpoint/fence",
+    "checkpoint/wait",
+    "startup/aot_join",
+)
+
+
+def _is_stall_span(name: str) -> bool:
+    return name.startswith("pipeline/") or name in _STALL_SPAN_NAMES
+
+
+def stall_attribution(
+    events: list[dict], min_span_s: float
+) -> dict:
+    """Who stalled first (earliest long WAIT span) and who followed
+    (per-host long-wait totals)."""
+    stalls = [
+        e
+        for e in events
+        if e["ph"] == "X"
+        and (e["dur_s"] or 0.0) >= min_span_s
+        and _is_stall_span(e["name"])
+    ]
+    totals: dict[int, float] = {}
+    for e in stalls:
+        totals[e["proc"]] = totals.get(e["proc"], 0.0) + e["dur_s"]
+    first = stalls[0] if stalls else None
+    return {
+        "first": (
+            {
+                "proc": first["proc"],
+                "name": first["name"],
+                "t": first["t"],
+                "dur_s": first["dur_s"],
+            }
+            if first
+            else None
+        ),
+        "totals_s": totals,
+    }
+
+
+def build_report(
+    workdir: str,
+    heartbeat_dir: Optional[str] = None,
+    min_span_ms: float = 50.0,
+    procs: Optional[dict] = None,
+) -> dict:
+    """Pass ``procs`` (one ``load_artifacts`` result) when also merging
+    a Chrome trace, so both views describe the same artifact snapshot
+    and multi-MB exports are parsed once."""
+    if procs is None:
+        procs = load_artifacts(workdir)
+    events = merged_events(procs)
+    min_span_s = min_span_ms / 1000.0
+    notable = [
+        e
+        for e in events
+        if (e["ph"] == "i" and e["name"].startswith(_NOTABLE_PREFIXES))
+        or (e["ph"] == "X" and (e["dur_s"] or 0.0) >= min_span_s)
+    ]
+    report = {
+        "workdir": os.path.abspath(workdir),
+        "processes": sorted(procs),
+        "artifacts": {
+            p: sorted(k for k, v in procs[p].items() if v) for p in procs
+        },
+        "incidents": incidents(procs),
+        "timeline": notable,
+        "step_skew": step_skew(events),
+        "stalls": stall_attribution(events, min_span_s),
+    }
+    telemetry_path = os.path.join(workdir, "telemetry.json")
+    if os.path.exists(telemetry_path):
+        tel = _load_json(telemetry_path)
+        if tel:
+            report["goodput"] = {
+                "fractions": tel.get("fractions"),
+                "steps": tel.get("steps"),
+                "total_s": tel.get("total_s"),
+            }
+    if heartbeat_dir and os.path.isdir(heartbeat_dir):
+        beats = {}
+        for path in sorted(glob.glob(os.path.join(heartbeat_dir, "p*.json"))):
+            m = re.search(r"p(\d+)\.json$", path)
+            obj = _load_json(path)
+            if m and obj is not None:
+                beats[int(m.group(1))] = obj
+        report["last_heartbeats"] = beats
+    return report
+
+
+def merge_chrome(procs: dict[int, dict]) -> dict:
+    """Perfetto-loadable fleet trace: every host's events on its own
+    process track, timeline rebased to the earliest event."""
+    events = merged_events(procs)
+    t0 = min((e["t"] for e in events), default=0.0)
+    out = []
+    for e in events:
+        ce = {
+            "name": e["name"],
+            "ph": e["ph"],
+            "ts": (e["t"] - t0) * 1e6,
+            "pid": e["proc"],
+            "tid": e["tid"] if e["tid"] is not None else 0,
+        }
+        if e["ph"] == "X":
+            ce["dur"] = (e["dur_s"] or 0.0) * 1e6
+        else:
+            ce["s"] = "t"
+        if e["args"]:
+            ce["args"] = e["args"]
+        out.append(ce)
+    for proc in sorted(procs):
+        out.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": proc,
+                "args": {"name": f"p{proc}"},
+            }
+        )
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {"t0_wall": t0, "processes": sorted(procs)},
+    }
+
+
+def format_report(report: dict) -> str:
+    lines = [f"fleet report: {report['workdir']}"]
+    if not report["processes"]:
+        lines.append(
+            "  no per-process artifacts found (flight_recorder_p*.json / "
+            "trace_p*.json) — enable flight_recorder/trace_export"
+        )
+        return "\n".join(lines)
+    lines.append(
+        "  processes: "
+        + ", ".join(
+            f"p{p}({'+'.join(report['artifacts'][p])})"
+            for p in report["processes"]
+        )
+    )
+    inc = report["incidents"]
+    if inc:
+        lines.append("incidents:")
+        t0 = min(e["t"] for e in inc)
+        for e in inc:
+            what = e["reason"]
+            if what == "chaos_kill":
+                what = "KILLED (chaos kill -9)"
+            extra = f" at step {e['step']}" if e.get("step") is not None else ""
+            relaunch = (
+                f"; relaunched (os pid {e['os_pid']} -> "
+                f"{e['relaunch_os_pid']})"
+                if e["relaunched"]
+                else ""
+            )
+            lines.append(
+                f"  p{e['proc']}: {what}{extra} "
+                f"(+{e['t'] - t0:.3f}s, os pid {e['os_pid']}){relaunch}"
+            )
+            ev = {k: v for k, v in e["evidence"].items() if v is not None}
+            if ev:
+                lines.append(f"      evidence: {ev}")
+    else:
+        lines.append("incidents: none (no flight-recorder dumps)")
+    skew = report.get("step_skew")
+    if skew:
+        lines.append(
+            f"step skew: max lag {skew['lag']} step(s) — "
+            f"p{skew['laggard']} behind p{skew['leader']}"
+        )
+    stalls = report.get("stalls") or {}
+    if stalls.get("first"):
+        f = stalls["first"]
+        lines.append(
+            f"first stall: p{f['proc']} {f['name']} "
+            f"({f['dur_s']:.3f}s); per-host stall totals: "
+            + ", ".join(
+                f"p{p}={s:.3f}s"
+                for p, s in sorted(stalls["totals_s"].items())
+            )
+        )
+    timeline = report["timeline"]
+    if timeline:
+        lines.append(f"timeline ({len(timeline)} notable events):")
+        t0 = timeline[0]["t"]
+        for e in timeline[-80:]:
+            dur = f" [{e['dur_s']:.3f}s]" if e["ph"] == "X" else ""
+            args = f" {e['args']}" if e["args"] else ""
+            lines.append(
+                f"  +{e['t'] - t0:9.3f}s p{e['proc']} {e['name']}{dur}{args}"
+            )
+    if report.get("goodput"):
+        lines.append(f"goodput (chief): {report['goodput']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("workdir", help="training workdir holding the artifacts")
+    p.add_argument(
+        "--chrome", default=None, metavar="OUT",
+        help="write the merged Perfetto-loadable Chrome trace here",
+    )
+    p.add_argument(
+        "--json", dest="json_out", default=None, metavar="OUT",
+        help="write the structured report here",
+    )
+    p.add_argument(
+        "--heartbeat-dir", default=None,
+        help="include last heartbeats from this directory (step/phase)",
+    )
+    p.add_argument(
+        "--min-span-ms", type=float, default=50.0,
+        help="spans shorter than this stay off the text timeline",
+    )
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.workdir):
+        print(f"error: no such workdir {args.workdir!r}", file=sys.stderr)
+        return 2
+    procs = load_artifacts(args.workdir)
+    report = build_report(
+        args.workdir,
+        heartbeat_dir=args.heartbeat_dir,
+        min_span_ms=args.min_span_ms,
+        procs=procs,
+    )
+    # Artifacts before the (interruptible) stdout print: a consumer
+    # piping the text through `head` must still get its files.
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1)
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(merge_chrome(procs), f)
+    print(format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

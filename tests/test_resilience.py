@@ -132,6 +132,20 @@ def test_chaos_sigterm_preempts_then_resumes_bit_identical(
     mgr = ckptlib.CheckpointManager(str(tmp_path))
     assert mgr.latest_step() == 4
     mgr.close()
+    # Preemption is an abnormal exit: the flight recorder must hold the
+    # incident (ISSUE 7) — graceful-path dump, schema-clean, with the
+    # chaos fire and the preemption marker on the timeline.
+    record = json.load(
+        open(os.path.join(str(tmp_path), "flight_recorder_p0.json"))
+    )
+    assert record["reason"] == "preempted"
+    assert record["step"] == 4
+    assert _load_script("check_metrics_schema").check_flight_record(
+        record
+    ) == []
+    names = [e["name"] for e in record["events"]]
+    assert "chaos/sigterm_at_step" in names
+    assert "train/preempted" in names
 
     second = trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
     assert not second.preempted
@@ -442,6 +456,23 @@ def test_nan_rollback_skips_exactly_one_batch_unfused(mesh8, tmp_path):
         for line in open(os.path.join(str(tmp_path), "metrics.jsonl"))
     ]
     assert rows[-1]["rollbacks"] == 1.0 and rows[-1]["skipped_batches"] == 1.0
+    # A rollback is an abnormal event even though the run survives: the
+    # flight recorder holds the divergence → restore → skip sequence
+    # (ISSUE 7), schema-clean, with the restored step in the marker.
+    record = json.load(
+        open(os.path.join(str(tmp_path), "flight_recorder_p0.json"))
+    )
+    assert record["reason"] == "rollback"
+    assert _load_script("check_metrics_schema").check_flight_record(
+        record
+    ) == []
+    by_name = {}
+    for e in record["events"]:
+        by_name.setdefault(e["name"], e)
+    assert "chaos/nan_at_step" in by_name
+    assert "train/divergence" in by_name
+    assert by_name["train/rollback"]["args"]["offender_start"] == 3
+    assert "train/skip_batches" in by_name
 
 
 def test_nan_rollback_skips_exactly_offending_chunk_fused(mesh8, tmp_path):

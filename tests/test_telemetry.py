@@ -87,8 +87,16 @@ def test_hot_loop_overhead_under_5us_per_step():
     """The full per-step recording set (one timer record, one counter inc,
     one gauge set) plus a snapshot every 100 steps — the real cadence —
     must amortize under 5 µs/step on CPU, or telemetry would tax the very
-    step time it measures."""
+    step time it measures.  Tracing rides the SAME budget: the loop runs
+    with a live tracer at the default ring size and adds the per-step
+    trace events fit's hot path produces — the data-wait span's trace
+    mirror (what ``registry.span`` emits beyond the timer record already
+    counted here) and the per-chunk ``train/chunk`` complete event — so
+    the flight recorder cannot quietly re-tax the step path."""
     reg = telemetry.MetricsRegistry()
+    reg.trace = telemetry.Tracer(
+        capacity=configlib.ExperimentConfig.trace_ring_events
+    )
     t = reg.timer(telemetry.STEP_TIME)
     c = reg.counter("steps")
     g = reg.gauge(telemetry.HOST_QUEUE_DEPTH)
@@ -104,9 +112,16 @@ def test_hot_loop_overhead_under_5us_per_step():
             t.record(1e-4)
             c.inc()
             g.set(i & 7)
+            # The span's trace-emission increment (its timer record is
+            # the t.record above) + the per-chunk event, args included.
+            reg.trace.complete(telemetry.DATA_WAIT, 1e-4)
+            reg.trace.complete(
+                "train/chunk", 1e-4, args={"start": i, "k": 1}
+            )
             if i % 100 == 0:
                 reg.snapshot()
         best = min(best, (time.perf_counter() - t0) / N)
+    assert reg.trace.emitted == 3 * 2 * N  # both sites really traced
     assert best < 5e-6, f"telemetry hot-loop cost {best*1e6:.2f} µs/step"
 
 
@@ -369,6 +384,7 @@ def test_smoke_train_produces_telemetry_artifacts(mesh8, tmp_path):
         global_batch_size=32,
         log_every_steps=10,
         checkpoint_every_secs=10_000.0,
+        trace_export=True,
     )
     trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
 
@@ -405,6 +421,19 @@ def test_smoke_train_produces_telemetry_artifacts(mesh8, tmp_path):
         text=True,
     )
     assert proc.returncode == 0, proc.stderr
+
+    # Event tracing (default ring) leaves its accounting in the report
+    # and — with trace_export on — a Perfetto-loadable per-process
+    # trace; a CLEAN exit leaves no flight-recorder dump.
+    snap = report["metrics"]
+    assert snap["trace/events"] > 0
+    assert snap["trace/dropped"] >= 0
+    trace = json.load(open(tmp_path / "trace_p0.json"))
+    names = {e["name"] for e in trace["traceEvents"]}
+    for expected in ("fit/entry", "fit/end", "train/chunk",
+                     "train/compile", "checkpoint/save"):
+        assert expected in names, expected
+    assert not os.path.exists(tmp_path / "flight_recorder_p0.json")
 
 
 def test_schema_lint_catches_violations(tmp_path):

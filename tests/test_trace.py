@@ -1,0 +1,408 @@
+"""Flight recorder / event tracer (telemetry/trace.py) and the fleet
+timeline merger (scripts/fleet_report.py): ring semantics, Chrome-trace
+well-formedness, flight-record schema (against the lint's validator),
+the signal watcher's at-arrival dump, the chaos kill's dump-before-kill
+ordering, heartbeat step/phase payloads, and cross-host incident /
+relaunch / skew attribution — all cheap unit tests (no fits)."""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from distributed_tensorflow_models_tpu import resilience, telemetry
+from distributed_tensorflow_models_tpu.resilience import chaos as chaoslib
+from distributed_tensorflow_models_tpu.resilience import heartbeat
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _load_script(name):
+    from importlib import util as importutil
+
+    spec = importutil.spec_from_file_location(
+        name, os.path.join(_SCRIPTS, f"{name}.py")
+    )
+    mod = importutil.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------------------
+# Ring semantics
+# --------------------------------------------------------------------------
+
+
+def test_ring_overwrites_oldest_and_counts_drops():
+    t = telemetry.Tracer(capacity=4)
+    for i in range(10):
+        t.instant("e", {"i": i})
+    events = t.events()
+    assert len(events) == 4  # bounded
+    assert [e["args"]["i"] for e in events] == [6, 7, 8, 9]  # newest kept
+    assert t.emitted == 10
+    assert t.dropped == 6
+
+
+def test_disabled_tracer_records_nothing():
+    t = telemetry.Tracer(capacity=8, enabled=False)
+    t.instant("a")
+    t.complete("b", 0.1)
+    with t.span("c"):
+        pass
+    assert t.events() == []
+    assert t.emitted == 0
+    assert not telemetry.NULL_TRACER.enabled
+
+
+def test_span_and_complete_durations():
+    t = telemetry.Tracer(capacity=8)
+    with t.span("work", {"k": 1}):
+        time.sleep(0.01)
+    t.complete("fixed", 2.5, args={"x": 1})
+    by_name = {e["name"]: e for e in t.events()}
+    assert by_name["work"]["ph"] == "X"
+    assert by_name["work"]["dur_s"] >= 0.01
+    assert by_name["work"]["args"] == {"k": 1}
+    assert by_name["fixed"]["dur_s"] == 2.5
+    # complete() backdates the start to now - dur.
+    assert by_name["fixed"]["ts_mono"] < by_name["work"]["ts_mono"] + 10
+
+
+def test_events_are_chronological_and_mono_per_thread():
+    t = telemetry.Tracer(capacity=64)
+
+    def emit(n):
+        for i in range(n):
+            t.instant("x", {"i": i})
+
+    threads = [threading.Thread(target=emit, args=(10,)) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    events = t.events()
+    monos = [e["ts_mono"] for e in events]
+    assert monos == sorted(monos)
+    per_tid: dict = {}
+    for e in events:
+        assert per_tid.get(e["tid"], -1) <= e["ts_mono"]
+        per_tid[e["tid"]] = e["ts_mono"]
+
+
+# --------------------------------------------------------------------------
+# Registry attachment
+# --------------------------------------------------------------------------
+
+
+def test_registry_span_emits_trace_event_when_attached():
+    reg = telemetry.MetricsRegistry()
+    with reg.span("checkpoint/save"):  # default: NULL tracer, no events
+        pass
+    tracer = telemetry.Tracer(capacity=8)
+    reg.trace = tracer
+    with reg.span("checkpoint/save"):
+        pass
+    events = tracer.events()
+    assert [e["name"] for e in events] == ["checkpoint/save"]
+    assert events[0]["ph"] == "X"
+    # The timer recorded both spans; the trace only the attached one.
+    assert reg.snapshot()["checkpoint/save/count"] == 2
+
+
+# --------------------------------------------------------------------------
+# Chrome export + flight record (schema-checked by the lint's validator)
+# --------------------------------------------------------------------------
+
+
+def test_chrome_export_well_formed(tmp_path):
+    t = telemetry.Tracer(capacity=16, process_index=3)
+    t.instant("chaos/kill_at_step", {"step": 3})
+    with t.span("train/data_wait"):
+        pass
+    path = str(tmp_path / "trace.json")
+    t.dump_chrome(path)
+    doc = json.loads(open(path).read())
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"]["process_index"] == 3
+    assert doc["otherData"]["os_pid"] == os.getpid()
+    events = doc["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta and meta[0]["args"]["name"] == "p3"
+    real = [e for e in events if e["ph"] != "M"]
+    assert all(e["pid"] == 3 for e in real)
+    instants = [e for e in real if e["ph"] == "i"]
+    completes = [e for e in real if e["ph"] == "X"]
+    assert instants and instants[0]["s"] == "t"
+    assert completes and completes[0]["dur"] >= 0
+    assert all(isinstance(e["ts"], float) for e in real)
+
+
+def test_flight_record_passes_schema_lint(tmp_path):
+    lint = _load_script("check_metrics_schema")
+    reg = telemetry.MetricsRegistry()
+    tracer = telemetry.Tracer(capacity=16, process_index=1)
+    reg.trace = tracer
+    reg.counter("train/restarts").inc()
+    with reg.span("checkpoint/fence"):
+        pass
+    tracer.instant("train/rollback", {"restored_step": 2})
+    path = str(tmp_path / "flight_recorder_p1.json")
+    tracer.dump_flight_record(path, "rollback", reg, extra={"step": 4})
+    record = json.loads(open(path).read())
+    assert lint.check_flight_record(record) == []
+    assert record["reason"] == "rollback"
+    assert record["step"] == 4
+    assert record["process_index"] == 1
+    assert record["registry"]["train/restarts"] == 1.0
+    # The CLI path agrees with the library call.
+    assert lint.main([path, "--flight-recorder"]) == 0
+
+
+def test_flight_record_schema_catches_violations():
+    lint = _load_script("check_metrics_schema")
+    tracer = telemetry.Tracer(capacity=4)
+    tracer.instant("a")
+    good = tracer.flight_record("crash")
+    assert lint.check_flight_record(good) == []
+
+    missing = dict(good)
+    del missing["reason"]
+    assert any("reason" in e for e in lint.check_flight_record(missing))
+
+    overflow = dict(good)
+    overflow["events"] = [dict(good["events"][0])] * 10  # > capacity 4
+    assert any("capacity" in e for e in lint.check_flight_record(overflow))
+
+    backwards = json.loads(json.dumps(good))
+    e0 = dict(backwards["events"][0])
+    e1 = dict(e0)
+    e1["ts_mono"] = e0["ts_mono"] - 1.0  # same tid, mono regression
+    backwards["events"] = [e0, e1]
+    assert any(
+        "backwards" in e for e in lint.check_flight_record(backwards)
+    )
+
+    bad_dur = json.loads(json.dumps(good))
+    bad_dur["events"] = [
+        {**e0, "ph": "X", "dur_s": -1.0}
+    ]
+    assert any("dur_s" in e for e in lint.check_flight_record(bad_dur))
+
+
+def test_metrics_schema_trace_prefix_nonnegative():
+    lint = _load_script("check_metrics_schema")
+    bad = [json.dumps({"step": 1, "time": 1.0, "trace/dropped": -1})]
+    errors, _, _ = lint.check_lines(bad)
+    assert any("trace" in e for e in errors)
+    good = [json.dumps({"step": 1, "time": 1.0, "trace/dropped": 7})]
+    errors, _, _ = lint.check_lines(good)
+    assert not errors
+
+
+# --------------------------------------------------------------------------
+# FlightWatcher: dump at signal ARRIVAL (main thread not required to run)
+# --------------------------------------------------------------------------
+
+
+def test_flight_watcher_dumps_on_sigterm_arrival():
+    """The watcher's contract: the dump fires off the wakeup fd when the
+    signal lands — the graceful chunk-boundary poll is NOT involved (a
+    host wedged in a dead peer's collective never reaches it)."""
+    dumped = []
+    done = threading.Event()
+
+    def dump(reason):
+        dumped.append(reason)
+        done.set()
+
+    # A Python-level handler must exist for the C handler (and so the
+    # wakeup fd write) to be armed — same order fit uses: listener
+    # first, watcher second.
+    listener = resilience.PreemptionListener()
+    assert listener.install()
+    watcher = telemetry.FlightWatcher(dump)
+    try:
+        assert watcher.install()
+        signal.raise_signal(signal.SIGTERM)
+        assert done.wait(5.0), "watcher never dumped"
+        assert dumped == [f"signal_{int(signal.SIGTERM)}"]
+        assert listener.preempted  # the listener still saw the notice
+    finally:
+        watcher.stop()
+        listener.uninstall()
+    assert not any(
+        t.name == "flight-watch" for t in threading.enumerate()
+    )
+
+
+def test_flight_watcher_install_off_main_thread_refuses():
+    results = []
+
+    def run():
+        w = telemetry.FlightWatcher(lambda r: None)
+        results.append(w.install())
+
+    th = threading.Thread(target=run)
+    th.start()
+    th.join()
+    assert results == [False]
+
+
+# --------------------------------------------------------------------------
+# Chaos kill: forensics BEFORE the SIGKILL
+# --------------------------------------------------------------------------
+
+
+def test_kill_hook_dumps_flight_record_before_sigkill(
+    tmp_path, monkeypatch
+):
+    calls = []
+    inj = chaoslib.ChaosInjector(
+        chaoslib.ChaosConfig(kill_at_step=3), scope=str(tmp_path)
+    )
+    inj._process_index = 0  # the target host, no jax needed
+    tracer = telemetry.Tracer(capacity=16)
+    inj.tracer = tracer
+    inj.flight_dump = lambda reason: calls.append(("dump", reason))
+    monkeypatch.setattr(os, "kill", lambda *a: calls.append(("kill", a)))
+
+    hook = inj.kill_hook()
+    assert hook.wants_step(3)
+    hook.after_step(None, {}, 3)
+    assert [c[0] for c in calls] == ["dump", "kill"]  # dump strictly first
+    assert calls[0][1] == "chaos_kill"
+    fires = [e for e in tracer.events() if e["name"] == "chaos/kill_at_step"]
+    assert fires and fires[0]["args"] == {"step": 3}
+    # Durable marker written (the at-most-once contract is unchanged).
+    assert inj._kill_fired()
+
+
+# --------------------------------------------------------------------------
+# Heartbeat payload: step + phase
+# --------------------------------------------------------------------------
+
+
+def test_heartbeat_payload_carries_step_and_phase(tmp_path):
+    w = heartbeat.HeartbeatWriter(str(tmp_path), 0, interval_s=0.05)
+    try:
+        w.start()
+        w.beat(7)
+        prev = w.set_phase("save")
+        assert prev == "init"
+        w._write()
+        view = heartbeat.read_fleet(str(tmp_path), 1)[0]
+        assert view["step"] == 7
+        assert view["phase"] == "save"
+        assert w.set_phase(prev) == "save"  # scoped restore contract
+    finally:
+        w.stop()
+
+
+# --------------------------------------------------------------------------
+# fleet_report: merged timeline, incident + relaunch + skew attribution
+# --------------------------------------------------------------------------
+
+
+def _make_fleet_workdir(tmp_path) -> str:
+    """Synthesize a 2-host kill incident: p1 killed at step 3 (flight
+    record from os pid 111), both hosts relaunched (trace exports from
+    different os pids), p1 lagging p0 by 2 steps mid-run."""
+    workdir = str(tmp_path)
+    os.makedirs(workdir, exist_ok=True)
+    t0 = time.time()
+
+    def chunk(tr, start, k, t, dur=0.05):
+        tr.complete(
+            "train/chunk", dur, ts_wall=t, ts_mono=t - t0 + 100.0,
+            args={"start": start, "k": k},
+        )
+
+    # p1: the victim.  Chunks to step 3, the kill fire, the dump.
+    t1 = telemetry.Tracer(capacity=64, process_index=1)
+    for s in range(3):
+        chunk(t1, s, 1, t0 + 0.2 * s)
+    t1.instant("chaos/kill_at_step", {"step": 3})
+    rec1 = t1.flight_record("chaos_kill", extra={"step": 3})
+    rec1["pid"] = 111
+    rec1["ts_wall"] = t0 + 0.7
+    with open(os.path.join(workdir, "flight_recorder_p1.json"), "w") as f:
+        json.dump(rec1, f)
+
+    # p0: the survivor — SIGTERM'd by the supervisor, dumped at arrival.
+    t0p = telemetry.Tracer(capacity=64, process_index=0)
+    for s in range(5):
+        chunk(t0p, s, 1, t0 + 0.15 * s)
+    t0p.complete("train/data_wait", 0.4, ts_wall=t0 + 0.75)
+    rec0 = t0p.flight_record("signal_15", extra={"step": 5})
+    rec0["pid"] = 100
+    rec0["ts_wall"] = t0 + 0.9
+    with open(os.path.join(workdir, "flight_recorder_p0.json"), "w") as f:
+        json.dump(rec0, f)
+
+    # Relaunch traces (the completed second run) from NEW os pids.
+    for proc, tracer, pid in ((0, t0p, 200), (1, t1, 222)):
+        chrome = tracer.to_chrome()
+        chrome["otherData"]["os_pid"] = pid
+        with open(
+            os.path.join(workdir, f"trace_p{proc}.json"), "w"
+        ) as f:
+            json.dump(chrome, f)
+    return workdir
+
+
+def test_fleet_report_names_killed_host_and_relaunch(tmp_path):
+    fr = _load_script("fleet_report")
+    workdir = _make_fleet_workdir(tmp_path)
+    report = fr.build_report(workdir, min_span_ms=100.0)
+    assert report["processes"] == [0, 1]
+
+    by_proc = {e["proc"]: e for e in report["incidents"]}
+    assert by_proc[1]["reason"] == "chaos_kill"
+    assert by_proc[1]["step"] == 3
+    assert by_proc[1]["relaunched"] is True
+    assert by_proc[1]["relaunch_os_pid"] == 222
+    assert by_proc[0]["reason"] == "signal_15"
+
+    # Step skew: p0 reached 5 while p1 stopped at 3.
+    skew = report["step_skew"]
+    assert skew["lag"] == 2
+    assert skew["laggard"] == 1 and skew["leader"] == 0
+
+    # Stall attribution: p0's 0.4s data wait is the only long span.
+    assert report["stalls"]["first"]["proc"] == 0
+    assert report["stalls"]["first"]["name"] == "train/data_wait"
+
+    text = fr.format_report(report)
+    assert "KILLED" in text and "p1" in text
+    assert "relaunched" in text
+
+    # The merged Chrome trace stays loadable and rebases time.
+    merged = fr.merge_chrome(fr.load_artifacts(workdir))
+    json.dumps(merged)
+    real = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+    assert {e["pid"] for e in real} == {0, 1}
+    assert min(e["ts"] for e in real) == pytest.approx(0.0, abs=1.0)
+
+
+def test_fleet_report_cli_smoke(tmp_path, capsys):
+    fr = _load_script("fleet_report")
+    workdir = _make_fleet_workdir(tmp_path / "wd")
+    chrome_out = str(tmp_path / "fleet.json")
+    json_out = str(tmp_path / "report.json")
+    assert (
+        fr.main([workdir, "--chrome", chrome_out, "--json", json_out]) == 0
+    )
+    out = capsys.readouterr().out
+    assert "KILLED" in out
+    assert json.load(open(json_out))["incidents"]
+    assert json.load(open(chrome_out))["traceEvents"]
+
+
+def test_fleet_report_empty_workdir(tmp_path, capsys):
+    fr = _load_script("fleet_report")
+    assert fr.main([str(tmp_path)]) == 0
+    assert "no per-process artifacts" in capsys.readouterr().out
