@@ -1,0 +1,16 @@
+"""Static analysis for the distributed_tensorflow_models_tpu repo.
+
+``analysis.dtmlint`` is a dependency-free, AST-based invariant checker
+encoding the contracts this codebase has already paid to learn at
+runtime: collective lockstep (no one-host deadlocks), int32-only
+collective wire values, jax-free supervisor modules, thread/signal
+discipline, determinism of everything feeding checkpointed state, and
+the metric-key registry.  ``scripts/dtm_lint.py`` is the CLI;
+``tests/test_lint.py`` pins the package clean (modulo
+``analysis/baseline.json``) in tier-1.
+
+Stdlib-only by design — the checker itself lives inside the jax-free
+zone it enforces.
+"""
+
+from analysis import dtmlint  # noqa: F401

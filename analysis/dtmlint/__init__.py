@@ -1,0 +1,35 @@
+"""dtmlint — AST-based invariant checker for this repo.
+
+Public API::
+
+    from analysis.dtmlint import repo_config, run, load_baseline
+
+    result = run(repo_config("/path/to/repo"),
+                 baseline=load_baseline(".../baseline.json"))
+    assert result.ok
+
+Everything is stdlib-only and nothing under lint is ever imported —
+files are parsed with :mod:`ast`, so fixtures containing deliberate
+deadlock shapes or forbidden imports are safe to check.
+"""
+
+from analysis.dtmlint.core import (  # noqa: F401
+    BASELINE_VERSION,
+    Finding,
+    LintConfig,
+    LintError,
+    LintResult,
+    PARSE_ERROR,
+    Project,
+    UNUSED_SUPPRESSION,
+    apply_baseline,
+    load_baseline,
+    run,
+    write_baseline,
+)
+from analysis.dtmlint.config import (  # noqa: F401
+    DEFAULT_BASELINE,
+    JAX_FREE_ROOTS,
+    repo_config,
+    strict_config,
+)

@@ -1,0 +1,143 @@
+"""Shared AST helpers for dtmlint rules (stdlib ``ast`` only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+# Methods/functions whose call IS a cross-host collective in this repo:
+# the Consensus primitives plus raw multihost allgather.  Rules key on
+# the *name*, not the receiver — every one of these names is reserved
+# for collectives in this codebase.
+COLLECTIVE_CALLS = frozenset(
+    {"broadcast_int", "allgather_int", "any_flag", "process_allgather"}
+)
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """The called attribute/function name (``x.y.z(...)`` -> ``"z"``)."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_in_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Like ``ast.walk`` but does not descend into nested function /
+    lambda scopes (their bodies run at *call* time, not here)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(child))
+
+
+def identifiers(node: ast.AST) -> Iterator[str]:
+    """Every Name id and Attribute attr in the subtree (same scope)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            yield n.id
+        elif isinstance(n, ast.Attribute):
+            yield n.attr
+
+
+def collective_calls(node: ast.AST) -> list[ast.Call]:
+    """Collective calls in the subtree, excluding nested scopes."""
+    out = []
+    for n in walk_in_scope(node):
+        if isinstance(n, ast.Call) and call_name(n) in COLLECTIVE_CALLS:
+            out.append(n)
+    return out
+
+
+def fold_int(node: ast.AST) -> Optional[int]:
+    """Constant-fold an integer expression (``2**62``, ``-(1 << 40)``,
+    arithmetic on int literals).  None when not a compile-time int."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool) or not isinstance(node.value, int):
+            return None
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = fold_int(node.operand)
+        return None if v is None else -v
+    if isinstance(node, ast.BinOp):
+        left, right = fold_int(node.left), fold_int(node.right)
+        if left is None or right is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.Pow):
+                # Cap the exponent: lint must never be the thing that
+                # hangs computing someone's 10**10**10 typo.
+                if abs(right) > 256:
+                    return None
+                return left ** right
+            if isinstance(node.op, ast.LShift):
+                if right > 512:
+                    return None
+                return left << right
+            if isinstance(node.op, ast.FloorDiv) and right != 0:
+                return left // right
+        except (OverflowError, ValueError):
+            return None
+    return None
+
+
+def scopes(tree: ast.Module) -> Iterator[ast.AST]:
+    """The module plus every (nested) function definition."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def const_int_assignments(scope: ast.AST) -> dict:
+    """``{name: int}`` for simple foldable assignments in this scope
+    (nested scopes excluded).  A later non-constant rebind removes the
+    name — only names that are *unambiguously* big constants report."""
+    out: dict[str, Optional[int]] = {}
+    for n in walk_in_scope(scope):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(
+            n.targets[0], ast.Name
+        ):
+            out[n.targets[0].id] = fold_int(n.value)
+        elif isinstance(n, ast.AugAssign) and isinstance(n.target, ast.Name):
+            out[n.target.id] = None
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def terminates(body: list) -> bool:
+    """True when a statement list unconditionally leaves the enclosing
+    block (return/raise/continue/break as its last statement)."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Continue, ast.Break)):
+        return True
+    if isinstance(last, ast.Expr) and isinstance(last.value, ast.Call):
+        name = call_name(last.value)
+        dn = dotted_name(last.value.func)
+        return name == "exit" or dn in ("sys.exit", "os._exit")
+    return False

@@ -1,0 +1,114 @@
+"""Repo-specific lint configuration.
+
+Two entry points:
+
+- :func:`repo_config` — the real tree: walks the package, ``scripts/``
+  and ``analysis/``, and wires each rule's scope to the modules that
+  motivated it (see ISSUE 9 / README "Static analysis").
+- :func:`strict_config` — explicit file lists (fixtures, ad-hoc CLI
+  paths): every given file is treated as maximally in-scope for every
+  rule, so known-bad snippets trip their rule without needing to mirror
+  the repo layout.
+"""
+
+from __future__ import annotations
+
+import os
+
+from analysis.dtmlint.core import LintConfig
+
+PACKAGE = "distributed_tensorflow_models_tpu"
+
+# Modules that must stay importable on a supervisor host with no
+# accelerator stack installed.  KNOBS.md documents the same list.
+JAX_FREE_ROOTS = (
+    f"{PACKAGE}/launch.py",
+    f"{PACKAGE}/resilience/backoff.py",
+    f"{PACKAGE}/resilience/heartbeat.py",
+)
+
+# Modules whose behaviour feeds checkpointed state, dataset cursors, or
+# replay decisions — wall-clock / unseeded randomness here breaks the
+# bit-identical-recovery contract.
+DETERMINISM_SCOPE = (
+    f"{PACKAGE}/data/datasets.py",
+    f"{PACKAGE}/data/tfrecord.py",
+    f"{PACKAGE}/data/augment.py",
+    f"{PACKAGE}/data/pipeline.py",
+    f"{PACKAGE}/core/train_loop.py",
+    f"{PACKAGE}/resilience/chaos.py",
+    f"{PACKAGE}/parallel/async_ps.py",
+    f"{PACKAGE}/parallel/backup.py",
+    f"{PACKAGE}/harness/generate.py",
+)
+
+METRIC_REGISTRY = f"{PACKAGE}/telemetry/registry.py"
+
+DEFAULT_BASELINE = "analysis/baseline.json"
+
+_LINT_DIRS = (PACKAGE, "scripts", "analysis")
+
+
+def _walk_py(root: str) -> list:
+    rels = []
+    for d in _LINT_DIRS:
+        top = os.path.join(root, d)
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(
+                x for x in dirnames if x != "__pycache__"
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    rels.append(rel.replace(os.sep, "/"))
+    return rels
+
+
+def repo_config(root: str) -> LintConfig:
+    """Lint configuration for the actual repository at ``root``."""
+    files = _walk_py(root)
+    jax_free = list(JAX_FREE_ROOTS) + [
+        f for f in files
+        if f.startswith("scripts/") and f.count("/") == 1
+    ]
+    return LintConfig(
+        root=root,
+        files=tuple(files),
+        jax_free_roots=tuple(jax_free),
+        determinism_scope=DETERMINISM_SCOPE,
+        metric_registry=METRIC_REGISTRY,
+        module_namespaces=("",),
+    )
+
+
+def strict_config(paths, root: str) -> LintConfig:
+    """Maximal-scope configuration for an explicit file list.
+
+    ``paths`` are absolute or cwd-relative; they are re-expressed
+    relative to ``root`` (the common ancestor when linting fixtures).
+    Every file is in the determinism scope and — when it is not a
+    registry itself — in the jax-free zone, so each fixture exercises
+    its rule directly.
+    """
+    rels = []
+    namespaces = [""]
+    for p in paths:
+        ap = os.path.abspath(p)
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        rels.append(rel)
+        # Let fixture-local imports resolve: the file's directory and
+        # its parent both act as import namespaces, so both
+        # ``import helper`` and ``from fixturedir import helper`` find
+        # a sibling file.
+        parent = os.path.dirname(rel)
+        for ns in (parent, os.path.dirname(parent)):
+            if ns and ns not in namespaces:
+                namespaces.append(ns)
+    return LintConfig(
+        root=root,
+        files=tuple(rels),
+        jax_free_roots=tuple(rels),
+        determinism_scope=tuple(rels),
+        metric_registry=None,
+        module_namespaces=tuple(namespaces),
+    )

@@ -1,0 +1,338 @@
+"""dtmlint engine: source model, suppressions, baseline, runner.
+
+The engine parses every configured file once (``ast`` only — nothing is
+imported or executed, so fixtures and broken trees are safe to lint),
+hands the parsed project to each enabled rule, then filters the raw
+findings through inline suppressions and the committed baseline:
+
+- **Suppressions** — ``# dtmlint: disable=rule-id[,rule-id...]`` on the
+  offending line (or alone on the line directly above it) silences a
+  finding.  ``disable=all`` silences every rule on that line.  A
+  suppression that silences nothing is itself reported
+  (``unused-suppression``) so stale escapes cannot accumulate.
+- **Baseline** — ``analysis/baseline.json`` lists grandfathered
+  findings as exact ``(rule, path, line)`` entries.  Baselined findings
+  don't fail the run; entries that no longer match anything are
+  reported as *stale* (shrink the file).  The intended trajectory is
+  monotonically toward empty.
+
+Rules live in :mod:`analysis.dtmlint.rules` — one module per invariant,
+each exporting ``RULE_ID`` and ``check(project)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable, Optional, Sequence
+
+UNUSED_SUPPRESSION = "unused-suppression"
+PARSE_ERROR = "parse-error"
+
+_SUPPRESS_RE = re.compile(r"#\s*dtmlint:\s*disable=([A-Za-z0-9_*,\- ]+)")
+
+BASELINE_VERSION = 1
+
+
+class LintError(Exception):
+    """Configuration / baseline problems (not code findings)."""
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    path: str  # repo-relative, posix separators
+    line: int
+    rule: str
+    message: str
+
+    def key(self) -> tuple[str, str, int]:
+        return (self.rule, self.path, self.line)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int  # line the comment sits on
+    rules: frozenset  # rule ids, or {"*"} for disable=all
+    applies: frozenset  # line numbers this suppression covers
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed file: AST + raw lines + suppression comments."""
+
+    def __init__(self, abspath: str, rel: str, text: str):
+        self.path = abspath
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        self.suppressions = self._parse_suppressions()
+
+    def _parse_suppressions(self) -> list[Suppression]:
+        out: list[Suppression] = []
+        for lineno, raw in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            rules = frozenset(
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            )
+            if not rules:
+                continue
+            applies = {lineno}
+            # A standalone comment line covers the next line too, so a
+            # suppression can sit above a long statement.
+            if raw.strip().startswith("#"):
+                applies.add(lineno + 1)
+            out.append(
+                Suppression(
+                    line=lineno, rules=rules, applies=frozenset(applies)
+                )
+            )
+        return out
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        """True (and marks the suppression used) when ``rule`` at
+        ``line`` is silenced by an inline comment."""
+        hit = False
+        for sup in self.suppressions:
+            if line in sup.applies and (
+                rule in sup.rules or "*" in sup.rules or "all" in sup.rules
+            ):
+                sup.used = True
+                hit = True
+        return hit
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """What to lint and which file plays which role.
+
+    All paths are repo-relative (posix).  ``module_namespaces`` are
+    directories (``""`` = the root itself) whose children resolve as
+    top-level importable names for the import-graph walk.
+    """
+
+    root: str
+    files: tuple  # rel paths of every file to parse
+    jax_free_roots: tuple = ()  # rel paths proven jax-free transitively
+    forbidden_imports: tuple = ("jax", "jaxlib", "flax", "orbax")
+    determinism_scope: tuple = ()  # rel paths under determinism-hazard
+    metric_registry: Optional[str] = None  # rel path of key-constant module
+    module_namespaces: tuple = ("",)
+
+
+class Project:
+    """Parsed view of the configured tree, shared by every rule."""
+
+    def __init__(self, config: LintConfig):
+        self.config = config
+        self.files: list[SourceFile] = []
+        self.parse_failures: list[Finding] = []
+        for rel in config.files:
+            abspath = os.path.join(config.root, rel)
+            try:
+                with open(abspath, encoding="utf-8") as f:
+                    text = f.read()
+                self.files.append(SourceFile(abspath, rel, text))
+            except (OSError, SyntaxError, ValueError) as e:
+                line = getattr(e, "lineno", None) or 1
+                self.parse_failures.append(
+                    Finding(rel, int(line), PARSE_ERROR, f"cannot lint: {e}")
+                )
+        self.by_rel = {sf.rel: sf for sf in self.files}
+        # name -> rel path, for the import-graph walk.  Built over every
+        # configured namespace so fixture trees resolve like the repo.
+        self.module_map: dict[str, str] = {}
+        for ns in config.module_namespaces:
+            prefix = "" if not ns else ns.rstrip("/") + "/"
+            for sf in self.files:
+                if not sf.rel.startswith(prefix):
+                    continue
+                sub = sf.rel[len(prefix):]
+                if not sub.endswith(".py"):
+                    continue
+                dotted = sub[:-3].replace("/", ".")
+                if dotted.endswith(".__init__"):
+                    dotted = dotted[: -len(".__init__")]
+                elif dotted == "__init__":
+                    continue
+                self.module_map.setdefault(dotted, sf.rel)
+
+    def resolve_module(self, dotted: str) -> Optional[str]:
+        """Rel path for a dotted module name, or None if external."""
+        return self.module_map.get(dotted)
+
+
+# --------------------------------------------------------------------------
+# Baseline
+# --------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> list[Finding]:
+    """Parse a baseline file, raising :class:`LintError` on any shape
+    problem — a malformed baseline must fail CI, not silently
+    grandfather everything."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError as e:
+        raise LintError(f"cannot read baseline {path}: {e}") from e
+    except ValueError as e:
+        raise LintError(f"baseline {path} is not valid JSON: {e}") from e
+    if not isinstance(data, dict):
+        raise LintError(f"baseline {path}: top level must be an object")
+    if data.get("version") != BASELINE_VERSION:
+        raise LintError(
+            f"baseline {path}: unsupported version {data.get('version')!r} "
+            f"(expected {BASELINE_VERSION})"
+        )
+    entries = data.get("findings")
+    if not isinstance(entries, list):
+        raise LintError(f"baseline {path}: 'findings' must be a list")
+    out: list[Finding] = []
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict):
+            raise LintError(f"baseline {path}: entry {i} is not an object")
+        missing = [k for k in ("rule", "path", "line") if k not in e]
+        if missing:
+            raise LintError(
+                f"baseline {path}: entry {i} missing keys {missing}"
+            )
+        if not isinstance(e["line"], int) or isinstance(e["line"], bool):
+            raise LintError(f"baseline {path}: entry {i} line not an int")
+        out.append(
+            Finding(
+                str(e["path"]), e["line"], str(e["rule"]),
+                str(e.get("message", "")),
+            )
+        )
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {
+        "version": BASELINE_VERSION,
+        "findings": [f.to_json() for f in sorted(findings)],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Sequence[Finding]
+) -> tuple[list[Finding], list[Finding], list[Finding]]:
+    """Split into ``(new, grandfathered, stale_baseline_entries)``."""
+    base_keys = {b.key() for b in baseline}
+    new = [f for f in findings if f.key() not in base_keys]
+    old = [f for f in findings if f.key() in base_keys]
+    live = {f.key() for f in findings}
+    stale = [b for b in baseline if b.key() not in live]
+    return new, old, stale
+
+
+# --------------------------------------------------------------------------
+# Runner
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    new: list  # findings that fail the run
+    baselined: list  # grandfathered by the baseline file
+    stale_baseline: list  # baseline entries matching nothing (shrink it)
+    enabled: tuple  # rule ids that ran
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "rules": list(self.enabled),
+            "findings": [f.to_json() for f in sorted(self.new)],
+            "baselined": len(self.baselined),
+            "stale_baseline": [f.to_json() for f in self.stale_baseline],
+        }
+
+
+def run(
+    config: LintConfig,
+    *,
+    only: Optional[Iterable[str]] = None,
+    disable: Iterable[str] = (),
+    baseline: Optional[Sequence[Finding]] = None,
+) -> LintResult:
+    """Lint the configured tree and return the filtered result."""
+    from analysis.dtmlint import rules as rules_pkg
+
+    all_rules = rules_pkg.ALL_RULES
+    known = {rid for rid, _ in all_rules} | {UNUSED_SUPPRESSION}
+    requested = set(only) if only is not None else set(known)
+    for rid in list(requested) + list(disable):
+        if rid not in known:
+            raise LintError(
+                f"unknown rule {rid!r} (known: {', '.join(sorted(known))})"
+            )
+    enabled = requested - set(disable)
+
+    project = Project(config)
+    raw: list[Finding] = list(project.parse_failures)
+    for rule_id, check in all_rules:
+        if rule_id in enabled:
+            raw.extend(check(project))
+
+    kept: list[Finding] = []
+    for f in raw:
+        sf = project.by_rel.get(f.path)
+        if f.rule != PARSE_ERROR and sf is not None and sf.suppressed(
+            f.line, f.rule
+        ):
+            continue
+        kept.append(f)
+
+    if UNUSED_SUPPRESSION in enabled:
+        for sf in project.files:
+            for sup in sf.suppressions:
+                if sup.used:
+                    continue
+                # Only complain about suppressions whose rules actually
+                # ran — disabling a rule must not flip its suppressions
+                # to "unused".
+                named = sup.rules - {"*", "all"}
+                if named and not (named & enabled):
+                    continue
+                kept.append(
+                    Finding(
+                        sf.rel,
+                        sup.line,
+                        UNUSED_SUPPRESSION,
+                        "suppression silences nothing "
+                        f"(rules: {', '.join(sorted(sup.rules))}); "
+                        "remove it",
+                    )
+                )
+
+    new, old, stale = apply_baseline(kept, baseline or [])
+    return LintResult(
+        new=sorted(new),
+        baselined=sorted(old),
+        stale_baseline=sorted(stale),
+        enabled=tuple(sorted(enabled)),
+    )

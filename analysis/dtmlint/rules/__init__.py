@@ -1,0 +1,20 @@
+"""Rule registry.  Each rule module exports ``RULE_ID`` and
+``check(project) -> Iterable[Finding]``."""
+
+from analysis.dtmlint.rules import (
+    determinism,
+    jaxfree,
+    lockstep,
+    metric_keys,
+    threads,
+    wire,
+)
+
+ALL_RULES = [
+    (lockstep.RULE_ID, lockstep.check),
+    (wire.RULE_ID, wire.check),
+    (jaxfree.RULE_ID, jaxfree.check),
+    (threads.RULE_ID, threads.check),
+    (determinism.RULE_ID, determinism.check),
+    (metric_keys.RULE_ID, metric_keys.check),
+]

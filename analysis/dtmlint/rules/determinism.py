@@ -1,0 +1,102 @@
+"""determinism-hazard — no ambient entropy in replayed state.
+
+The bit-identical-recovery contract says: restore a checkpoint, replay
+the same steps, get the same bytes.  That dies the moment anything
+feeding checkpointed state, dataset cursors, or replay decisions reads
+a wall clock or an unseeded RNG — the restored run sees different
+values than the original did.  In the scoped modules (data pipeline /
+cursors, the train loop, chaos scheduling, the async-PS and backup
+paths) this rule forbids:
+
+- wall-clock reads: ``time.time`` / ``time.time_ns`` /
+  ``time.monotonic`` / ``time.monotonic_ns`` (``time.perf_counter`` is
+  the allowlisted telemetry-timing primitive — it measures durations,
+  its value never flows into state);
+- ambient entropy: ``os.urandom``, ``uuid.uuid1/uuid4``,
+  ``secrets.*``;
+- process-global RNGs: any ``random.*`` call, any ``np.random.*``
+  module-level call;
+- unseeded RNG construction: ``np.random.RandomState()`` /
+  ``np.random.default_rng()`` with no seed (seeded constructors are the
+  sanctioned pattern — every existing site passes ``config.seed``).
+
+Out-of-scope modules (telemetry, harness supervision) may use wall
+clocks freely; this rule only runs over ``determinism_scope``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.dtmlint.astutil import dotted_name
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "determinism-hazard"
+
+_FORBIDDEN_EXACT = {
+    "time.time": "wall clock",
+    "time.time_ns": "wall clock",
+    "time.monotonic": "process-relative clock (differs across restore)",
+    "time.monotonic_ns": "process-relative clock (differs across restore)",
+    "os.urandom": "ambient entropy",
+    "uuid.uuid1": "ambient entropy",
+    "uuid.uuid4": "ambient entropy",
+}
+
+_SEEDABLE_CTORS = frozenset(
+    {"RandomState", "default_rng", "Generator", "PCG64", "Philox"}
+)
+
+
+def _has_seed(call: ast.Call) -> bool:
+    if any(not isinstance(a, ast.Starred) for a in call.args):
+        return True
+    return any(
+        kw.arg in ("seed", "key") or kw.arg is None for kw in call.keywords
+    )
+
+
+def _classify(call: ast.Call):
+    """``(why, detail)`` when the call is a hazard, else None."""
+    dn = dotted_name(call.func)
+    if dn is None:
+        return None
+    if dn in _FORBIDDEN_EXACT:
+        return dn, _FORBIDDEN_EXACT[dn]
+    parts = dn.split(".")
+    if parts[0] == "secrets":
+        return dn, "ambient entropy"
+    if parts[0] == "random" and len(parts) == 2:
+        return dn, "process-global RNG (unseeded across restore)"
+    if len(parts) >= 3 and parts[0] in ("np", "numpy") and (
+        parts[1] == "random"
+    ):
+        tail = parts[2]
+        if tail in _SEEDABLE_CTORS:
+            if _has_seed(call):
+                return None
+            return dn, "unseeded RNG constructor"
+        return dn, "module-level global RNG"
+    return None
+
+
+def check(project: Project):
+    scope = set(project.config.determinism_scope)
+    for sf in project.files:
+        if sf.rel not in scope:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = _classify(node)
+            if hit is None:
+                continue
+            dn, why = hit
+            yield Finding(
+                sf.rel,
+                node.lineno,
+                RULE_ID,
+                f"`{dn}` ({why}) in a determinism-scoped module; "
+                "values here feed checkpointed state or replay "
+                "decisions — derive from step/seed instead",
+            )

@@ -1,0 +1,172 @@
+"""jax-free-zone — supervisor-side modules must not import jax.
+
+``launch.py``, ``resilience/backoff.py``, ``resilience/heartbeat.py``
+and every ``scripts/*.py`` run on supervisor hosts (and in the drill
+parent process) where the accelerator stack may not exist — and where
+importing jax would initialise a backend, pin memory, and race the
+child it is about to spawn.  The sanctioned pattern is a *function-
+level* lazy import (see ``launch.py``); what this rule forbids is any
+**module-level** path from a jax-free root to ``jax`` / ``jaxlib`` /
+``flax`` / ``orbax``, even transitively through the package's own
+modules and the ``__init__.py`` files that execute on the way in.
+
+Module-level means: top-level statements, including those inside
+``if`` / ``try`` / ``with`` blocks and class bodies (all execute at
+import time), excluding function bodies and ``if TYPE_CHECKING:``
+blocks (which never execute).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "jax-free-zone"
+
+_NON_EXEC = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_type_checking(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id == "TYPE_CHECKING":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "TYPE_CHECKING":
+            return True
+    return False
+
+
+def _module_level_imports(tree: ast.Module) -> Iterator[ast.stmt]:
+    """Import statements that execute when the module is imported."""
+    stack: List[ast.AST] = [tree]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _NON_EXEC):
+                continue
+            if isinstance(child, ast.If) and _is_type_checking(child.test):
+                continue
+            if isinstance(child, (ast.Import, ast.ImportFrom)):
+                yield child
+            else:
+                stack.append(child)
+
+
+def _ancestor_inits(rel: str, project: Project) -> List[str]:
+    """``__init__.py`` files that execute when ``rel`` is imported."""
+    out = []
+    parts = rel.split("/")
+    for i in range(1, len(parts)):
+        init = "/".join(parts[:i]) + "/__init__.py"
+        if init in project.by_rel and init != rel:
+            out.append(init)
+    return out
+
+
+def _rel_to_dotted(project: Project) -> Dict[str, str]:
+    return {rel: dotted for dotted, rel in project.module_map.items()}
+
+
+def _edges(
+    rel: str, project: Project, dotted_of: Dict[str, str]
+) -> List[Tuple[str, int, Optional[str]]]:
+    """``(target_rel_or_None, lineno, forbidden_root_or_None)`` for every
+    module-level import edge out of ``rel``."""
+    sf = project.by_rel.get(rel)
+    if sf is None:
+        return []
+    forbidden = project.config.forbidden_imports
+    edges: List[Tuple[str, int, Optional[str]]] = []
+
+    def classify(dotted: str, lineno: int) -> None:
+        root = dotted.split(".")[0]
+        if root in forbidden:
+            edges.append(("", lineno, root))
+            return
+        target = project.resolve_module(dotted)
+        if target is not None:
+            edges.append((target, lineno, None))
+
+    for stmt in _module_level_imports(sf.tree):
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                classify(alias.name, stmt.lineno)
+        elif isinstance(stmt, ast.ImportFrom):
+            if stmt.level:
+                me = dotted_of.get(rel)
+                if me is None:
+                    continue
+                parts = me.split(".")
+                # A package's __init__ is one level "shallower" than a
+                # plain module for the purposes of relative imports.
+                drop = stmt.level - (
+                    1 if rel.endswith("__init__.py") else 0
+                )
+                if drop >= len(parts):
+                    continue
+                base = parts[: len(parts) - drop] if drop else parts
+                prefix = ".".join(base)
+                mod = (
+                    f"{prefix}.{stmt.module}" if stmt.module else prefix
+                )
+            else:
+                mod = stmt.module or ""
+            if not mod:
+                continue
+            classify(mod, stmt.lineno)
+            # ``from pkg import sub`` may bind submodules — chase each
+            # name that resolves to a module of ours.
+            for alias in stmt.names:
+                if alias.name == "*":
+                    continue
+                sub = f"{mod}.{alias.name}"
+                if sub.split(".")[0] in forbidden or (
+                    project.resolve_module(sub) is not None
+                ):
+                    classify(sub, stmt.lineno)
+    return edges
+
+
+def check(project: Project):
+    dotted_of = _rel_to_dotted(project)
+    edge_cache: Dict[str, List[Tuple[str, int, Optional[str]]]] = {}
+    reported = set()
+
+    for root in project.config.jax_free_roots:
+        if root not in project.by_rel:
+            continue
+        # Importing the root executes its ancestor packages first.
+        queue: List[Tuple[str, Tuple[str, ...]]] = [(root, (root,))]
+        for init in _ancestor_inits(root, project):
+            queue.append((init, (root, init)))
+        seen = {rel for rel, _ in queue}
+        while queue:
+            rel, chain = queue.pop(0)
+            if rel not in edge_cache:
+                edge_cache[rel] = _edges(rel, project, dotted_of)
+            for target, lineno, bad in edge_cache[rel]:
+                if bad is not None:
+                    key = (rel, lineno, bad)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    via = (
+                        " -> ".join(chain)
+                        if len(chain) > 1
+                        else chain[0]
+                    )
+                    yield Finding(
+                        rel,
+                        lineno,
+                        RULE_ID,
+                        f"module-level `{bad}` import reachable from "
+                        f"jax-free root {root} (import chain: {via}); "
+                        "use a function-level lazy import",
+                    )
+                    continue
+                hops = [target] + _ancestor_inits(target, project)
+                for hop in hops:
+                    if hop not in seen:
+                        seen.add(hop)
+                        queue.append((hop, chain + (hop,)))

@@ -1,0 +1,159 @@
+"""collective-lockstep — collectives must be reachable on every host.
+
+The deadlock shape this rule catches: a ``Consensus`` collective
+(``broadcast_int`` / ``allgather_int`` / ``any_flag``) or raw
+``process_allgather`` sitting under a branch whose predicate varies
+*per process* (chief checks, process_index / rank / pid comparisons,
+chaos host selection).  One host enters the collective, its peers never
+do, and the fleet hangs until the watchdog fires — PR 4's chief-decides
+consensus exists precisely because this class of bug shipped.
+
+Fleet-uniform predicates (``nproc > 1``, ``process_count``,
+``consensus.active``, ``world_size``) are fine: every host evaluates
+them identically, so every host takes the same path.
+
+Flagged shapes, for an ``if`` whose test mentions a per-process
+identifier:
+
+1. one branch performs a collective and the other (possibly absent)
+   branch performs none;
+2. neither branch performs a collective, but one branch exits the
+   function early (``return``/``break``/``continue``) and a collective
+   follows the ``if`` in the same scope — the exiting hosts never reach
+   it.
+
+Collectives *inside the test itself* are evaluated before the branch
+and are therefore always uniform — not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from analysis.dtmlint.astutil import (
+    call_name,
+    collective_calls,
+    identifiers,
+    terminates,
+    walk_in_scope,
+)
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "collective-lockstep"
+
+# Identifiers whose value differs between hosts of one fleet.  Matching
+# is by bare name or attribute name, so ``self._is_chief``,
+# ``jax.process_index()`` and ``os.getpid()`` all register.
+PER_PROCESS = frozenset(
+    {
+        "is_chief",
+        "_is_chief",
+        "chief",
+        "process_index",
+        "process_id",
+        "getpid",
+        "pid",
+        "rank",
+        "_rank",
+        "local_rank",
+        "host_id",
+        "host_index",
+        "task_id",
+        "chaos_host",
+        "target_host",
+        "is_coordinator",
+    }
+)
+
+
+def _per_process_test(test: ast.AST) -> List[str]:
+    return sorted(set(identifiers(test)) & PER_PROCESS)
+
+
+def _scopes(tree: ast.Module) -> Iterator[ast.AST]:
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _collectives_after(scope: ast.AST, stmt: ast.If) -> List[ast.Call]:
+    """Collectives lexically after ``stmt`` in the same statement list."""
+    out: List[ast.Call] = []
+    for node in walk_in_scope(scope):
+        body = getattr(node, "body", None)
+        for attr in ("body", "orelse", "finalbody"):
+            seq = getattr(node, attr, None)
+            if isinstance(seq, list) and stmt in seq:
+                idx = seq.index(stmt)
+                for later in seq[idx + 1:]:
+                    out.extend(collective_calls(later))
+                return out
+    # top-level statement list of the scope itself
+    seq = getattr(scope, "body", [])
+    if stmt in seq:
+        idx = seq.index(stmt)
+        for later in seq[idx + 1:]:
+            out.extend(collective_calls(later))
+    return out
+
+
+def check(project: Project):
+    for sf in project.files:
+        for scope in _scopes(sf.tree):
+            for node in walk_in_scope(scope):
+                if not isinstance(node, ast.If):
+                    continue
+                markers = _per_process_test(node.test)
+                if not markers:
+                    continue
+                in_body = [
+                    c
+                    for stmt in node.body
+                    for c in collective_calls(stmt)
+                ]
+                in_orelse = [
+                    c
+                    for stmt in node.orelse
+                    for c in collective_calls(stmt)
+                ]
+                why = f"per-process condition ({', '.join(markers)})"
+                if bool(in_body) != bool(in_orelse):
+                    # The collective-free side may still reach a
+                    # collective by falling through to one after the
+                    # `if` — that's the matched shape, not a deadlock.
+                    empty_side = node.orelse if in_body else node.body
+                    falls_through = not (
+                        empty_side and terminates(empty_side)
+                    )
+                    if falls_through and _collectives_after(scope, node):
+                        continue
+                    bad = (in_body or in_orelse)[0]
+                    yield Finding(
+                        sf.rel,
+                        bad.lineno,
+                        RULE_ID,
+                        f"collective `{call_name(bad)}` under {why} at "
+                        f"line {node.lineno} has no matching collective "
+                        "on the other path; hosts that skip this branch "
+                        "never enter it (one-host deadlock)",
+                    )
+                    continue
+                if in_body or in_orelse:
+                    continue
+                exits_body = terminates(node.body)
+                exits_orelse = bool(node.orelse) and terminates(node.orelse)
+                if exits_body == exits_orelse:
+                    continue
+                later = _collectives_after(scope, node)
+                if later:
+                    yield Finding(
+                        sf.rel,
+                        node.lineno,
+                        RULE_ID,
+                        f"early exit under {why} skips collective "
+                        f"`{call_name(later[0])}` at line "
+                        f"{later[0].lineno}; exiting hosts never reach "
+                        "it (one-host deadlock)",
+                    )

@@ -1,0 +1,84 @@
+"""metric-key-registry — metric names live in one place.
+
+``scripts/check_metrics_schema.py`` validates emitted telemetry against
+the key constants in ``telemetry/registry.py``; a string literal passed
+straight to ``registry.counter/gauge/timer/span`` bypasses that schema
+entirely — the metric exists in code, the schema lint never hears of
+it, and dashboards silently reference a key nobody validates.  This
+rule requires every string literal flowing into those four methods to
+match a declared key constant (UPPERCASE module-level string
+assignment in the registry module).  Passing the constant itself
+(``reg.counter(telemetry.RESTARTS)``) is the sanctioned pattern and is
+not string-checkable — variables are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict
+
+from analysis.dtmlint.astutil import call_name
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "metric-key-registry"
+
+REGISTRY_METHODS = frozenset({"counter", "gauge", "timer", "span"})
+
+
+def declared_keys_from_source(text: str) -> Dict[str, str]:
+    """``{key_string: CONSTANT_NAME}`` for every UPPERCASE module-level
+    string assignment in the given source."""
+    out: Dict[str, str] = {}
+    tree = ast.parse(text)
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Name) and tgt.id.isupper()):
+            continue
+        if isinstance(node.value, ast.Constant) and isinstance(
+            node.value.value, str
+        ):
+            out[node.value.value] = tgt.id
+    return out
+
+
+def _declared(project: Project) -> Dict[str, str]:
+    reg = project.config.metric_registry
+    if reg is not None:
+        sf = project.by_rel.get(reg)
+        return declared_keys_from_source(sf.text) if sf else {}
+    # Strict/fixture mode: any UPPERCASE string constant anywhere in the
+    # linted set counts as declared.
+    out: Dict[str, str] = {}
+    for sf in project.files:
+        out.update(declared_keys_from_source(sf.text))
+    return out
+
+
+def check(project: Project):
+    declared = _declared(project)
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in REGISTRY_METHODS:
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+            ):
+                continue
+            if arg.value in declared:
+                continue
+            yield Finding(
+                sf.rel,
+                arg.lineno,
+                RULE_ID,
+                f"metric key literal {arg.value!r} is not declared in "
+                "the telemetry key registry; add a constant there and "
+                "pass it instead (schema lint can't see ad-hoc keys)",
+            )

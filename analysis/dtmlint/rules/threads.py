@@ -1,0 +1,144 @@
+"""thread-discipline — explicit daemonhood, reachable joins, guarded
+signal installation.
+
+Two bug classes motivate this rule.  First, threads whose daemonhood is
+whatever the default happened to be: a non-daemon watcher keeps a dead
+fit's process alive, a daemon IO thread gets killed mid-write.  Every
+``threading.Thread(...)`` must pass ``daemon=`` explicitly, and the
+thread handle must have a reachable ``.join(...)`` somewhere in the
+same module (the harness thread-leak guard catches the rest at
+runtime).  Second, ``signal.signal`` / ``signal.set_wakeup_fd`` raise
+``ValueError`` when called off the main thread — PR 7's FlightWatcher
+learned this the hard way — so each such call must be preceded, in the
+same scope, by a main-thread check (any mention of ``main_thread`` /
+``current_thread``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+from analysis.dtmlint.astutil import dotted_name
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "thread-discipline"
+
+_GUARDED_SIGNAL_CALLS = frozenset({"signal.signal", "signal.set_wakeup_fd"})
+_MAIN_THREAD_MARKERS = frozenset(
+    {"main_thread", "current_thread", "MainThread", "_MAIN_THREAD"}
+)
+
+
+def _thread_ctor(node: ast.Call) -> bool:
+    dn = dotted_name(node.func)
+    return dn == "threading.Thread" or dn == "Thread"
+
+
+def _binding_of(tree: ast.Module, call: ast.Call) -> Optional[str]:
+    """Dotted name the Thread() result is bound to, if any."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and node.value is call:
+            if len(node.targets) == 1:
+                return dotted_name(node.targets[0])
+        if isinstance(node, ast.AnnAssign) and node.value is call:
+            return dotted_name(node.target)
+    return None
+
+
+def _join_receivers(tree: ast.Module) -> Iterator[Tuple[str, ast.Call]]:
+    """Dotted receiver of every ``X.join(...)`` that could plausibly be
+    a thread join (excludes ``os.path.join`` and string ``sep.join``)."""
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+        ):
+            continue
+        recv = node.func.value
+        if isinstance(recv, ast.Constant):
+            continue  # "sep".join(...)
+        dn = dotted_name(recv)
+        if dn is None or dn == "os.path" or dn.endswith(".path"):
+            continue
+        yield dn, node
+
+
+def _enclosing_scope(tree: ast.Module, call: ast.Call) -> ast.AST:
+    best = tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if sub is call:
+                    best = node
+    return best
+
+
+def _main_thread_checked_before(scope: ast.AST, lineno: int) -> bool:
+    for node in ast.walk(scope):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if (
+            name in _MAIN_THREAD_MARKERS
+            and getattr(node, "lineno", lineno + 1) <= lineno
+        ):
+            return True
+    return False
+
+
+def check(project: Project):
+    for sf in project.files:
+        joins = list(_join_receivers(sf.tree))
+        join_names = {dn for dn, _ in joins}
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _thread_ctor(node):
+                kwargs = {kw.arg for kw in node.keywords}
+                if "daemon" not in kwargs:
+                    yield Finding(
+                        sf.rel,
+                        node.lineno,
+                        RULE_ID,
+                        "threading.Thread(...) without explicit "
+                        "daemon=; default daemonhood is a latent leak "
+                        "or a mid-write kill — choose one",
+                    )
+                bound = _binding_of(sf.tree, node)
+                if bound is not None:
+                    # self._t = Thread(...) joins as self._t.join() —
+                    # also accept a bare attribute-tail match so
+                    # handles joined through a local alias count.
+                    tail = bound.split(".")[-1]
+                    joined = bound in join_names or any(
+                        dn.split(".")[-1] == tail for dn in join_names
+                    )
+                else:
+                    # No handle (appended to a list, passed along):
+                    # accept any plausible thread join in the module.
+                    joined = bool(join_names)
+                if not joined:
+                    yield Finding(
+                        sf.rel,
+                        node.lineno,
+                        RULE_ID,
+                        "thread is never joined in this module; add a "
+                        "join/reap on the shutdown path (or suppress "
+                        "with a comment saying who reaps it)",
+                    )
+            dn = dotted_name(node.func)
+            if dn in _GUARDED_SIGNAL_CALLS:
+                scope = _enclosing_scope(sf.tree, node)
+                if not _main_thread_checked_before(scope, node.lineno):
+                    yield Finding(
+                        sf.rel,
+                        node.lineno,
+                        RULE_ID,
+                        f"`{dn}` without a preceding main-thread check "
+                        "in the same scope; it raises ValueError off "
+                        "the main thread",
+                    )

@@ -1,0 +1,110 @@
+"""int32-wire — values crossing the collective wire must fit int32.
+
+``process_allgather`` with x64 disabled silently truncates int64
+payloads; PR 5 shipped a ``2**62`` "no bad step" sentinel that came
+back as garbage on the other side and was only caught in a drill.  The
+fix pinned the sentinel to ``2**31 - 1`` and made the consensus
+backend range-check — this rule makes the contract static:
+
+- integer constants (including folded expressions like ``1 << 40`` and
+  names bound to such constants in the same or module scope) passed to
+  ``broadcast_int`` / ``allgather_int`` / ``any_flag`` /
+  ``process_allgather`` must lie within int32;
+- ``np.int64(...)`` / ``numpy.int64(...)`` must not flow into those
+  calls at all — widen at the destination, never on the wire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional
+
+from analysis.dtmlint.astutil import (
+    call_name,
+    const_int_assignments,
+    dotted_name,
+    fold_int,
+    walk_in_scope,
+    COLLECTIVE_CALLS,
+)
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "int32-wire"
+
+INT32_MIN = -(2**31)
+INT32_MAX = 2**31 - 1
+
+_INT64_CTORS = frozenset(
+    {"np.int64", "numpy.int64", "np.uint64", "numpy.uint64"}
+)
+
+
+def _arg_values(call: ast.Call) -> Iterator[ast.AST]:
+    for a in call.args:
+        if isinstance(a, ast.Starred):
+            yield a.value
+        else:
+            yield a
+    for kw in call.keywords:
+        if kw.value is not None:
+            yield kw.value
+
+
+def _scoped_consts(tree: ast.Module) -> Dict[ast.AST, Dict[str, int]]:
+    module_consts = const_int_assignments(tree)
+    out: Dict[ast.AST, Dict[str, int]] = {tree: module_consts}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local = dict(module_consts)
+            local.update(const_int_assignments(node))
+            out[node] = local
+    return out
+
+
+def _value_of(node: ast.AST, consts: Dict[str, int]) -> Optional[int]:
+    v = fold_int(node)
+    if v is not None:
+        return v
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def check(project: Project):
+    for sf in project.files:
+        scoped = _scoped_consts(sf.tree)
+        for scope, consts in scoped.items():
+            for node in walk_in_scope(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name not in COLLECTIVE_CALLS:
+                    continue
+                for arg in _arg_values(node):
+                    v = _value_of(arg, consts)
+                    if v is not None and not (INT32_MIN <= v <= INT32_MAX):
+                        src = (
+                            f"constant {v}"
+                            if fold_int(arg) is not None
+                            else f"`{arg.id}` = {v}"  # type: ignore[attr-defined]
+                        )
+                        yield Finding(
+                            sf.rel,
+                            arg.lineno,
+                            RULE_ID,
+                            f"{src} passed to `{name}` exceeds int32; "
+                            "the collective wire truncates it silently "
+                            "(use a sentinel <= 2**31 - 1)",
+                        )
+                    if isinstance(arg, ast.Call):
+                        ctor = dotted_name(arg.func)
+                        if ctor in _INT64_CTORS:
+                            yield Finding(
+                                sf.rel,
+                                arg.lineno,
+                                RULE_ID,
+                                f"`{ctor}(...)` passed to `{name}`; "
+                                "64-bit values are truncated on the "
+                                "collective wire — convert to int32 "
+                                "range first",
+                            )

@@ -437,6 +437,13 @@ def fit(
     t_run0 = time.perf_counter()
     registry = telemetry.MetricsRegistry()
     registry.counter(telemetry.RESTARTS).inc(restarts)
+    # Pre-create the other resilience counters (CKPT_FENCE precedent,
+    # checkpoint.py): a run that never rolled back must say so with an
+    # explicit zero in telemetry.json — absence is indistinguishable
+    # from the emission path silently breaking, and the schema lint's
+    # declared-coverage check rightly treats absence as a failure.
+    registry.counter(telemetry.ROLLBACKS)
+    registry.counter(telemetry.SKIPPED_BATCHES)
     # Structured event tracing + flight recorder (telemetry/trace.py,
     # README "Observability"): the run's tracer rides the registry, so
     # every component the registry already reaches (pipeline, step,

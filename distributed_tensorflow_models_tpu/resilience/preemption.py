@@ -102,6 +102,13 @@ class PreemptionListener:
         return True
 
     def uninstall(self) -> None:
+        # Restoring handlers raises ValueError off the main thread, and a
+        # watchdog/reaper thread *can* reach teardown: leave the handlers
+        # in place for the main thread to restore (or the process to die
+        # with) rather than half-clearing our bookkeeping.
+        if threading.current_thread() is not threading.main_thread():
+            log.debug("preemption uninstall skipped: not the main thread")
+            return
         for sig, prev in self._prev.items():
             try:
                 signal.signal(sig, prev)

@@ -47,6 +47,18 @@ carries the full telemetry key set (``data_wait_s``, ``step_time_s``,
 ``mfu``) — the TelemetryHook injects them together, so a partial set on
 any row is always an error.
 
+With ``--declared-coverage REGISTRY_PY`` the path is validated as a
+``telemetry.json`` goodput report instead: every metric key constant
+declared in the registry module (the same UPPERCASE-constant extraction
+``analysis/dtmlint``'s metric-key-registry rule uses) must appear in the
+report's ``metrics`` snapshot, exactly or as a ``key/...`` timer/family
+expansion.  This closes the declared-vs-emitted gap from the other
+side: the lint rule stops ad-hoc keys that the schema never heard of,
+this mode catches declared keys that no code path ever emits (dead
+constants, or a metric whose emission silently regressed).  Keys whose
+emission is legitimately load- or topology-dependent are excused with
+``--allow-missing PREFIX`` (repeatable).
+
 With ``--flight-recorder`` the path is validated as a flight-recorder
 dump (``<workdir>/flight_recorder_p<i>.json``, telemetry/trace.py)
 instead of a metrics file: required keys (``version``, ``reason``,
@@ -64,8 +76,11 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Iterable
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 REQUIRED_KEYS = ("step", "time")
 TELEMETRY_KEYS = ("data_wait_s", "step_time_s", "mfu")
@@ -304,6 +319,49 @@ def check_flight_record(record) -> list[str]:
     return errors
 
 
+# --------------------------------------------------------------------------
+# Declared-vs-emitted coverage (telemetry.json goodput reports)
+# --------------------------------------------------------------------------
+
+
+def declared_metric_keys(registry_path: str) -> dict[str, str]:
+    """``{key: CONSTANT_NAME}`` declared in the registry module, via the
+    same extraction dtm-lint's metric-key-registry rule trusts."""
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)
+    from analysis.dtmlint.rules.metric_keys import declared_keys_from_source
+
+    with open(registry_path, encoding="utf-8") as f:
+        return declared_keys_from_source(f.read())
+
+
+def check_declared_coverage(
+    report: dict,
+    declared: dict[str, str],
+    allow_missing: Iterable[str] = (),
+) -> list[str]:
+    """Declared keys absent from the report's ``metrics`` snapshot.
+
+    A key counts as emitted when it appears exactly (counters, gauges)
+    or as a ``key/...`` expansion (timer stats, gauge families).
+    """
+    errors: list[str] = []
+    snap = report.get("metrics") if isinstance(report, dict) else None
+    if not isinstance(snap, dict):
+        return ["report carries no 'metrics' snapshot object"]
+    prefixes = tuple(allow_missing)
+    for key in sorted(declared):
+        if key in snap or any(k.startswith(key + "/") for k in snap):
+            continue
+        if prefixes and key.startswith(prefixes):
+            continue
+        errors.append(
+            f"declared metric key {key!r} ({declared[key]}) never "
+            "emitted: dead constant, or its emission regressed"
+        )
+    return errors
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument(
@@ -328,7 +386,47 @@ def main(argv=None) -> int:
         help="validate the path as a flight-recorder dump "
         "(telemetry/trace.py schema) instead of a metrics file",
     )
+    p.add_argument(
+        "--declared-coverage",
+        metavar="REGISTRY_PY",
+        help="validate the path as a telemetry.json report instead: "
+        "every key constant declared in REGISTRY_PY must appear in its "
+        "'metrics' snapshot",
+    )
+    p.add_argument(
+        "--allow-missing",
+        action="append",
+        default=[],
+        metavar="PREFIX",
+        help="with --declared-coverage: excuse declared keys matching "
+        "this prefix (load/topology-dependent emission); repeatable",
+    )
     args = p.parse_args(argv)
+    if args.declared_coverage:
+        try:
+            with open(args.path) as f:
+                report = json.load(f)
+            declared = declared_metric_keys(args.declared_coverage)
+        except (OSError, ValueError, SyntaxError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        errors = check_declared_coverage(
+            report, declared, allow_missing=args.allow_missing
+        )
+        if errors:
+            for e in errors:
+                print(f"{args.path}: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"{args.path}: OK ({len(declared)} declared keys all emitted"
+            + (
+                f", {len(args.allow_missing)} allowed-missing prefixes"
+                if args.allow_missing
+                else ""
+            )
+            + ")"
+        )
+        return 0
     if args.flight_recorder:
         try:
             with open(args.path) as f:

@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""dtm-lint CLI — run the repo's AST invariant checker.
+
+Usage::
+
+    python scripts/dtm_lint.py                 # whole tree, baseline applied
+    python scripts/dtm_lint.py --json          # machine-readable output
+    python scripts/dtm_lint.py --only collective-lockstep,int32-wire
+    python scripts/dtm_lint.py --disable determinism-hazard
+    python scripts/dtm_lint.py path/a.py b.py  # explicit files, strict mode
+    python scripts/dtm_lint.py --write-baseline  # grandfather current findings
+
+Exit status: 0 when no new findings (baselined ones don't count),
+1 when there are new findings, 2 on configuration/baseline errors.
+
+Explicit file arguments switch to *strict* mode: every named file is
+treated as in-scope for every rule and the baseline is not applied —
+this is how the fixture tests drive single known-bad snippets.
+
+Suppress a single finding inline with ``# dtmlint: disable=RULE`` on
+the offending line (or alone on the line above); unused suppressions
+are themselves findings.  Stdlib-only; never imports the code it lints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from analysis.dtmlint import (  # noqa: E402
+    DEFAULT_BASELINE,
+    LintError,
+    load_baseline,
+    repo_config,
+    run,
+    strict_config,
+    write_baseline,
+)
+
+
+def _split(csv):
+    out = []
+    for chunk in csv or []:
+        out.extend(p.strip() for p in chunk.split(",") if p.strip())
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dtm_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="explicit files to lint in strict mode (default: whole tree)",
+    )
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--only", action="append", metavar="RULES",
+        help="comma-separated rule ids to run (repeatable)",
+    )
+    ap.add_argument(
+        "--disable", action="append", metavar="RULES",
+        help="comma-separated rule ids to skip (repeatable)",
+    )
+    ap.add_argument(
+        "--root", default=_REPO_ROOT,
+        help="repo root (default: parent of this script)",
+    )
+    ap.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help=f"baseline file (default: {DEFAULT_BASELINE}; "
+        "'none' disables)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    args = ap.parse_args(argv)
+
+    only = _split(args.only) or None
+    disable = _split(args.disable)
+
+    try:
+        if args.paths:
+            config = strict_config(args.paths, args.root)
+            baseline = None
+        else:
+            config = repo_config(args.root)
+            bl = args.baseline or DEFAULT_BASELINE
+            if bl == "none":
+                baseline = None
+            else:
+                bl_path = os.path.join(args.root, bl)
+                baseline = (
+                    load_baseline(bl_path)
+                    if os.path.exists(bl_path)
+                    else None
+                )
+        result = run(config, only=only, disable=disable, baseline=baseline)
+        if args.write_baseline:
+            if args.paths:
+                raise LintError(
+                    "--write-baseline only applies to whole-tree runs"
+                )
+            bl_path = os.path.join(args.root, args.baseline or DEFAULT_BASELINE)
+            write_baseline(bl_path, result.new + result.baselined)
+            print(
+                f"wrote {len(result.new) + len(result.baselined)} "
+                f"finding(s) to {bl_path}"
+            )
+            return 0
+    except LintError as e:
+        print(f"dtm-lint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        for f in result.new:
+            print(f.render())
+        for b in result.stale_baseline:
+            print(
+                f"note: stale baseline entry {b.path}:{b.line} "
+                f"[{b.rule}] — remove it"
+            )
+        n = len(result.new)
+        summary = (
+            f"dtm-lint: {n} new finding(s)"
+            if n
+            else "dtm-lint: clean"
+        )
+        if result.baselined:
+            summary += f" ({len(result.baselined)} baselined)"
+        if result.stale_baseline:
+            summary += f", {len(result.stale_baseline)} stale baseline entries"
+        print(summary)
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,7 @@ import argparse
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import textwrap
@@ -442,11 +443,36 @@ def main(argv=None) -> int:
         "--keep", action="store_true",
         help="keep the scratch dir (checkpoints, logs, results)",
     )
+    p.add_argument(
+        "--no-lint", action="store_true",
+        help="skip the dtm-lint pre-drill gate (debugging only: a tree "
+        "with new lockstep violations can deadlock the drills it is "
+        "supposed to certify)",
+    )
     args = p.parse_args(argv)
     wanted = [d.strip() for d in args.drills.split(",") if d.strip()]
     unknown = set(wanted) - set(DRILLS)
     if unknown:
         p.error(f"unknown drills {sorted(unknown)}; have {DRILLS}")
+
+    # Pre-drill gate: refuse to certify a tree that static analysis can
+    # already prove deadlock-prone — a one-host collective hangs the
+    # 2-process cluster until the grace timeout, wasting the whole drill
+    # budget to rediscover what the AST said for free.
+    if not args.no_lint:
+        lint = os.path.join(os.path.dirname(__file__), "dtm_lint.py")
+        proc = subprocess.run(
+            [sys.executable, lint], capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            print(proc.stdout, end="", file=sys.stderr)
+            print(
+                "fleet_drill: dtm-lint gate failed; fix the findings "
+                "(or rerun with --no-lint to debug anyway)",
+                file=sys.stderr,
+            )
+            return proc.returncode
+        print("dtm-lint gate: clean")
 
     scratch = args.scratch or tempfile.mkdtemp(prefix="dtm-fleet-drill-")
     os.makedirs(scratch, exist_ok=True)

@@ -1,0 +1,9 @@
+"""Known-bad: int64 values on the collective wire."""
+import numpy as np
+
+SENTINEL = 2 ** 62
+
+
+def publish(consensus):
+    consensus.broadcast_int(SENTINEL)
+    return consensus.allgather_int(np.int64(1))

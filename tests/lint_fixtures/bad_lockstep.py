@@ -1,0 +1,13 @@
+"""Known-bad: collectives reachable on only some hosts."""
+
+
+def chief_only(consensus, is_chief, value):
+    if is_chief:
+        return consensus.broadcast_int(value)
+    return None
+
+
+def early_exit(consensus, rank, flag):
+    if rank != 0:
+        return 0
+    return consensus.any_flag(flag)

@@ -1,0 +1,5 @@
+"""Known-bad: ad-hoc metric key literal, declared nowhere."""
+
+
+def publish(registry):
+    registry.counter("train/oops").inc(1)

@@ -1,0 +1,13 @@
+"""Known-bad: implicit daemonhood, no join, unguarded signal."""
+import signal
+import threading
+
+
+def start(worker):
+    t = threading.Thread(target=worker)
+    t.start()
+    return t
+
+
+def arm(handler):
+    signal.signal(signal.SIGTERM, handler)

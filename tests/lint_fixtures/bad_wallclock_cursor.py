@@ -1,0 +1,9 @@
+"""Known-bad: ambient entropy feeding a dataset cursor."""
+import random
+import time
+
+
+def next_cursor(cursor):
+    jitter = random.random()
+    stamp = time.time()
+    return cursor + jitter + stamp

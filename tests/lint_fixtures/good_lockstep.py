@@ -1,0 +1,20 @@
+"""Known-good twins: uniform predicates and matched branches."""
+
+
+def uniform_guard(consensus, nproc, value):
+    if nproc == 1:
+        return [value]
+    return consensus.allgather_int(value)
+
+
+def matched_fallthrough(consensus, is_chief, value):
+    if is_chief:
+        return consensus.broadcast_int(value)
+    return consensus.broadcast_int(0)
+
+
+def collective_in_test(consensus, is_chief, failed):
+    # The collective runs *before* the branch — every host enters it.
+    if consensus.any_flag(failed):
+        return "rollback" if is_chief else "wait"
+    return "ok"

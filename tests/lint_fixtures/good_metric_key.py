@@ -1,0 +1,7 @@
+"""Known-good: key declared as a registry constant."""
+
+STEP_TIME = "train/step_time"
+
+
+def publish(registry):
+    registry.timer("train/step_time")

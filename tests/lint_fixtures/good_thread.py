@@ -1,0 +1,15 @@
+"""Known-good twins: explicit daemon, joined handle, guarded signal."""
+import signal
+import threading
+
+
+def start_and_reap(worker):
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    t.join(timeout=5.0)
+    return t
+
+
+def arm(handler):
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGTERM, handler)

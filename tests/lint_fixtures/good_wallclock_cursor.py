@@ -1,0 +1,11 @@
+"""Known-good: seeded RNG, duration-only timing."""
+import time
+
+import numpy as np
+
+
+def next_cursor(cursor, seed):
+    rng = np.random.RandomState(seed)
+    start = time.perf_counter()
+    jitter = rng.random()
+    return cursor + jitter, time.perf_counter() - start

@@ -1,0 +1,7 @@
+"""Imports jax at module level — poison for the jax-free zone."""
+
+import jax
+
+
+def helper_value():
+    return jax.device_count()

@@ -1,0 +1,7 @@
+"""Known-bad: supervisor module transitively imports jax."""
+
+from jaxzone_bad import helper
+
+
+def supervise():
+    return helper.helper_value()

@@ -1,0 +1,11 @@
+"""Known-good: accelerator imports stay lazy or type-only."""
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    import jax
+
+
+def supervise():
+    import jax
+
+    return jax.device_count()

@@ -1,0 +1,12 @@
+"""Suppressions: one used (silences a real finding), one unused."""
+import time
+
+
+def next_cursor(cursor):
+    stamp = time.time()  # dtmlint: disable=determinism-hazard
+    return cursor + stamp
+
+
+# dtmlint: disable=int32-wire
+def nothing():
+    return 0

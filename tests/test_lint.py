@@ -1,0 +1,292 @@
+"""dtm-lint: engine semantics, per-rule fixtures, tree cleanliness.
+
+Three layers:
+
+- **Fixtures** (``tests/lint_fixtures/``): each rule has a minimal
+  known-bad snippet asserting exact rule id + line, and a known-good
+  twin asserting silence — the rule's contract, pinned.
+- **Engine**: suppression use/unuse, baseline well-formedness and
+  staleness, rule selection, error handling.
+- **Tree**: the whole package lints clean modulo ``analysis/
+  baseline.json`` (which starts — and must stay — empty), both through
+  the library API and the ``scripts/dtm_lint.py`` CLI with ``--json``.
+
+Everything here is pure AST work — no jax, no device, fast.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from analysis.dtmlint import (
+    LintError,
+    apply_baseline,
+    Finding,
+    load_baseline,
+    repo_config,
+    run,
+    strict_config,
+    write_baseline,
+)
+from analysis.dtmlint.config import DEFAULT_BASELINE, JAX_FREE_ROOTS
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "lint_fixtures")
+DTM_LINT = os.path.join(REPO_ROOT, "scripts", "dtm_lint.py")
+
+
+def lint_files(*names):
+    paths = [os.path.join(FIXTURES, n) for n in names]
+    return run(strict_config(paths, REPO_ROOT))
+
+
+# --------------------------------------------------------------------------
+# Per-rule fixtures: exact rule id + line on bad, silence on good
+# --------------------------------------------------------------------------
+
+BAD_EXPECT = {
+    "bad_lockstep.py": {("collective-lockstep", 6),
+                        ("collective-lockstep", 11)},
+    "bad_int64_wire.py": {("int32-wire", 8), ("int32-wire", 9)},
+    "bad_thread.py": {("thread-discipline", 7), ("thread-discipline", 13)},
+    "bad_wallclock_cursor.py": {("determinism-hazard", 7),
+                                ("determinism-hazard", 8)},
+    "bad_metric_key.py": {("metric-key-registry", 5)},
+}
+
+GOOD_FILES = [
+    "good_lockstep.py",
+    "good_int64_wire.py",
+    "good_thread.py",
+    "good_wallclock_cursor.py",
+    "good_metric_key.py",
+]
+
+
+@pytest.mark.parametrize("name", sorted(BAD_EXPECT))
+def test_bad_fixture_trips_its_rule(name):
+    result = lint_files(name)
+    got = {(f.rule, f.line) for f in result.new}
+    assert BAD_EXPECT[name] <= got, result.new
+    # ...and nothing from unrelated rules leaks in.
+    expected_rules = {r for r, _ in BAD_EXPECT[name]}
+    assert {f.rule for f in result.new} == expected_rules, result.new
+
+
+def test_bad_thread_flags_both_problems_on_ctor_line():
+    # Line 7 carries two distinct findings: implicit daemonhood and a
+    # handle that is never joined.
+    result = lint_files("bad_thread.py")
+    msgs = [f.message for f in result.new if f.line == 7]
+    assert len(msgs) == 2
+    assert any("daemon=" in m for m in msgs)
+    assert any("never joined" in m for m in msgs)
+
+
+@pytest.mark.parametrize("name", GOOD_FILES)
+def test_good_twin_is_silent(name):
+    result = lint_files(name)
+    assert result.new == [], result.new
+
+
+def test_jaxzone_bad_reports_transitive_chain():
+    result = lint_files("jaxzone_bad/supervisor.py", "jaxzone_bad/helper.py")
+    assert len(result.new) == 1, result.new
+    f = result.new[0]
+    assert f.rule == "jax-free-zone"
+    assert f.path.endswith("jaxzone_bad/helper.py")
+    assert f.line == 3
+    assert "supervisor.py" in f.message  # the chain names the root
+
+
+def test_jaxzone_good_lazy_and_type_only_imports_pass():
+    result = lint_files("jaxzone_good/supervisor.py")
+    assert result.new == [], result.new
+
+
+# --------------------------------------------------------------------------
+# Suppressions
+# --------------------------------------------------------------------------
+
+
+def test_used_suppression_silences_unused_suppression_reports():
+    result = lint_files("suppressed_ok.py")
+    assert [(f.rule, f.line) for f in result.new] == [
+        ("unused-suppression", 10)
+    ], result.new
+
+
+def test_disabling_a_rule_does_not_flip_its_suppressions_to_unused():
+    paths = [os.path.join(FIXTURES, "suppressed_ok.py")]
+    result = run(
+        strict_config(paths, REPO_ROOT),
+        disable=("determinism-hazard", "int32-wire"),
+    )
+    assert result.new == [], result.new
+
+
+# --------------------------------------------------------------------------
+# Rule selection and error handling
+# --------------------------------------------------------------------------
+
+
+def test_only_restricts_to_named_rules():
+    paths = [os.path.join(FIXTURES, "bad_thread.py")]
+    result = run(strict_config(paths, REPO_ROOT), only=["int32-wire"])
+    assert result.new == []
+    assert result.enabled == ("int32-wire",)
+
+
+def test_unknown_rule_is_a_config_error():
+    paths = [os.path.join(FIXTURES, "good_thread.py")]
+    with pytest.raises(LintError, match="unknown rule"):
+        run(strict_config(paths, REPO_ROOT), only=["no-such-rule"])
+
+
+def test_unparseable_file_is_a_finding_not_a_crash(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def oops(:\n")
+    result = run(strict_config([str(p)], str(tmp_path)))
+    assert [f.rule for f in result.new] == ["parse-error"]
+
+
+# --------------------------------------------------------------------------
+# Baseline
+# --------------------------------------------------------------------------
+
+
+def test_committed_baseline_is_well_formed_and_empty():
+    entries = load_baseline(os.path.join(REPO_ROOT, DEFAULT_BASELINE))
+    # The tree was fixed rather than grandfathered in the PR that
+    # introduced dtm-lint; new findings must be fixed, not baselined.
+    assert entries == []
+
+
+@pytest.mark.parametrize(
+    "payload",
+    [
+        "not json{",
+        '{"findings": []}',  # missing version
+        '{"version": 99, "findings": []}',
+        '{"version": 1, "findings": {}}',
+        '{"version": 1, "findings": [{"rule": "x"}]}',  # missing keys
+        '{"version": 1, "findings": [{"rule": "x", "path": "p", '
+        '"line": "7"}]}',  # line not an int
+    ],
+)
+def test_malformed_baseline_fails_loudly(tmp_path, payload):
+    p = tmp_path / "baseline.json"
+    p.write_text(payload)
+    with pytest.raises(LintError):
+        load_baseline(str(p))
+
+
+def test_baseline_roundtrip_grandfathers_and_reports_stale(tmp_path):
+    live = Finding("a.py", 3, "int32-wire", "m")
+    gone = Finding("b.py", 9, "int32-wire", "m")
+    p = tmp_path / "baseline.json"
+    write_baseline(str(p), [live, gone])
+    loaded = load_baseline(str(p))
+    new, old, stale = apply_baseline([live], loaded)
+    assert new == [] and old == [live] and stale == [gone]
+
+
+# --------------------------------------------------------------------------
+# The tree itself
+# --------------------------------------------------------------------------
+
+
+def test_tree_is_clean_modulo_baseline():
+    baseline = load_baseline(os.path.join(REPO_ROOT, DEFAULT_BASELINE))
+    result = run(repo_config(REPO_ROOT), baseline=baseline)
+    assert result.ok, "\n".join(f.render() for f in result.new)
+    assert result.stale_baseline == [], result.stale_baseline
+
+
+def test_jax_free_roots_exist():
+    # The zone list in config.py (cross-referenced from KNOBS.md) must
+    # track the tree — a renamed module silently dropping out of the
+    # walk would gut the rule.
+    for rel in JAX_FREE_ROOTS:
+        assert os.path.exists(os.path.join(REPO_ROOT, rel)), rel
+
+
+def test_cli_json_clean_on_tree():
+    proc = subprocess.run(
+        [sys.executable, DTM_LINT, "--json"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert "collective-lockstep" in payload["rules"]
+
+
+def test_cli_nonzero_with_rule_and_location_on_bad_fixture():
+    bad = os.path.join(FIXTURES, "bad_lockstep.py")
+    proc = subprocess.run(
+        [sys.executable, DTM_LINT, bad, "--json"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    found = {(f["rule"], f["line"]) for f in payload["findings"]}
+    assert ("collective-lockstep", 6) in found
+    # Text mode renders path:line: [rule] for operators and editors.
+    proc = subprocess.run(
+        [sys.executable, DTM_LINT, bad],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    assert "[collective-lockstep]" in proc.stdout
+    assert "bad_lockstep.py:6" in proc.stdout
+
+
+# --------------------------------------------------------------------------
+# Declared-vs-emitted coverage (check_metrics_schema --declared-coverage)
+# --------------------------------------------------------------------------
+
+
+def _load_schema_script():
+    from importlib import util as importutil
+
+    path = os.path.join(REPO_ROOT, "scripts", "check_metrics_schema.py")
+    spec = importutil.spec_from_file_location("check_metrics_schema", path)
+    mod = importutil.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_declared_coverage_flags_never_emitted_keys(tmp_path):
+    mod = _load_schema_script()
+    registry_py = tmp_path / "registry.py"
+    registry_py.write_text(
+        'STEP = "train/step"\nDEAD = "train/dead"\n'
+        'WAIT = "pipeline/wait"\n'
+    )
+    declared = mod.declared_metric_keys(str(registry_py))
+    assert declared == {
+        "train/step": "STEP",
+        "train/dead": "DEAD",
+        "pipeline/wait": "WAIT",
+    }
+    report = {"metrics": {"train/step": 1.0, "pipeline/wait/total_s": 0.2}}
+    errors = mod.check_declared_coverage(report, declared)
+    assert len(errors) == 1 and "train/dead" in errors[0]
+    # Timer/family expansion counts as emitted; allow-missing excuses.
+    assert mod.check_declared_coverage(
+        report, declared, allow_missing=["train/dead"]
+    ) == []
+    assert mod.check_declared_coverage({}, declared) == [
+        "report carries no 'metrics' snapshot object"
+    ]

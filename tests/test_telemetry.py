@@ -422,6 +422,28 @@ def test_smoke_train_produces_telemetry_artifacts(mesh8, tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
 
+    # Declared-vs-emitted coverage: every key constant in the telemetry
+    # registry must show up in this run's snapshot, except the
+    # explicitly feature/topology-gated ones (no chaos, no fleet
+    # supervisor, no sharded workers, no restore, no watchdog here).
+    registry_py = os.path.join(
+        os.path.dirname(SCHEMA_LINT), "..",
+        "distributed_tensorflow_models_tpu", "telemetry", "registry.py",
+    )
+    proc = subprocess.run(
+        [sys.executable, SCHEMA_LINT, str(tmp_path / "telemetry.json"),
+         "--declared-coverage", registry_py,
+         "--allow-missing", "chaos/",
+         "--allow-missing", "fleet/",
+         "--allow-missing", "checkpoint/restore",
+         "--allow-missing", "pipeline/reassembly_wait",
+         "--allow-missing", "pipeline/worker_busy",
+         "--allow-missing", "train/watchdog_last_progress_s"],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+
     # Event tracing (default ring) leaves its accounting in the report
     # and — with trace_export on — a Perfetto-loadable per-process
     # trace; a CLEAN exit leaves no flight-recorder dump.
