@@ -25,6 +25,7 @@ JAX_FREE_ROOTS = (
     f"{PACKAGE}/launch.py",
     f"{PACKAGE}/resilience/backoff.py",
     f"{PACKAGE}/resilience/heartbeat.py",
+    f"{PACKAGE}/serving/server.py",
 )
 
 # Modules whose behaviour feeds checkpointed state, dataset cursors, or

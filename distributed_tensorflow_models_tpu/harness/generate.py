@@ -24,17 +24,26 @@ import jax.numpy as jnp
 
 
 def _filter_logits(logits, top_k: int, top_p: float):
-    """Standard nucleus/top-k filtering, static-shaped (ONE descending
-    sort serves both filters — a vocab-size sort per generated token is
-    the dominant cost of this function inside the scan body)."""
+    """Standard nucleus/top-k filtering, static-shaped.
+
+    Nucleus filtering needs the full descending order (cumulative mass
+    over the whole row), but top-k alone only needs the k-th largest
+    VALUE — so the common top-k-only configuration takes a
+    ``lax.top_k`` partial selection, O(V·log k) instead of the full
+    O(V·log V) vocab sort, per generated token inside the scan body.
+    Both paths threshold the original row against the identical k-th
+    value, so the fast path is bit-identical to the sort path (pinned
+    in ``tests/test_generate.py``)."""
     if top_k <= 0 and top_p >= 1.0:
         return logits
+    # top_k >= vocab is a no-op (clamp, the standard convention).
+    k = min(top_k, logits.shape[-1]) if top_k > 0 else 0
+    if top_p >= 1.0:
+        kth = jax.lax.top_k(logits, k)[0][..., -1][..., None]
+        return jnp.where(logits < kth, -jnp.inf, logits)
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     if top_k > 0:
-        # top_k >= vocab is a no-op (clamp, the standard convention).
-        kth = sorted_logits[
-            ..., min(top_k, logits.shape[-1]) - 1
-        ][..., None]
+        kth = sorted_logits[..., k - 1][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
         # Mirror the mask into the sorted view so the nucleus pass below
         # computes its cumulative mass over the top-k-filtered

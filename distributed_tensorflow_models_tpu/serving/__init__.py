@@ -1,0 +1,41 @@
+"""Continuous-batching LM inference: the production serving path.
+
+The training side of this framework already decodes TPU-idiomatically
+(``harness/generate.py``: one compiled program, static shapes, KV cache
+updated in place) — but only one request at a time.  Serving traffic is
+many requests of different lengths arriving at different times, and
+running them serially wastes the accelerator: every decode step streams
+the full parameter set from HBM to produce ONE token.  This package
+implements Orca-style continuous batching (iteration-level scheduling)
+over a slotted KV cache — the fixed-shape cousin of vLLM's
+PagedAttention — so B in-flight requests share one batched decode
+dispatch per token and the weight stream amortizes B-fold.
+
+Layering (server → scheduler → engine → kv_slots; strictly one-way):
+
+- :mod:`kv_slots` — slot manager over a preallocated ``[max_slots, ...]``
+  KV arena.  Alloc/free are host-side index bookkeeping; every device
+  view of the arena is shape-stable, so the whole serving path compiles
+  exactly TWO programs (one prefill, one decode) regardless of traffic.
+- :mod:`engine` — ties the slotted arena to the existing transformer
+  decode path.  Chunked right-padded prefill, one vmapped single-token
+  decode step over all slots, and a traced sampling kernel that is
+  bit-identical to ``generate()``'s ``_filter_logits`` + ``_sample``
+  for every (temperature, top_k, top_p) — so batching NEVER changes a
+  request's token stream (pinned in ``tests/test_serving.py``).
+- :mod:`scheduler` — the admission/continuous-batching loop: pack
+  waiting prompts into free slots each iteration (bounded by
+  ``max_prefill_tokens``), one batched decode step for all active
+  slots, retire finished sequences and refill their slots mid-flight.
+  Records TTFT/TPOT/queue-depth/slot-occupancy into the telemetry
+  registry.
+- :mod:`server` — the stdlib-only front half (jax-free zone: importable
+  on a supervisor host with no accelerator stack): a thread-safe
+  request queue + worker thread, drain-on-SIGTERM via
+  ``resilience/preemption.py``, flight-recorder dump on drain, and the
+  file-queue replica mode ``scripts/serve_drill.py`` drives.
+
+This ``__init__`` deliberately imports nothing: ``server`` must stay
+importable without jax (the jax-free-zone lint walks ancestor
+``__init__`` files), so callers import submodules explicitly.
+"""

@@ -1,0 +1,341 @@
+"""Slotted inference engine: two compiled programs, bit-identical sampling.
+
+Ties the KV arena (:mod:`.kv_slots`) to the existing transformer decode
+path (``models/transformer_lm.py`` ``decode=True``) under two jitted
+programs whose shapes never depend on traffic:
+
+- **prefill** — one ``prefill_chunk``-token right-padded chunk of one
+  request's prompt into one slot (traced slot index), returning the
+  first sampled token when the chunk is the prompt's last.
+- **decode** — ONE batched dispatch for ALL slots: the unmodified B=1
+  single-token apply vmapped over the arena's slot axis, advanced
+  ``decode_burst`` tokens by an in-program ``lax.scan`` (each lane's
+  sample feeds straight back as its next input token, so the burst is
+  the same autoregressive recurrence ``generate()`` runs).  Every
+  in-flight request advances ``decode_burst`` tokens per dispatch, the
+  parameter stream from HBM amortizes over the whole batch, and the
+  per-dispatch host cost (launch, sync, lane bookkeeping) amortizes
+  over the burst — multi-step scheduling, the same lever vLLM's
+  ``--num-scheduler-steps`` pulls.  ``decode_burst=1`` (the default)
+  degrades to classic one-token iteration-level scheduling with the
+  lowest admission latency; the burst length is a construction-time
+  constant, so there is still exactly ONE decode program.
+
+``tests/test_serving.py`` pins ``_cache_size() == 1`` for both programs
+after a mixed workload: admission, retirement, and slot recycling are
+host bookkeeping and must never trigger a recompile.
+
+**Why right-padding is sound.**  A chunk shorter than ``prefill_chunk``
+is zero-padded on the right; the model writes garbage K/V at the padded
+positions.  Those positions are strictly after every real query position
+in the chunk, so causal masking hides them from the chunk's own logits;
+every later read happens only after a later chunk or a decode step has
+overwritten the position with real K/V (the cache write lands *before*
+attention in the apply).  Same argument covers a recycled slot's stale
+K/V from its previous request.  Counters are force-set to the real
+lengths around each apply (:func:`.kv_slots.set_counters`), and the
+returned logits row is read at the last REAL position — so padding
+never reaches sampling.  Admission must still respect the arena bound:
+the padded prompt (``ceil(len/chunk) * chunk`` positions) has to fit in
+``max_len``, or the final chunk's ``dynamic_update_slice`` would clamp
+backwards onto real positions — :meth:`InferenceEngine.check_fits`
+enforces it.
+
+**Bit-identity.**  :func:`sample_dynamic` recomputes ``generate()``'s
+``_filter_logits`` + ``_sample`` with (temperature, top_k, top_p) as
+*traced per-slot values* instead of Python statics, gated by
+``jnp.where`` so one compiled program serves every sampling mode.  Each
+gate is exact, not approximate: top_k off ⇒ threshold -inf masks
+nothing; top_p off ⇒ the nucleus mask is bypassed wholesale; greedy ⇒
+argmax of the unscaled row, same as ``_sample``.  Combined with the
+model's own padding invariance (decode attention always reduces over
+the full ``max_len`` cache with masked scores exactly zeroed — constant
+reduction length, so batch composition cannot move a single bit) and
+per-request keys precomputed as ``jax.random.split(rng, max_new)``
+(exactly ``generate()``'s schedule), a request's token stream is
+bit-identical to a solo ``generate()`` run regardless of what it was
+batched with — the serving contract ``tests/test_serving.py`` pins
+mode-by-mode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributed_tensorflow_models_tpu.serving import kv_slots
+from distributed_tensorflow_models_tpu.telemetry import registry as reglib
+
+
+def sample_dynamic(row, keydata, temperature, top_k, top_p, dtype):
+    """One sampling decision with TRACED sampling knobs, bit-identical to
+    ``generate.py``'s static ``_sample(_filter_logits(...))`` for every
+    knob setting (pinned in tests).
+
+    ``row`` is the unscaled float32 logits row ``[V]``; ``keydata`` the
+    raw ``jax.random.key_data`` row for this token (unused bits cost
+    nothing under the greedy gate).  Returns a scalar token of ``dtype``.
+    """
+    v = row.shape[-1]
+    safe_t = jnp.where(temperature > 0, temperature, jnp.float32(1.0))
+    # [1, V] to mirror generate()'s batch-of-one categorical exactly
+    # (same shape -> same sampling bits).
+    scaled = (row / safe_t)[None, :]
+    sorted_ = jnp.sort(scaled, axis=-1)[..., ::-1]
+    # top-k threshold: the k-th largest of the scaled row; disabled
+    # (top_k <= 0) degrades to a -inf threshold that masks nothing.
+    idx = (jnp.clip(top_k, 1, v) - 1).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_, idx[None, None], axis=-1)
+    kth = jnp.where(top_k > 0, kth, -jnp.inf)
+    filtered = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # Nucleus mass over the top-k-filtered distribution (sequential
+    # top-k-then-top-p semantics, as in _filter_logits).
+    sorted_m = jnp.where(sorted_ < kth, -jnp.inf, sorted_)
+    probs = jax.nn.softmax(sorted_m, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs < top_p).at[..., 0].set(True)
+    cutoff = jnp.min(
+        jnp.where(keep, sorted_m, jnp.inf), axis=-1, keepdims=True
+    )
+    filtered = jnp.where(
+        top_p < 1.0,
+        jnp.where(scaled < cutoff, -jnp.inf, filtered),
+        filtered,
+    )
+    key = jax.random.wrap_key_data(keydata)
+    sampled = jax.random.categorical(key, filtered, axis=-1)[0]
+    greedy = jnp.argmax(row[None, :], axis=-1)[0]
+    return jnp.where(temperature > 0, sampled, greedy).astype(dtype)
+
+
+class InferenceEngine:
+    """The device half of serving: arena + the two jitted programs.
+
+    ``model`` is the TRAINING-configured ``TransformerLM`` (re-cloned
+    here with ``decode=True``, like ``generate()``); ``params`` its
+    trained parameters.  The engine owns the arena and the
+    :class:`~.kv_slots.SlotManager`; the scheduler decides WHICH
+    requests occupy slots, the engine only moves tokens.
+
+    The arena is donated to both jitted programs, so each step updates
+    it in place (no second arena's worth of HBM) — callers must treat
+    ``self.arena`` as consumed across calls, which the engine does
+    internally by always rebinding it.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        max_slots: int = 8,
+        prefill_chunk: int = 32,
+        decode_burst: int = 1,
+        registry: Optional[reglib.MetricsRegistry] = None,
+    ):
+        if decode_burst < 1:
+            raise ValueError(
+                f"decode_burst must be >= 1, got {decode_burst}"
+            )
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        if prefill_chunk > model.max_len:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} exceeds model max_len "
+                f"{model.max_len}"
+            )
+        self.model = model
+        self.params = params
+        self.max_slots = int(max_slots)
+        self.prefill_chunk = int(prefill_chunk)
+        self.decode_burst = int(decode_burst)
+        self.max_len = int(model.max_len)
+        self.registry = registry if registry is not None else reglib.get_registry()
+        self.slots = kv_slots.SlotManager(max_slots)
+        self._decode_model = model.clone(decode=True, dropout_rate=0.0)
+        self.arena = kv_slots.make_arena(self._decode_model, max_slots)
+        # Key-material layout for this backend's PRNG impl (threefry:
+        # uint32[2] per key) — probed, not hardcoded, so an rbg/unsafe
+        # impl switch keeps working.
+        kd = np.asarray(jax.random.key_data(jax.random.key(0)))
+        self._key_shape = kd.shape
+        self._key_dtype = kd.dtype
+        self._prefill_j = jax.jit(self._prefill_fn, donate_argnums=(1,))
+        self._decode_j = jax.jit(self._decode_fn, donate_argnums=(1,))
+
+    # -- request bookkeeping helpers --------------------------------------
+
+    def padded_len(self, prompt_len: int) -> int:
+        """Arena positions a prompt occupies after right-padded chunking."""
+        c = self.prefill_chunk
+        return -(-prompt_len // c) * c
+
+    def check_fits(self, prompt_len: int, max_new_tokens: int) -> None:
+        """Admission bound: real tokens AND the padded prefill footprint
+        must fit in ``max_len`` (a clamped final-chunk write would
+        corrupt real positions — module docstring)."""
+        if prompt_len < 1:
+            raise ValueError("prompt must be non-empty")
+        total = prompt_len + max_new_tokens
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt {prompt_len} + new {max_new_tokens} exceeds "
+                f"max_len {self.max_len}"
+            )
+        if self.padded_len(prompt_len) > self.max_len:
+            raise ValueError(
+                f"padded prompt {self.padded_len(prompt_len)} "
+                f"(chunk {self.prefill_chunk}) exceeds max_len "
+                f"{self.max_len}"
+            )
+
+    def request_keys(self, rng, max_new_tokens: int) -> np.ndarray:
+        """Per-token key material, ``[max_new_tokens, *key_shape]`` —
+        exactly ``generate()``'s ``jax.random.split(rng, max_new)``
+        schedule, so token i of this request samples with the same key
+        solo decoding would have used."""
+        keys = jax.random.split(rng, max_new_tokens)
+        return np.asarray(jax.random.key_data(keys))
+
+    def zero_keys(self, max_new_tokens: int) -> np.ndarray:
+        """Placeholder key material for greedy requests (the categorical
+        branch is computed then discarded by the greedy gate)."""
+        return np.zeros(
+            (max_new_tokens,) + self._key_shape, self._key_dtype
+        )
+
+    # -- the two device programs ------------------------------------------
+
+    def _prefill_fn(self, params, arena, slot, tokens, start, new_len,
+                    keydata, temperature, top_k, top_p, last):
+        """One prompt chunk into one slot.  ``tokens`` is ``[1, chunk]``
+        right-padded; ``start``/``new_len`` the real positions before and
+        after; ``last`` the chunk-local index of the last real token
+        (its logits seed the first generated token on the final chunk —
+        the caller ignores the sample for earlier chunks)."""
+        cache = kv_slots.extract_slot(arena, slot)
+        cache = kv_slots.set_counters(cache, start)
+        (logits, _), mutated = self._decode_model.apply(
+            {"params": params, "cache": cache}, tokens,
+            train=False, mutable=["cache"],
+        )
+        cache = kv_slots.set_counters(mutated["cache"], new_len)
+        arena = kv_slots.write_slot(arena, cache, slot)
+        row = logits[0].astype(jnp.float32)[last]
+        tok = sample_dynamic(
+            row, keydata, temperature, top_k, top_p, jnp.int32
+        )
+        return arena, tok
+
+    def _decode_fn(self, params, arena, tokens, keydata, temperature,
+                   top_k, top_p):
+        """One batched decode dispatch: the unmodified B=1 single-token
+        apply vmapped over the slot axis, advanced ``decode_burst``
+        tokens by ``lax.scan`` — each lane's sampled token feeds back as
+        its next input, exactly ``generate()``'s recurrence, so burst
+        length cannot move a bit.  ``keydata`` is ``[S, K, *key]`` (one
+        key row per lane per burst token); returns the ``[K, S]`` token
+        matrix.  Free slots ride along as zero lanes (their writes land
+        at their own counters, harmless; their samples are discarded
+        host-side)."""
+
+        def one(cache, tok, kd, t, k, p):
+            (logits, _), mutated = self._decode_model.apply(
+                {"params": params, "cache": cache}, tok[None, None],
+                train=False, mutable=["cache"],
+            )
+            row = logits[0, -1].astype(jnp.float32)
+            return mutated["cache"], sample_dynamic(
+                row, kd, t, k, p, jnp.int32
+            )
+
+        def burst_step(carry, kd_t):
+            arena, toks = carry
+            arena, nxt = jax.vmap(one)(
+                arena, toks, kd_t, temperature, top_k, top_p
+            )
+            return (arena, nxt), nxt
+
+        (arena, _), out = lax.scan(
+            burst_step, (arena, tokens), jnp.swapaxes(keydata, 0, 1)
+        )
+        return arena, out
+
+    # -- host-facing ops ---------------------------------------------------
+
+    def prefill(self, slot: int, prompt: np.ndarray, keydata: np.ndarray,
+                temperature: float, top_k: int, top_p: float) -> int:
+        """Run one request's full (chunked) prompt into ``slot``; returns
+        the first generated token (sampled with ``keydata`` — key 0 of
+        the request's schedule, matching ``generate()``'s seeding of the
+        first token from the prompt's last logits)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        c = self.prefill_chunk
+        tok = None
+        with self.registry.span(reglib.SERVE_PREFILL):
+            for lo in range(0, len(prompt), c):
+                chunk = prompt[lo:lo + c]
+                real = len(chunk)
+                padded = np.zeros((c,), np.int32)
+                padded[:real] = chunk
+                self.arena, tok = self._prefill_j(
+                    self.params, self.arena, jnp.int32(slot),
+                    jnp.asarray(padded)[None], jnp.int32(lo),
+                    jnp.int32(lo + real), jnp.asarray(keydata),
+                    jnp.float32(temperature), jnp.int32(top_k),
+                    jnp.float32(top_p), jnp.int32(real - 1),
+                )
+            tok = int(tok)
+        return tok
+
+    def decode_step(self, lanes: dict) -> dict:
+        """One batched decode dispatch (``decode_burst`` tokens).
+        ``lanes`` maps slot -> ``(last_token, keydata_rows, temperature,
+        top_k, top_p)`` for every ACTIVE slot, where ``keydata_rows`` is
+        ``[r, *key]`` with ``1 <= r <= decode_burst`` (a lane with fewer
+        than ``decode_burst`` tokens left passes only its remaining key
+        schedule; the zero-padded tail samples garbage the caller must
+        discard — such a lane finishes inside this burst, so its slot is
+        retired and the overrun never reaches a live request).  Returns
+        ``{slot: [token, ...]}`` (``decode_burst`` tokens per lane) for
+        the same slots.  Inactive slots run as inert zero lanes — the
+        program shape never depends on how many requests are live."""
+        s, k = self.max_slots, self.decode_burst
+        tokens = np.zeros((s,), np.int32)
+        keydata = np.zeros((s, k) + self._key_shape, self._key_dtype)
+        temperature = np.zeros((s,), np.float32)
+        top_k = np.zeros((s,), np.int32)
+        top_p = np.ones((s,), np.float32)
+        for slot, (tok, kd, t, tk, p) in lanes.items():
+            tokens[slot] = tok
+            kd = np.asarray(kd, self._key_dtype).reshape(
+                (-1,) + self._key_shape
+            )
+            keydata[slot, : kd.shape[0]] = kd[:k]
+            temperature[slot] = t
+            top_k[slot] = tk
+            top_p[slot] = p
+        with self.registry.span(reglib.SERVE_DECODE):
+            self.arena, nxt = self._decode_j(
+                self.params, self.arena, jnp.asarray(tokens),
+                jnp.asarray(keydata), jnp.asarray(temperature),
+                jnp.asarray(top_k), jnp.asarray(top_p),
+            )
+            nxt = np.asarray(nxt)  # [K, S]
+        return {
+            slot: [int(nxt[i, slot]) for i in range(k)] for slot in lanes
+        }
+
+    def compile_counts(self) -> tuple[int, int]:
+        """(prefill, decode) compiled-program counts — the shape-stability
+        invariant tests pin to ``(1, 1)`` after a mixed workload."""
+        return (
+            int(self._prefill_j._cache_size()),
+            int(self._decode_j._cache_size()),
+        )

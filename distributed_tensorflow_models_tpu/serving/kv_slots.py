@@ -1,0 +1,147 @@
+"""Slotted KV arena: static device shapes, host-side slot bookkeeping.
+
+The transformer decode cache for ONE sequence is a pytree of
+``[1, max_len, kv_heads, head_dim]`` leaves plus two scalar counters
+(``cache_index`` — next write position, ``pos_index`` — next absolute
+position; see ``models/transformer_lm.py``).  Serving needs many
+sequences in flight with *independent* positions, but the model's
+counters are scalars — so instead of teaching the model a batch of
+counters, the arena stacks ``max_slots`` complete single-sequence
+caches along a new leading axis and the engine vmaps the unmodified
+B=1 decode over it.  Scalar counter leaves become ``[max_slots]``
+arrays under the same stacking, which is exactly what vmap expects.
+
+Why this is TPU-shaped: the arena is allocated ONCE with static shapes;
+admitting, retiring, or recycling a request never changes any device
+shape.  ``extract_slot`` / ``write_slot`` are ``lax.dynamic_*_in_dim``
+on the leading axis (traced slot index), so the prefill program is
+identical for every slot and compiles once.  Alloc/free/occupancy are
+pure host-side index bookkeeping (:class:`SlotManager`) — the device
+never sees them.  The fixed-shape trade-off vs PagedAttention: every
+slot reserves ``max_len`` positions, so memory is
+``max_slots × max_len`` regardless of actual lengths — the right trade
+on TPU, where dynamic shapes force recompiles that cost more than the
+reserved HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Scalar position counters in the decode cache (see SelfAttention /
+# TransformerLM ``decode=True`` variables).  Stacked per-slot by the
+# arena; force-set around chunked prefill by the engine.
+COUNTER_LEAVES = ("cache_index", "pos_index")
+
+
+def set_counters(cache, value):
+    """Return ``cache`` with every counter leaf set to ``value`` (cast to
+    the leaf's dtype).  Chunked prefill needs this twice per chunk: the
+    model advances its counters by the full (padded) chunk length, but
+    the real sequence position is ``start + real_tokens`` — the engine
+    pins the counters to the truth on the way in and the way out."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {
+                k: (jnp.asarray(value, v.dtype) if k in COUNTER_LEAVES
+                    else walk(v))
+                for k, v in node.items()
+            }
+        return node
+
+    return walk(cache)
+
+
+def make_arena(decode_model, max_slots: int, params=None):
+    """Allocate the ``[max_slots, ...]`` KV arena for ``decode_model``
+    (a model cloned with ``decode=True``): one zeroed single-sequence
+    cache per slot, stacked on a new leading axis.
+
+    Shapes come from ``jax.eval_shape`` over a one-token init — no
+    device work, no params needed (pass ``params`` only to silence
+    re-init cost concerns; it is unused because eval_shape is abstract).
+    Zero-init is safe for recycled slots too: stale K/V at positions at
+    or beyond the live sequence's write head is either causally masked
+    (position > query) or overwritten just-in-time by the next write —
+    the engine's padding argument, see ``engine.py``.
+    """
+    del params  # shapes only — eval_shape never touches values
+    shapes = jax.eval_shape(
+        lambda: decode_model.init(
+            jax.random.key(0), jnp.zeros((1, 1), jnp.int32)
+        )
+    )["cache"]
+    return jax.tree.map(
+        lambda s: jnp.zeros((max_slots,) + s.shape, s.dtype), shapes
+    )
+
+
+def extract_slot(arena, slot):
+    """One slot's single-sequence cache view (traced ``slot`` ok)."""
+    return jax.tree.map(
+        lambda a: lax.dynamic_index_in_dim(a, slot, 0, keepdims=False),
+        arena,
+    )
+
+
+def write_slot(arena, cache, slot):
+    """Write a single-sequence cache back into its arena slot."""
+    return jax.tree.map(
+        lambda a, c: lax.dynamic_update_index_in_dim(a, c, slot, 0),
+        arena, cache,
+    )
+
+
+class SlotManager:
+    """Host-side alloc/free bookkeeping over ``max_slots`` arena slots.
+
+    Lowest-free-index-first allocation — deterministic, so a replayed
+    request sequence lands in the same slots (useful when diffing two
+    runs' flight records).  Freeing returns the slot's request id so
+    the caller can assert it retired what it meant to.
+    """
+
+    def __init__(self, max_slots: int):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_slots = int(max_slots)
+        self._owner: dict[int, int] = {}  # slot -> request_id
+
+    def alloc(self, request_id: int) -> Optional[int]:
+        """Claim the lowest free slot for ``request_id`` (None = full)."""
+        for slot in range(self.max_slots):
+            if slot not in self._owner:
+                self._owner[slot] = request_id
+                return slot
+        return None
+
+    def free(self, slot: int) -> int:
+        """Release ``slot``; returns the request id that held it."""
+        if slot not in self._owner:
+            raise KeyError(f"slot {slot} is not allocated")
+        return self._owner.pop(slot)
+
+    def owner(self, slot: int) -> Optional[int]:
+        return self._owner.get(slot)
+
+    def active_slots(self) -> list[int]:
+        return sorted(self._owner)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._owner)
+
+    @property
+    def free_count(self) -> int:
+        return self.max_slots - len(self._owner)
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of slots in use, 0.0-1.0 (the utilization gauge the
+        scheduler records per iteration)."""
+        return len(self._owner) / self.max_slots

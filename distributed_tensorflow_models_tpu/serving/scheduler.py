@@ -1,0 +1,296 @@
+"""Admission + continuous batching: iteration-level scheduling over slots.
+
+Orca's observation, applied to the slotted engine: scheduling decisions
+belong at TOKEN granularity, not request granularity.  Each
+:meth:`ContinuousBatchingScheduler.step`:
+
+1. **Admits** — packs waiting prompts (FIFO; no reordering, so TTFT is
+   arrival-ordered and starvation-free) into free slots, bounded by the
+   ``max_prefill_tokens`` budget: prefill compute is O(prompt), and an
+   unbounded admission burst would stall every RUNNING request's next
+   token behind it — the budget caps the per-iteration TPOT spike.  The
+   first admission of an iteration is always allowed (a single prompt
+   longer than the budget must not starve).  A request finishing AT
+   admission (EOS first token, or ``max_new_tokens == 1``) frees its
+   slot inside the same pass, so the next waiter takes it immediately.
+2. **Decodes** — ONE batched dispatch advances every active slot
+   ``engine.decode_burst`` tokens (1 by default — classic per-token
+   scheduling; >1 amortizes per-dispatch host cost over the burst at
+   the price of burst-granular admission, vLLM's multi-step
+   scheduling).  Tokens a lane generates past its own finish line
+   (EOS or ``max_new_tokens``) inside a burst are discarded here and
+   never emitted.
+3. **Retires** — sequences that emitted ``eos_id`` or reached
+   ``max_new_tokens`` free their slots; the NEXT iteration's admission
+   pass refills them mid-flight (no drain-the-batch barrier — the
+   whole point of continuous batching).
+
+Telemetry (keys in ``telemetry/registry.py``): TTFT (submit → first
+token, timer), TPOT (inter-token gap after the first, timer),
+queue-depth and slot-occupancy sampled once per iteration into timers
+(so p50/p99 come from the same reservoir machinery as the latencies),
+``serve/requests`` / ``serve/tokens`` counters, plus the engine's own
+``serve/prefill`` / ``serve/decode`` device spans.  With
+``decode_burst > 1`` a burst's tokens become host-visible together, so
+TPOT turns bimodal (≈0 intra-burst, the full dispatch gap at burst
+boundaries) — the p50/p99 spread IS the burst tradeoff; the mean stays
+the true per-token rate.  All host timing is
+``time.perf_counter`` (monotonic — wall-clock steps would corrupt
+latency stats).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from distributed_tensorflow_models_tpu.telemetry import registry as reglib
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  ``rng`` is the SAME key a solo
+    ``generate()`` call would take — required when ``temperature > 0``
+    (matching ``generate()``'s contract), ignored for greedy.  The
+    conventional per-request derivation is
+    ``jax.random.fold_in(base_key, request_id)``, which the server
+    front half applies for callers that pass a seed instead of a key."""
+
+    request_id: int
+    prompt: np.ndarray  # 1-D int32, non-empty
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    rng: Optional[object] = None  # jax PRNG key; opaque at this layer
+
+
+@dataclasses.dataclass
+class Completion:
+    """A retired request: its generated tokens (EOS included when that
+    is what stopped it) and per-request latency facts."""
+
+    request_id: int
+    tokens: list
+    finish_reason: str  # "eos" | "length"
+    ttft_s: float
+    decode_steps: int
+
+
+class _InFlight:
+    """Host-side state of one admitted request."""
+
+    __slots__ = (
+        "req", "slot", "keydata", "tokens", "pos", "t_submit", "ttft_s",
+        "t_last",
+    )
+
+    def __init__(self, req, slot, keydata, t_submit):
+        self.req = req
+        self.slot = slot
+        self.keydata = keydata  # [max_new, *key_shape]
+        self.tokens: list = []
+        self.pos = 0  # tokens generated so far
+        self.t_submit = t_submit
+        self.ttft_s = 0.0
+        self.t_last = 0.0
+
+
+class ContinuousBatchingScheduler:
+    """The host-side serving loop over one :class:`InferenceEngine`.
+
+    Single-threaded by design: ``submit`` and ``step`` must be called
+    from one thread (the server's worker).  ``step`` returns the
+    requests it retired; ``run_until_idle`` drives steps until nothing
+    is waiting or active (the batch-mode entry tests and the bench use).
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_prefill_tokens: Optional[int] = None,
+        registry: Optional[reglib.MetricsRegistry] = None,
+    ):
+        self.engine = engine
+        # Default budget: half the arena's slots' worth of one chunk
+        # each — enough to keep slots full under bursty arrivals without
+        # ever spending more than ~half an iteration on prefill.
+        self.max_prefill_tokens = (
+            int(max_prefill_tokens)
+            if max_prefill_tokens is not None
+            else max(1, engine.max_slots // 2) * engine.prefill_chunk
+        )
+        if self.max_prefill_tokens < 1:
+            raise ValueError(
+                f"max_prefill_tokens must be >= 1, got "
+                f"{self.max_prefill_tokens}"
+            )
+        self.registry = (
+            registry if registry is not None else engine.registry
+        )
+        self._waiting: deque = deque()
+        self._active: dict[int, _InFlight] = {}  # slot -> state
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        """Validate + enqueue (does NOT run the engine; admission happens
+        in :meth:`step`).  Raises ``ValueError`` for requests that could
+        never be served — rejecting at the door beats a slot wedged on
+        an impossible request."""
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
+            )
+        self.engine.check_fits(len(prompt), req.max_new_tokens)
+        if req.temperature > 0 and req.rng is None:
+            raise ValueError("temperature sampling needs an rng key")
+        req.prompt = prompt
+        if req.temperature > 0:
+            keydata = self.engine.request_keys(
+                req.rng, req.max_new_tokens
+            )
+        else:
+            keydata = self.engine.zero_keys(req.max_new_tokens)
+        self.registry.counter(reglib.SERVE_REQUESTS).inc()
+        self._waiting.append(
+            _InFlight(req, -1, keydata, time.perf_counter())
+        )
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def waiting_count(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._waiting or self._active)
+
+    # -- the iteration -----------------------------------------------------
+
+    def _emit(self, inflight, token: int, now: float) -> bool:
+        """Record one generated token; True when the request is done."""
+        inflight.tokens.append(token)
+        inflight.pos += 1
+        self.registry.counter(reglib.SERVE_TOKENS).inc()
+        if inflight.pos == 1:
+            inflight.ttft_s = now - inflight.t_submit
+            self.registry.timer(reglib.SERVE_TTFT).record(
+                inflight.ttft_s
+            )
+        else:
+            self.registry.timer(reglib.SERVE_TPOT).record(
+                now - inflight.t_last
+            )
+        inflight.t_last = now
+        req = inflight.req
+        return (
+            req.eos_id is not None and token == req.eos_id
+        ) or inflight.pos >= req.max_new_tokens
+
+    def _retire(self, inflight, done: list) -> None:
+        self.engine.slots.free(inflight.slot)
+        reason = (
+            "eos"
+            if (
+                inflight.req.eos_id is not None
+                and inflight.tokens
+                and inflight.tokens[-1] == inflight.req.eos_id
+            )
+            else "length"
+        )
+        done.append(
+            Completion(
+                request_id=inflight.req.request_id,
+                tokens=list(inflight.tokens),
+                finish_reason=reason,
+                ttft_s=inflight.ttft_s,
+                decode_steps=max(0, inflight.pos - 1),
+            )
+        )
+
+    def step(self) -> list:
+        """One scheduling iteration; returns retired :class:`Completion`s
+        (possibly empty).  No-op when idle."""
+        done: list = []
+        # 1. admission: pack waiters into free slots under the budget.
+        spent = 0
+        while self._waiting and self.engine.slots.free_count > 0:
+            cost = self.engine.padded_len(
+                len(self._waiting[0].req.prompt)
+            )
+            if spent and spent + cost > self.max_prefill_tokens:
+                break
+            inflight = self._waiting.popleft()
+            req = inflight.req
+            slot = self.engine.slots.alloc(req.request_id)
+            inflight.slot = slot
+            spent += cost
+            first = self.engine.prefill(
+                slot, req.prompt, inflight.keydata[0],
+                req.temperature, req.top_k, req.top_p,
+            )
+            if self._emit(inflight, first, time.perf_counter()):
+                self._retire(inflight, done)  # frees the slot in-pass
+            else:
+                self._active[slot] = inflight
+        # 2. one batched decode dispatch (decode_burst tokens) for every
+        # active slot.  A lane with fewer tokens left than the burst
+        # passes only its remaining key rows; it finishes mid-burst and
+        # the loop below discards the overrun.
+        if self._active:
+            burst = self.engine.decode_burst
+            lanes = {}
+            for slot, inflight in self._active.items():
+                req = inflight.req
+                lanes[slot] = (
+                    inflight.tokens[-1],
+                    inflight.keydata[
+                        inflight.pos: inflight.pos + burst
+                    ],
+                    req.temperature, req.top_k, req.top_p,
+                )
+            next_tokens = self.engine.decode_step(lanes)
+            now = time.perf_counter()
+            # 3. retire finished sequences (their slots are refillable
+            # from the very next admission pass).
+            for slot in list(self._active):
+                inflight = self._active[slot]
+                for token in next_tokens[slot]:
+                    if self._emit(inflight, token, now):
+                        del self._active[slot]
+                        self._retire(inflight, done)
+                        break
+        # Iteration-sampled load gauges, recorded as timer distributions
+        # so the server's p50/p99 surface covers them too.
+        self.registry.timer(reglib.SERVE_QUEUE_DEPTH).record(
+            float(len(self._waiting))
+        )
+        self.registry.timer(reglib.SERVE_SLOT_OCCUPANCY).record(
+            self.engine.slots.occupancy
+        )
+        return done
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> list:
+        """Drive :meth:`step` until no work remains (or ``max_steps``);
+        returns every completion, submission-agnostic order."""
+        done: list = []
+        steps = 0
+        while self.has_work:
+            if max_steps is not None and steps >= max_steps:
+                break
+            done.extend(self.step())
+            steps += 1
+        return done

@@ -1,0 +1,654 @@
+"""Serving front half: request queue, worker thread, drain-on-SIGTERM.
+
+This module is the jax-free zone's serving member (with ``launch.py``
+and the heartbeat/backoff modules): importable on a supervisor host
+with no accelerator stack, because every jax touch lives behind the
+worker thread's function-level imports.  The split mirrors the rest of
+the repo — stdlib front half (queueing, signals, artifacts), device
+work behind one boundary.
+
+:class:`LMServer` owns ONE worker thread that builds the engine (via
+the injected factory — the caller decides model/params/slots), runs the
+:class:`~.scheduler.ContinuousBatchingScheduler`, and resolves
+:class:`ServeHandle`\\ s.  ``submit`` is thread-safe and non-blocking;
+callers block on ``handle.result(timeout)``.
+
+**Drain semantics** (the part a preemptible fleet cares about):
+``drain()``, ``stop()``, or a SIGTERM observed through the injected
+``resilience/preemption.py`` listener all flip the server into
+draining: new ``submit`` calls are rejected with :class:`ServerDraining`,
+everything already accepted keeps decoding until it retires, then the
+worker exits — bounded by ``drain_grace_s``, after which still-unfinished
+handles fail with ``TimeoutError`` instead of wedging the host past its
+kill window.  On the way out the worker dumps a flight record
+(``flight_recorder_p<i>.json``, reason ``serve_drain`` /
+``serve_drain_timeout``) and a ``serving_stats_p<i>.json`` report with
+TTFT/TPOT/queue-depth/slot-occupancy p50/p99 —
+``scripts/check_metrics_schema.py --serving-report`` validates the
+latter, ``--flight-recorder`` the former.
+
+Run as ``python -m distributed_tensorflow_models_tpu.serving.server``
+the module becomes one file-queue replica for ``scripts/serve_drill.py``:
+it claims request files from a shared directory by atomic rename (two
+replicas can never both serve one request), answers into ``resp/``, and
+drains cleanly when SIGTERM'd mid-traffic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import logging
+import os
+import queue
+import signal
+import threading
+import time
+from typing import Optional
+
+from distributed_tensorflow_models_tpu.resilience.preemption import (
+    PreemptionListener,
+)
+from distributed_tensorflow_models_tpu.telemetry import registry as reglib
+from distributed_tensorflow_models_tpu.telemetry import trace as tracelib
+
+log = logging.getLogger("dtm")
+
+STATS_BASENAME = "serving_stats_p{index}.json"
+
+
+def serving_stats_path(workdir: str, process_index: int) -> str:
+    """The per-process serving stats artifact path."""
+    return os.path.join(
+        workdir, STATS_BASENAME.format(index=process_index)
+    )
+
+
+class ServerDraining(RuntimeError):
+    """Raised by ``submit`` once the server is draining or stopped."""
+
+
+class ServeHandle:
+    """One request's future.  ``result(timeout)`` blocks for the
+    :class:`~.scheduler.Completion`; failures (validation, drain
+    timeout, engine death) re-raise here, on the caller's thread."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished in {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    # worker-side
+    def _resolve(self, completion) -> None:
+        self._result = completion
+        self._event.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+
+class LMServer:
+    """Request queue + one serving worker thread over one engine.
+
+    ``engine_factory`` is called ON the worker thread (first jax touch
+    happens there, keeping this module importable jax-free) and must
+    return an :class:`~.engine.InferenceEngine`.  Pass a ``listener``
+    (installed from the main thread) to get drain-on-SIGTERM; without
+    one, only ``drain()``/``stop()`` end the run.
+    """
+
+    def __init__(
+        self,
+        engine_factory,
+        *,
+        max_prefill_tokens: Optional[int] = None,
+        drain_grace_s: float = 30.0,
+        registry: Optional[reglib.MetricsRegistry] = None,
+        listener: Optional[PreemptionListener] = None,
+        workdir: Optional[str] = None,
+        process_index: Optional[int] = None,
+        poll_s: float = 0.02,
+        trace_ring_events: int = tracelib.DEFAULT_RING_EVENTS,
+    ):
+        self._engine_factory = engine_factory
+        self._max_prefill_tokens = max_prefill_tokens
+        self.drain_grace_s = float(drain_grace_s)
+        self.registry = (
+            registry if registry is not None else reglib.MetricsRegistry()
+        )
+        self._listener = listener
+        self.workdir = workdir
+        self.process_index = (
+            int(process_index)
+            if process_index is not None
+            else int(os.environ.get("DTM_PROCESS_ID", "0"))
+        )
+        self._poll_s = float(poll_s)
+        # A live tracer (unless the caller attached their own): the
+        # registry's spans then mirror serve/prefill + serve/decode into
+        # the ring, so the drain's flight record shows the serving
+        # timeline, not an empty event list.
+        if self.registry.trace is tracelib.NULL_TRACER:
+            self.registry.trace = tracelib.Tracer(
+                trace_ring_events, process_index=self.process_index
+            )
+        self._queue: queue.Queue = queue.Queue()
+        self._ids = itertools.count()
+        self._draining = threading.Event()
+        self._fatal: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set() or (
+            self._listener is not None and self._listener.preempted
+        )
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._thread = threading.Thread(
+            target=self._run, name="serve-worker", daemon=True
+        )
+        self._thread.start()
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Stop admitting, serve out the backlog, join the worker."""
+        self._draining.set()
+        if self._thread is not None:
+            # Grace + engine-build slack: the drain deadline only starts
+            # ticking once the worker observes it.
+            self._thread.join(
+                timeout if timeout is not None
+                else self.drain_grace_s + 60.0
+            )
+            if self._thread.is_alive():
+                raise TimeoutError("serve worker did not drain in time")
+            self._thread = None
+        if self._fatal is not None:
+            raise self._fatal
+
+    def stop(self) -> None:
+        self.drain()
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_id: Optional[int] = None,
+        seed: Optional[int] = None,
+        rng=None,
+        request_id: Optional[int] = None,
+    ) -> ServeHandle:
+        """Enqueue one request; returns its :class:`ServeHandle`.
+
+        Sampling requests take either an explicit jax ``rng`` key (the
+        bit-identity tests pass the same key to a solo ``generate()``)
+        or a ``seed``, from which the worker derives the conventional
+        per-request key ``fold_in(key(seed), request_id)``.
+        """
+        if self.draining:
+            raise ServerDraining("server is draining; not accepting work")
+        if self._thread is None:
+            raise RuntimeError("server not started")
+        rid = int(request_id) if request_id is not None else next(self._ids)
+        handle = ServeHandle(rid)
+        self._queue.put(
+            (
+                handle,
+                {
+                    "prompt": [int(t) for t in prompt],
+                    "max_new_tokens": int(max_new_tokens),
+                    "temperature": float(temperature),
+                    "top_k": int(top_k),
+                    "top_p": float(top_p),
+                    "eos_id": eos_id,
+                    "seed": seed,
+                    "rng": rng,
+                },
+            )
+        )
+        return handle
+
+    # -- reporting ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Serving report: the registry snapshot plus p99 expansions for
+        every serving distribution (snapshot() itself carries p50/p95).
+        Touches each serving key first so the report ALWAYS carries the
+        full set — an idle server reports zeros, not absences (the
+        ``--serving-report`` schema contract)."""
+        for name in (reglib.SERVE_REQUESTS, reglib.SERVE_TOKENS):
+            self.registry.counter(name)
+        for name in (
+            reglib.SERVE_TTFT, reglib.SERVE_TPOT, reglib.SERVE_PREFILL,
+            reglib.SERVE_DECODE, reglib.SERVE_QUEUE_DEPTH,
+            reglib.SERVE_SLOT_OCCUPANCY,
+        ):
+            self.registry.timer(name)
+        snap = self.registry.snapshot()
+        for name in (
+            reglib.SERVE_TTFT, reglib.SERVE_TPOT,
+            reglib.SERVE_QUEUE_DEPTH, reglib.SERVE_SLOT_OCCUPANCY,
+        ):
+            (p99,) = self.registry.timer(name).percentiles(0.99)
+            snap[f"{name}/p99_s"] = p99
+        return {
+            "version": 1,
+            "process_index": self.process_index,
+            "draining": self.draining,
+            "metrics": snap,
+        }
+
+    def write_stats(self, path: str) -> None:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.stats(), f)
+        os.replace(tmp, path)
+
+    # -- worker ------------------------------------------------------------
+
+    def _fail_queue(self, err: BaseException) -> None:
+        while True:
+            try:
+                handle, _ = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            handle._fail(err)
+
+    def _admit(self, sched, pending, handle, spec) -> None:
+        try:
+            import jax  # worker thread only — the front half stays jax-free
+
+            from distributed_tensorflow_models_tpu.serving.scheduler import (
+                Request,
+            )
+
+            rng = spec["rng"]
+            if rng is None and spec["temperature"] > 0:
+                seed = spec["seed"] if spec["seed"] is not None else 0
+                rng = jax.random.fold_in(
+                    jax.random.key(int(seed)), handle.request_id
+                )
+            sched.submit(
+                Request(
+                    request_id=handle.request_id,
+                    prompt=spec["prompt"],
+                    max_new_tokens=spec["max_new_tokens"],
+                    temperature=spec["temperature"],
+                    top_k=spec["top_k"],
+                    top_p=spec["top_p"],
+                    eos_id=spec["eos_id"],
+                    rng=rng,
+                )
+            )
+            pending[handle.request_id] = handle
+        except Exception as e:  # noqa: BLE001 — a bad request fails ITS
+            handle._fail(e)  # handle, never the serving loop
+
+    def _pull(self, sched, pending) -> None:
+        while True:
+            try:
+                handle, spec = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            self._admit(sched, pending, handle, spec)
+
+    def _run(self) -> None:
+        try:
+            engine = self._engine_factory()
+            # Adopt the engine into this server's registry unless the
+            # factory attached its own — otherwise the prefill/decode
+            # spans would land in the process-global default and the
+            # drain artifacts would miss them.
+            if engine.registry is reglib.get_registry():
+                engine.registry = self.registry
+            from distributed_tensorflow_models_tpu.serving.scheduler import (
+                ContinuousBatchingScheduler,
+            )
+
+            sched = ContinuousBatchingScheduler(
+                engine,
+                max_prefill_tokens=self._max_prefill_tokens,
+                registry=self.registry,
+            )
+        except BaseException as e:  # noqa: BLE001 — surface via drain()
+            self._fatal = e
+            self._draining.set()
+            self._fail_queue(e)
+            log.exception("serve worker failed to build its engine")
+            return
+        pending: dict = {}
+        deadline = None
+        timed_out = False
+        while True:
+            draining = self.draining
+            if draining and deadline is None:
+                deadline = time.perf_counter() + self.drain_grace_s
+                self.registry.trace.instant(
+                    "serve/drain",
+                    {
+                        "pending": len(pending),
+                        "queued": self._queue.qsize(),
+                        "waiting": sched.waiting_count,
+                        "active": sched.active_count,
+                    },
+                )
+                log.warning(
+                    "serving drain: %d in flight, %d queued, grace %.1fs",
+                    len(pending) + sched.waiting_count
+                    + self._queue.qsize(),
+                    self._queue.qsize(),
+                    self.drain_grace_s,
+                )
+            self._pull(sched, pending)
+            if sched.has_work:
+                for comp in sched.step():
+                    handle = pending.pop(comp.request_id, None)
+                    if handle is not None:
+                        handle._resolve(comp)
+                if (
+                    draining
+                    and time.perf_counter() > deadline
+                    and sched.has_work
+                ):
+                    timed_out = True
+                    break
+            elif draining and self._queue.empty():
+                break
+            else:
+                try:
+                    handle, spec = self._queue.get(timeout=self._poll_s)
+                except queue.Empty:
+                    continue
+                self._admit(sched, pending, handle, spec)
+        if timed_out:
+            err = TimeoutError(
+                f"serve drain exceeded {self.drain_grace_s}s grace"
+            )
+            for handle in pending.values():
+                handle._fail(err)
+            self._fail_queue(err)
+        self._finalize(
+            "serve_drain_timeout" if timed_out else "serve_drain"
+        )
+
+    def _finalize(self, reason: str) -> None:
+        if not self.workdir:
+            return
+        try:
+            os.makedirs(self.workdir, exist_ok=True)
+            self.write_stats(
+                serving_stats_path(self.workdir, self.process_index)
+            )
+            self.registry.trace.dump_flight_record(
+                tracelib.flight_record_path(
+                    self.workdir, self.process_index
+                ),
+                reason,
+                registry=self.registry,
+            )
+        except OSError:  # forensics must not turn a drain into a crash
+            log.exception("serving artifacts not written")
+
+
+# --------------------------------------------------------------------------
+# File-queue replica mode (scripts/serve_drill.py)
+# --------------------------------------------------------------------------
+#
+# Protocol, all under --queue-dir: the parent writes req-<id>.json files
+# plus a DONE sentinel; each replica claims a request by atomically
+# renaming it into claimed/ (suffixed .p<replica> — the rename either
+# fully succeeds or another replica already owns it, so exactly one
+# serves it), answers into resp/req-<id>.json (tmp + rename, torn-read
+# safe), and exits when DONE is present, nothing is left to claim, and
+# its own in-flight work is resolved.  A SIGTERM'd replica stops
+# claiming, drains what it owns, writes those responses, and exits 0 —
+# the drill asserts no response is missing or duplicated.
+
+
+def _drill_engine_factory(args):
+    """Tiny deterministic LM (params from seed 0 — replicas identical)."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_tensorflow_models_tpu.models import get_model
+        from distributed_tensorflow_models_tpu.serving.engine import (
+            InferenceEngine,
+        )
+
+        model = get_model(
+            "transformer_lm", vocab_size=64, num_layers=2, num_heads=2,
+            d_model=32, d_ff=64, max_len=64, dropout_rate=0.0,
+            dtype=jnp.float32, attn_impl="reference",
+        )
+        params = model.init(
+            jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+        return InferenceEngine(
+            model, params, max_slots=args.max_slots,
+            prefill_chunk=args.prefill_chunk,
+            decode_burst=args.decode_burst,
+        )
+
+    return build
+
+
+def _claim_one(queue_dir: str, claimed_dir: str, replica: int):
+    """Claim the oldest unclaimed request file, or None.  The atomic
+    rename is the exactly-once guarantee: losing the race to a peer is
+    a skip, never an error."""
+    for name in sorted(os.listdir(queue_dir)):
+        if not (name.startswith("req-") and name.endswith(".json")):
+            continue
+        src = os.path.join(queue_dir, name)
+        dst = os.path.join(claimed_dir, f"{name}.p{replica}")
+        try:
+            os.rename(src, dst)
+        except OSError:
+            continue  # peer won the race
+        with open(dst) as f:
+            return name, json.load(f)
+    return None
+
+
+def _unclaim(queue_dir: str, claimed_dir: str, name: str, replica: int):
+    try:
+        os.rename(
+            os.path.join(claimed_dir, f"{name}.p{replica}"),
+            os.path.join(queue_dir, name),
+        )
+    except OSError:  # pragma: no cover — duplicate drains are benign
+        log.exception("unclaim of %s failed", name)
+
+
+def _write_response(resp_dir: str, rid: int, payload: dict) -> None:
+    path = os.path.join(resp_dir, f"req-{rid}.json")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _replica_main(args) -> int:
+    replica = int(os.environ.get("DTM_PROCESS_ID", "0"))
+    claimed_dir = os.path.join(args.queue_dir, "claimed")
+    resp_dir = os.path.join(args.queue_dir, "resp")
+    os.makedirs(claimed_dir, exist_ok=True)
+    os.makedirs(resp_dir, exist_ok=True)
+    listener = PreemptionListener(signals=(signal.SIGTERM,))
+    listener.install()
+    server = LMServer(
+        _drill_engine_factory(args),
+        max_prefill_tokens=args.max_prefill_tokens,
+        drain_grace_s=args.drain_grace_s,
+        listener=listener,
+        workdir=args.workdir,
+        process_index=replica,
+    )
+    server.start()
+    outstanding: dict = {}  # request_id -> (handle, request name)
+    responded = 0
+    sigterm_sent = False
+    deadline = time.perf_counter() + args.timeout
+
+    def resolve_finished(block: bool) -> int:
+        nonlocal responded
+        n = 0
+        for rid in list(outstanding):
+            handle, name = outstanding[rid]
+            if not block and not handle.done():
+                continue
+            try:
+                comp = handle.result(
+                    timeout=args.drain_grace_s + 60.0 if block else None
+                )
+            except Exception as e:  # noqa: BLE001 — drill asserts on the
+                log.error("request %d failed: %s", rid, e)  # missing resp
+                del outstanding[rid]
+                continue
+            _write_response(
+                resp_dir, rid,
+                {
+                    "request_id": rid,
+                    "tokens": comp.tokens,
+                    "finish_reason": comp.finish_reason,
+                    "ttft_s": comp.ttft_s,
+                    "replica": replica,
+                },
+            )
+            del outstanding[rid]
+            responded += 1
+            n += 1
+        return n
+
+    exit_reason = "deadline"
+    while time.perf_counter() < deadline:
+        if listener.preempted:
+            exit_reason = "preempted"
+            break
+        # Claim backpressure: never hold more than two arenas' worth of
+        # unresolved work.  Claim-ahead would hoard requests a peer
+        # replica could be serving — and everything hoarded becomes
+        # drain debt when this replica is SIGTERM'd.
+        can_claim = len(outstanding) < 2 * args.max_slots
+        got = (
+            _claim_one(args.queue_dir, claimed_dir, replica)
+            if can_claim else None
+        )
+        if got is not None:
+            name, spec = got
+            try:
+                handle = server.submit(
+                    spec["prompt"], spec["max_new_tokens"],
+                    temperature=spec.get("temperature", 0.0),
+                    top_k=spec.get("top_k", 0),
+                    top_p=spec.get("top_p", 1.0),
+                    eos_id=spec.get("eos_id"),
+                    seed=spec.get("seed"),
+                    request_id=spec["request_id"],
+                )
+                outstanding[spec["request_id"]] = (handle, name)
+            except ServerDraining:
+                # SIGTERM won the race between claim and submit: hand
+                # the request back for the surviving replica.
+                _unclaim(args.queue_dir, claimed_dir, name, replica)
+                exit_reason = "drain_race"
+                break
+        resolve_finished(block=False)
+        if (
+            args.self_sigterm_after
+            and replica == args.sigterm_replica
+            and responded >= args.self_sigterm_after
+            and not sigterm_sent
+        ):
+            sigterm_sent = True
+            log.warning(
+                "replica %d self-delivering SIGTERM after %d responses "
+                "(drill victim)", replica, responded,
+            )
+            os.kill(os.getpid(), signal.SIGTERM)
+        if got is None:
+            done = os.path.exists(os.path.join(args.queue_dir, "DONE"))
+            if done and not outstanding and can_claim:
+                # Only exit on a GENUINE empty claim attempt.  When
+                # backpressure suppressed this iteration's claim, a
+                # completion burst may just have emptied `outstanding`
+                # — loop once more so the freed capacity re-checks the
+                # queue, else both replicas can strand its tail.
+                exit_reason = "queue_drained"
+                break
+            listener.wait(args.poll_s)
+    # Drain: everything this replica claimed must be answered before it
+    # exits — the drill's no-dropped-responses assertion.
+    resolve_finished(block=True)
+    server.drain()
+    listener.uninstall()
+    log.info(
+        "replica %d exiting (%s): %d responses", replica, exit_reason,
+        responded,
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="file-queue serving replica (serve_drill.py)"
+    )
+    p.add_argument("--queue-dir", required=True)
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--max-slots", type=int, default=4)
+    p.add_argument("--prefill-chunk", type=int, default=8)
+    p.add_argument(
+        "--decode-burst", type=int, default=1,
+        help="decode tokens per device dispatch (multi-step "
+        "scheduling); 1 = per-token admission, larger bursts trade "
+        "admission latency for dispatch amortization",
+    )
+    p.add_argument("--max-prefill-tokens", type=int, default=None)
+    p.add_argument("--drain-grace-s", type=float, default=30.0)
+    p.add_argument(
+        "--self-sigterm-after", type=int, default=0,
+        help="after N responses, deliver SIGTERM to self (drill victim)",
+    )
+    p.add_argument(
+        "--sigterm-replica", type=int, default=-1,
+        help="which replica index self-SIGTERMs (default: none)",
+    )
+    p.add_argument("--poll-s", type=float, default=0.05)
+    p.add_argument(
+        "--timeout", type=float, default=300.0,
+        help="hard wall bound on the claim loop",
+    )
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    return _replica_main(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

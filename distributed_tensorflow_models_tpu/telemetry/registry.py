@@ -110,6 +110,24 @@ CHAOS_ARMED_UNFIRED = "chaos/armed_unfired"  # gauge
 # trace_ring_events).  Validated non-negative by check_metrics_schema.
 TRACE_EVENTS = "trace/events"  # gauge
 TRACE_DROPPED = "trace/dropped"  # gauge
+# Serving (serving/: continuous-batching inference).  The two latency
+# distributions every serving SLO is written against: TTFT = submit →
+# first token (dominated by queueing + prefill), TPOT = inter-token gap
+# after the first (dominated by the batched decode step — the number
+# continuous batching trades against throughput).  PREFILL/DECODE are
+# device-dispatch spans (timer + trace span via registry.span).
+# QUEUE_DEPTH and SLOT_OCCUPANCY are per-iteration load samples recorded
+# into timers so they get the same p50/p99 surface as the latencies.
+# serving_stats_p<i>.json carries all of these; validated by
+# check_metrics_schema --serving-report.
+SERVE_TTFT = "serve/ttft_s"  # timer
+SERVE_TPOT = "serve/tpot_s"  # timer
+SERVE_PREFILL = "serve/prefill"  # timer + span
+SERVE_DECODE = "serve/decode"  # timer + span
+SERVE_QUEUE_DEPTH = "serve/queue_depth"  # timer (per-iteration sample)
+SERVE_SLOT_OCCUPANCY = "serve/slot_occupancy"  # timer (fraction, 0-1)
+SERVE_REQUESTS = "serve/requests"  # counter
+SERVE_TOKENS = "serve/tokens"  # counter
 
 
 class Counter:

@@ -42,6 +42,9 @@ Checks, per line:
   in telemetry.json snapshots): any present value must be a
   non-negative number;
 
+- serving keys (``serve/*`` — TTFT/TPOT/occupancy etc., README
+  "Serving"): any present value must be a non-negative number;
+
 and, across the file with ``--require-telemetry``: at least one row
 carries the full telemetry key set (``data_wait_s``, ``step_time_s``,
 ``mfu``) — the TelemetryHook injects them together, so a partial set on
@@ -58,6 +61,15 @@ this mode catches declared keys that no code path ever emits (dead
 constants, or a metric whose emission silently regressed).  Keys whose
 emission is legitimately load- or topology-dependent are excused with
 ``--allow-missing PREFIX`` (repeatable).
+
+With ``--serving-report`` the path is validated as a serving stats
+report (``<workdir>/serving_stats_p<i>.json``, serving/server.py)
+instead: required top-level keys, a numbers-only ``metrics`` snapshot
+carrying the FULL serving key set (both counters, every serving timer's
+``/count`` expansion, and the p99 expansions for TTFT/TPOT/queue-depth/
+slot-occupancy — the server writes the full set even when idle, so an
+absence is a writer regression, not light load), every ``serve/*``
+value non-negative.
 
 With ``--flight-recorder`` the path is validated as a flight-recorder
 dump (``<workdir>/flight_recorder_p<i>.json``, telemetry/trace.py)
@@ -104,6 +116,9 @@ CHECKPOINT_PREFIX = "checkpoint/"
 # Tracer accounting (trace/events, trace/dropped): counts, non-negative
 # wherever they appear.
 TRACE_PREFIX = "trace/"
+# Serving keys (serve/ttft_s etc.): latencies, counts and fractions —
+# non-negative wherever they appear.
+SERVE_PREFIX = "serve/"
 # Restart-MTTR gauges TelemetryHook injects together (README
 # "Performance"); a partial set on a row is a writer bug, like the sets
 # above.  Values are overlapped wall readings — non-negative seconds.
@@ -229,7 +244,72 @@ def check_lines(
                 errors.append(
                     f"line {i}: trace key {key!r} is negative: {value!r}"
                 )
+            elif key.startswith(SERVE_PREFIX):
+                errors.append(
+                    f"line {i}: serving key {key!r} is negative: {value!r}"
+                )
     return errors, rows, telemetry_rows
+
+
+# --------------------------------------------------------------------------
+# Serving stats reports (serving/server.py serving_stats_p<i>.json)
+# --------------------------------------------------------------------------
+
+SERVING_REQUIRED = ("version", "process_index", "draining", "metrics")
+SERVING_COUNTERS = ("serve/requests", "serve/tokens")
+SERVING_TIMERS = (
+    "serve/ttft_s", "serve/tpot_s", "serve/prefill", "serve/decode",
+    "serve/queue_depth", "serve/slot_occupancy",
+)
+# Tail-latency expansions the server adds on top of snapshot()'s
+# p50/p95 — the serving SLO surface.
+SERVING_P99 = (
+    "serve/ttft_s", "serve/tpot_s", "serve/queue_depth",
+    "serve/slot_occupancy",
+)
+
+
+def check_serving_report(report) -> list[str]:
+    """Violations in one serving stats report (empty list = clean)."""
+    errors: list[str] = []
+    if not isinstance(report, dict):
+        return ["serving report is not a JSON object"]
+    for key in SERVING_REQUIRED:
+        if key not in report:
+            errors.append(f"missing required key {key!r}")
+    if errors:
+        return errors
+    pi = report["process_index"]
+    if not isinstance(pi, int) or isinstance(pi, bool) or pi < 0:
+        errors.append(
+            f"'process_index' must be a non-negative int, got {pi!r}"
+        )
+    if not isinstance(report["draining"], bool):
+        errors.append(
+            f"'draining' must be a bool, got {report['draining']!r}"
+        )
+    snap = report["metrics"]
+    if not isinstance(snap, dict):
+        return errors + ["'metrics' is not an object"]
+    for key, value in snap.items():
+        if not _is_number(value):
+            errors.append(
+                f"metrics value for {key!r} is not a number: {value!r}"
+            )
+        elif value < 0 and key.startswith(SERVE_PREFIX):
+            errors.append(f"serving key {key!r} is negative: {value!r}")
+    # Full-set requirement: the server touches every serving key before
+    # snapshotting, so absence = writer regression (never light load).
+    for key in SERVING_COUNTERS:
+        if key not in snap:
+            errors.append(f"serving counter {key!r} missing")
+    for key in SERVING_TIMERS:
+        if f"{key}/count" not in snap:
+            errors.append(f"serving timer {key!r} missing (no /count)")
+    for key in SERVING_P99:
+        if f"{key}/p99_s" not in snap:
+            errors.append(f"serving p99 expansion {key!r}/p99_s missing")
+    return errors
 
 
 # --------------------------------------------------------------------------
@@ -387,6 +467,13 @@ def main(argv=None) -> int:
         "(telemetry/trace.py schema) instead of a metrics file",
     )
     p.add_argument(
+        "--serving-report",
+        action="store_true",
+        help="validate the path as a serving stats report "
+        "(serving/server.py serving_stats_p<i>.json schema) instead of "
+        "a metrics file",
+    )
+    p.add_argument(
         "--declared-coverage",
         metavar="REGISTRY_PY",
         help="validate the path as a telemetry.json report instead: "
@@ -425,6 +512,25 @@ def main(argv=None) -> int:
                 else ""
             )
             + ")"
+        )
+        return 0
+    if args.serving_report:
+        try:
+            with open(args.path) as f:
+                report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+            return 1
+        errors = check_serving_report(report)
+        if errors:
+            for e in errors:
+                print(f"{args.path}: {e}", file=sys.stderr)
+            return 1
+        m = report["metrics"]
+        print(
+            f"{args.path}: OK ({int(m['serve/requests'])} requests, "
+            f"{int(m['serve/tokens'])} tokens, "
+            f"ttft p99 {m['serve/ttft_s/p99_s']:.4f}s)"
         )
         return 0
     if args.flight_recorder:

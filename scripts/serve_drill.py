@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Two-replica serving fleet drill: drain-on-SIGTERM, exactly-once.
+
+Spawns a real 2-replica serving fleet (``launch.launch_local`` over
+``python -m distributed_tensorflow_models_tpu.serving.server``) against
+one shared file queue of requests, SIGTERMs replica 1 mid-traffic (the
+replica self-delivers the signal after its 3rd response, so the timing
+is deterministic-ish and the parent needs no child PIDs), and verifies
+the serving drain contract:
+
+- **no dropped responses** — every request file gets exactly one
+  response; the victim answers everything it claimed before exiting 0
+  (drain, not abort), and hands back anything caught between claim and
+  submit for the survivor to serve;
+- **no duplicated responses** — the atomic-rename claim protocol means
+  a request is served by exactly one replica (asserted from the
+  ``claimed/`` audit trail);
+- **replica-independent results** — the queue carries duplicate-spec
+  request pairs; each pair's token streams must be identical even when
+  the two copies landed on different replicas (the batching-invariance
+  contract, observed end-to-end through the fleet);
+- **forensics** — both replicas leave a schema-clean flight record
+  (reason ``serve_drain``, with the ``serve/drain`` instant marking
+  when the drain began) and a schema-clean ``serving_stats_p<i>.json``
+  (both validated by ``scripts/check_metrics_schema.py``), and the
+  victim actually served traffic before dying.
+
+The parent process never imports jax (safe on a login host); all device
+work happens in the spawned replicas.  Exit 0 when every check passes.
+
+Usage::
+
+    python scripts/serve_drill.py [--requests 24] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO)
+
+from distributed_tensorflow_models_tpu import launch  # noqa: E402
+
+PORT = 9871
+SIGTERM_AFTER = 3  # victim self-SIGTERMs after this many responses
+VICTIM = 1
+
+# Request mix: every sampling mode, EVEN ids duplicated by their
+# successor (same spec, different request_id) for the cross-replica
+# determinism check.  Vocab is 64 (the replica's built-in tiny model).
+MODES = [
+    dict(temperature=0.0, top_k=0, top_p=1.0),
+    dict(temperature=1.0, top_k=0, top_p=1.0, seed=11),
+    dict(temperature=0.8, top_k=5, top_p=1.0, seed=12),
+    dict(temperature=1.0, top_k=0, top_p=0.9, seed=13),
+]
+
+
+def _write_requests(queue_dir: str, n: int) -> dict[int, dict]:
+    """Emit ``n`` request files; returns {request_id: spec}.  Pairs
+    (2i, 2i+1) share prompt + mode; the cross-replica determinism check
+    compares the GREEDY pairs byte-for-byte (seeded modes legitimately
+    diverge within a pair, because the replica folds the sampling key
+    with the request_id — per-request keys are part of the contract)."""
+    specs = {}
+    for rid in range(n):
+        mode = MODES[(rid // 2) % len(MODES)]
+        pair = rid // 2  # both members of a pair share everything below
+        prompt = [(3 + 7 * pair + j) % 64 for j in range(3 + pair % 5)]
+        spec = {
+            "request_id": rid,
+            "prompt": prompt,
+            "max_new_tokens": 6 + pair % 4,
+            **mode,
+        }
+        specs[rid] = spec
+        path = os.path.join(queue_dir, f"req-{rid}.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(spec, f)
+        os.replace(path + ".tmp", path)
+    return specs
+
+
+def _schema_check(path: str, flag: str, errors: list[str]) -> None:
+    lint = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "check_metrics_schema.py")
+    proc = subprocess.run(
+        [sys.executable, lint, path, flag], capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        errors.append(f"{flag} lint failed for {path}: {proc.stderr}")
+
+
+def run_drill(scratch: str, n_requests: int) -> list[str]:
+    errors: list[str] = []
+    queue_dir = os.path.join(scratch, "queue")
+    workdir = os.path.join(scratch, "wd")
+    os.makedirs(queue_dir, exist_ok=True)
+    os.makedirs(workdir, exist_ok=True)
+    specs = _write_requests(queue_dir, n_requests)
+    # DONE is pre-written: replicas exit once the queue is drained and
+    # their own in-flight work is resolved.
+    with open(os.path.join(queue_dir, "DONE"), "w") as f:
+        f.write("done\n")
+
+    argv = [
+        sys.executable, "-m",
+        "distributed_tensorflow_models_tpu.serving.server",
+        "--queue-dir", queue_dir, "--workdir", workdir,
+        "--max-slots", "4", "--prefill-chunk", "8",
+        "--drain-grace-s", "60",
+        "--self-sigterm-after", str(SIGTERM_AFTER),
+        "--sigterm-replica", str(VICTIM),
+        "--timeout", "240",
+    ]
+    codes = launch.launch_local(
+        2, argv, port=PORT, timeout=420.0,
+        extra_env={
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+            "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
+                "PYTHONPATH", ""
+            ),
+        },
+    )
+    agg = launch.aggregate_exit_codes(codes)
+    if agg != 0:
+        errors.append(f"fleet exit codes {codes} (victim must DRAIN to 0)")
+
+    # -- exactly-once bookkeeping -----------------------------------------
+    claimed_dir = os.path.join(queue_dir, "claimed")
+    resp_dir = os.path.join(queue_dir, "resp")
+    claims: dict[int, list[str]] = {}
+    for name in os.listdir(claimed_dir) if os.path.isdir(claimed_dir) else []:
+        rid = int(name.split("-")[1].split(".")[0])
+        claims.setdefault(rid, []).append(name)
+    for rid, names in sorted(claims.items()):
+        if len(names) > 1:
+            errors.append(f"request {rid} claimed twice: {names}")
+    unclaimed = [
+        n for n in os.listdir(queue_dir)
+        if n.startswith("req-") and n.endswith(".json")
+    ]
+    if unclaimed:
+        errors.append(f"requests never claimed: {sorted(unclaimed)}")
+
+    responses: dict[int, dict] = {}
+    for name in os.listdir(resp_dir) if os.path.isdir(resp_dir) else []:
+        if name.endswith(".json"):
+            with open(os.path.join(resp_dir, name)) as f:
+                responses[int(name.split("-")[1].split(".")[0])] = json.load(f)
+    missing = sorted(set(specs) - set(responses))
+    extra = sorted(set(responses) - set(specs))
+    if missing:
+        errors.append(f"dropped responses (drain lost work): {missing}")
+    if extra:
+        errors.append(f"responses for unknown requests: {extra}")
+
+    for rid, resp in sorted(responses.items()):
+        want = specs[rid]["max_new_tokens"]
+        if len(resp["tokens"]) != want:
+            errors.append(
+                f"request {rid}: {len(resp['tokens'])} tokens, "
+                f"expected {want}"
+            )
+
+    by_replica: dict[int, int] = {}
+    for resp in responses.values():
+        by_replica[resp["replica"]] = by_replica.get(resp["replica"], 0) + 1
+    print(f"  responses by replica: {by_replica}")
+    if by_replica.get(VICTIM, 0) < SIGTERM_AFTER:
+        errors.append(
+            f"victim served {by_replica.get(VICTIM, 0)} < {SIGTERM_AFTER} "
+            "responses — SIGTERM fired before real traffic"
+        )
+    if by_replica.get(1 - VICTIM, 0) == 0:
+        errors.append("survivor served nothing — no failover happened")
+
+    # -- cross-replica determinism ----------------------------------------
+    # Greedy pairs (identical spec, no sampling key involved) must be
+    # byte-identical regardless of which replica served each member.
+    for pair in range(len(specs) // 2):
+        a, b = responses.get(2 * pair), responses.get(2 * pair + 1)
+        if a is None or b is None:
+            continue
+        if specs[2 * pair]["temperature"] == 0.0:
+            if a["tokens"] != b["tokens"]:
+                errors.append(
+                    f"greedy pair ({2 * pair}, {2 * pair + 1}) diverged "
+                    f"(replicas {a['replica']}/{b['replica']}): "
+                    f"{a['tokens']} vs {b['tokens']}"
+                )
+
+    # -- forensics ---------------------------------------------------------
+    for proc_index in (0, 1):
+        record_path = os.path.join(
+            workdir, f"flight_recorder_p{proc_index}.json"
+        )
+        stats_path = os.path.join(
+            workdir, f"serving_stats_p{proc_index}.json"
+        )
+        for path, flag in (
+            (record_path, "--flight-recorder"),
+            (stats_path, "--serving-report"),
+        ):
+            if not os.path.exists(path):
+                errors.append(f"missing artifact {path}")
+                continue
+            _schema_check(path, flag, errors)
+        if os.path.exists(record_path):
+            with open(record_path) as f:
+                record = json.load(f)
+            if record.get("reason") != "serve_drain":
+                errors.append(
+                    f"p{proc_index} flight record reason "
+                    f"{record.get('reason')!r}, expected 'serve_drain'"
+                )
+            names = {e.get("name") for e in record.get("events", [])}
+            if "serve/drain" not in names:
+                errors.append(
+                    f"p{proc_index} flight record has no serve/drain "
+                    f"instant (events: {sorted(x for x in names if x)})"
+                )
+        if os.path.exists(stats_path):
+            with open(stats_path) as f:
+                snap = json.load(f)["metrics"]
+            print(
+                f"  p{proc_index}: {int(snap['serve/requests'])} requests, "
+                f"{int(snap['serve/tokens'])} tokens, "
+                f"ttft p99 {snap['serve/ttft_s/p99_s'] * 1e3:.1f}ms, "
+                f"tpot p99 {snap['serve/tpot_s/p99_s'] * 1e3:.1f}ms"
+            )
+    return errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument(
+        "--scratch", default=None,
+        help="working directory (default: a fresh temp dir)",
+    )
+    p.add_argument(
+        "--keep", action="store_true",
+        help="keep the scratch dir (queue, responses, flight records)",
+    )
+    args = p.parse_args(argv)
+    scratch = args.scratch or tempfile.mkdtemp(prefix="dtm-serve-drill-")
+    os.makedirs(scratch, exist_ok=True)
+    failed = False
+    try:
+        print(f"serve drill in {scratch}: {args.requests} requests, "
+              f"2 replicas, SIGTERM replica {VICTIM} after "
+              f"{SIGTERM_AFTER} responses")
+        errors = run_drill(scratch, args.requests)
+        failed = bool(errors)
+        if errors:
+            print("DRILL serve: FAIL", file=sys.stderr)
+            for e in errors:
+                print(f"  - {e}", file=sys.stderr)
+        else:
+            print("DRILL serve: PASS")
+        return 1 if failed else 0
+    finally:
+        if not args.keep and not failed and args.scratch is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+        elif failed:
+            print(f"artifacts kept in {scratch}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

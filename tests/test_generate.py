@@ -188,6 +188,89 @@ def test_filter_logits_degenerate_knobs():
     assert np.isinf(out[0, [0, 2, 3]]).all()
 
 
+def test_filter_logits_top_k_fast_path_matches_sort():
+    """The top-k-only configuration takes a ``lax.top_k`` partial
+    selection instead of the full vocab sort; this pins the fast path
+    BIT-identical to the reference sort-based filter — including ties
+    at the k-th boundary, where both paths threshold on the identical
+    k-th VALUE (so equal values are kept by both or masked by both)."""
+    from distributed_tensorflow_models_tpu.harness.generate import (
+        _filter_logits,
+    )
+
+    def sort_reference(logits, top_k):
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        kth = sorted_logits[
+            ..., min(top_k, logits.shape[-1]) - 1
+        ][..., None]
+        return jnp.where(logits < kth, -jnp.inf, logits)
+
+    rng = jax.random.key(0)
+    for trial in range(5):
+        rng, k = jax.random.split(rng)
+        logits = jax.random.normal(k, (3, 101)) * 4
+        for top_k in (1, 2, 3, 50, 101, 500):
+            np.testing.assert_array_equal(
+                np.asarray(_filter_logits(logits, top_k, 1.0)),
+                np.asarray(sort_reference(logits, top_k)),
+                err_msg=f"trial {trial} top_k {top_k}",
+            )
+    # Ties straddling the k-th position.
+    tied = jnp.asarray([[1.0, 2.0, 2.0, 2.0, 0.5, 3.0]])
+    for top_k in (1, 2, 3, 4, 5):
+        np.testing.assert_array_equal(
+            np.asarray(_filter_logits(tied, top_k, 1.0)),
+            np.asarray(sort_reference(tied, top_k)),
+            err_msg=f"tied top_k {top_k}",
+        )
+
+
+def test_generate_top_k_sampling_pinned_to_sort_path(small_lm, monkeypatch):
+    """End-to-end pin of the fast path: a top-k sampled generation must
+    be BYTE-identical to the same generation with ``_filter_logits``
+    swapped for the reference full-sort implementation.  If this fails,
+    the ``lax.top_k`` optimisation moved sampled token streams — a
+    correctness regression, not a perf detail."""
+    from distributed_tensorflow_models_tpu.harness import generate as genlib
+
+    model, params = small_lm
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    fast = generate(
+        model, params, prompt, 8,
+        temperature=0.8, top_k=5, rng=jax.random.key(17),
+    )
+
+    def sort_filter(logits, top_k, top_p):
+        if top_k <= 0 and top_p >= 1.0:
+            return logits
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        if top_k > 0:
+            kth = sorted_logits[
+                ..., min(top_k, logits.shape[-1]) - 1
+            ][..., None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+            sorted_logits = jnp.where(
+                sorted_logits < kth, -jnp.inf, sorted_logits
+            )
+        if top_p < 1.0:
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = (cum - probs < top_p).at[..., 0].set(True)
+            cutoff = jnp.min(
+                jnp.where(keep, sorted_logits, jnp.inf),
+                axis=-1, keepdims=True,
+            )
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return logits
+
+    monkeypatch.setattr(genlib, "_filter_logits", sort_filter)
+    reference = generate(
+        model, params, prompt, 8,
+        temperature=0.8, top_k=5, rng=jax.random.key(17),
+    )
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(reference))
+
+
 def test_generate_top_k_one_equals_greedy(small_lm):
     """temperature>0 with top_k=1 must reduce to greedy argmax."""
     model, params = small_lm
@@ -260,6 +343,7 @@ def test_generate_rnn_matches_naive_greedy():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
 
 
+@pytest.mark.slow
 def test_cli_train_then_generate(tmp_path):
     """The user surface: train a transformer_lm checkpoint via the CLI,
     then sample from it with the generate subcommand."""

@@ -416,6 +416,7 @@ def test_fit_auto_resume(mesh8, tmp_path):
     assert int(result3.state.step) == 8
 
 
+@pytest.mark.slow
 def test_fused_loop_host_overhead_drops_k_fold(mesh8, tmp_path):
     """Tier-1 micro-guard for the fused multi-step dispatch: at
     steps_per_loop=K the host overhead per step — jitted dispatches and
@@ -594,6 +595,7 @@ def test_recoverable_fit_does_not_catch_nan_guard(mesh8, tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_fit_then_eval_classification(mesh8, tmp_path):
     cfg = _small_cfg(train_steps=20)
     trainlib.fit(cfg, str(tmp_path), mesh=mesh8)
@@ -625,6 +627,7 @@ def test_fit_lm_and_eval(mesh8, tmp_path):
     assert np.isfinite(res.metrics["perplexity"])
 
 
+@pytest.mark.slow
 def test_async_vs_sync_ab_experiment(mesh8):
     """The reference's flagship A/B ([B:10], SURVEY.md §2.4) as a harness
     call: same init + batch stream through both modes."""

@@ -1,0 +1,446 @@
+"""Continuous-batching serving: the batching-invariance contract.
+
+The flagship assertion: for EVERY sampling mode (greedy, temperature,
+top-k, top-p, combined), a request decoded inside a mixed continuous
+batch — including one admitted mid-flight into a recycled slot — yields
+BYTE-identical tokens to a solo ``generate()`` call with the same key.
+Batching is a throughput decision and must never be a quality decision.
+
+Also pinned here: the two-compiled-programs invariant (admission,
+retirement and slot recycling never recompile), slot-manager
+bookkeeping, admission-budget behaviour, door-step rejection of
+impossible requests, EOS retirement, the serving telemetry surface
+(``serving_stats_p<i>.json`` validated by ``check_metrics_schema.py
+--serving-report``), and the server front half's drain semantics
+(reject-new / finish-accepted / artifacts on exit) — both the explicit
+``drain()`` path and the SIGTERM-listener path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_models_tpu.harness.generate import generate
+from distributed_tensorflow_models_tpu.models import get_model
+from distributed_tensorflow_models_tpu.serving.engine import InferenceEngine
+from distributed_tensorflow_models_tpu.serving.kv_slots import SlotManager
+from distributed_tensorflow_models_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+)
+from distributed_tensorflow_models_tpu.serving.server import (
+    LMServer,
+    ServerDraining,
+)
+from distributed_tensorflow_models_tpu.telemetry import registry as reglib
+
+SCHEMA_LINT = os.path.join(
+    os.path.dirname(__file__), "..", "scripts", "check_metrics_schema.py"
+)
+
+
+def _small_lm(max_len=64):
+    model = get_model(
+        "transformer_lm",
+        vocab_size=50,
+        num_layers=2,
+        num_heads=2,
+        d_model=32,
+        d_ff=64,
+        max_len=max_len,
+        dropout_rate=0.0,
+        dtype=jnp.float32,
+        attn_impl="reference",
+    )
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def small_lm():
+    return _small_lm()
+
+
+@pytest.fixture(scope="module")
+def engine(small_lm):
+    """ONE shared engine: every test drives the same two compiled
+    programs, which is itself part of the shape-stability story."""
+    model, params = small_lm
+    return InferenceEngine(
+        model, params, max_slots=4, prefill_chunk=8,
+        registry=reglib.MetricsRegistry(),
+    )
+
+
+# -- slot manager ----------------------------------------------------------
+
+
+def test_slot_manager_alloc_free_bookkeeping():
+    sm = SlotManager(3)
+    assert sm.free_count == 3 and sm.active_count == 0
+    assert sm.alloc(10) == 0  # lowest-free-first
+    assert sm.alloc(11) == 1
+    assert sm.alloc(12) == 2
+    assert sm.alloc(13) is None  # full
+    assert sm.occupancy == 1.0
+    assert sm.free(1) == 11
+    assert sm.owner(1) is None and sm.owner(0) == 10
+    assert sm.alloc(14) == 1  # recycled: lowest free again
+    assert sm.active_slots() == [0, 1, 2]
+    with pytest.raises(KeyError):
+        sm.free(3)
+    sm.free(1)
+    with pytest.raises(KeyError):
+        sm.free(1)  # double free
+    with pytest.raises(ValueError):
+        SlotManager(0)
+
+
+# -- the flagship: batching invariance -------------------------------------
+
+# Every sampling mode, deliberately mixed in one batch: greedy rides
+# beside temperature, top-k beside nucleus beside combined.
+CONFIGS = [
+    (0.0, 0, 1.0),   # greedy
+    (1.0, 0, 1.0),   # pure temperature
+    (0.8, 5, 1.0),   # top-k
+    (1.0, 0, 0.9),   # nucleus
+    (0.7, 8, 0.85),  # combined
+    (0.0, 0, 1.0),   # second greedy (recycled-slot occupant)
+]
+PLENS = [3, 7, 8, 12, 5, 9]
+MAXNEW = [10, 8, 12, 6, 10, 7]
+
+
+def _mk_requests(rng0):
+    reqs = []
+    for i, ((t, k, p), plen, mn) in enumerate(zip(CONFIGS, PLENS, MAXNEW)):
+        prompt = np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng0, 100 + i), (plen,), 0, 50
+            ),
+            np.int32,
+        )
+        rng = jax.random.fold_in(rng0, i) if t > 0 else None
+        reqs.append(
+            Request(
+                request_id=i, prompt=prompt, max_new_tokens=mn,
+                temperature=t, top_k=k, top_p=p, rng=rng,
+            )
+        )
+    return reqs
+
+
+def test_batched_decode_bit_identical_to_solo_generate(engine, small_lm):
+    """6 mixed-mode requests through 4 slots: the last two are admitted
+    MID-FLIGHT into recycled slots (one only after extra decode steps
+    have advanced the survivors — the hardest recycling case), and every
+    request's stream must be byte-equal to its solo ``generate()``."""
+    model, params = small_lm
+    rng0 = jax.random.key(7)
+    reqs = _mk_requests(rng0)
+    # Budget covers all four slots' padded prompts, so one admission
+    # pass fills the arena (the budget's own behaviour is pinned in
+    # test_admission_budget_bounds_prefill_per_step).
+    sched = ContinuousBatchingScheduler(
+        engine, max_prefill_tokens=64, registry=engine.registry
+    )
+
+    for r in reqs[:5]:
+        sched.submit(r)
+    done = []
+    done.extend(sched.step())  # admits 4 (slots full), decodes once
+    assert sched.active_count == 4 and sched.waiting_count == 1
+    done.extend(sched.step())
+    done.extend(sched.step())
+    sched.submit(reqs[5])  # late arrival: joins a half-advanced batch
+    done.extend(sched.run_until_idle())
+    comps = {c.request_id: c for c in done}
+    assert sorted(comps) == list(range(6))
+
+    for i, r in enumerate(reqs):
+        t, k, p = CONFIGS[i]
+        rng = jax.random.fold_in(rng0, i) if t > 0 else None
+        solo = generate(
+            model, params, jnp.asarray(r.prompt)[None], MAXNEW[i],
+            temperature=t, top_k=k, top_p=p, rng=rng,
+        )
+        solo_new = np.asarray(solo)[0, len(r.prompt):].tolist()
+        assert comps[i].tokens == solo_new, (
+            f"request {i} mode {CONFIGS[i]}: batched stream diverged "
+            f"from solo generate"
+        )
+        assert comps[i].finish_reason == "length"
+        assert comps[i].ttft_s >= 0
+
+    # Shape-stability invariant: the whole mixed workload — chunked
+    # prefills of 5 different prompt lengths, recycling, mid-flight
+    # admission — compiled exactly ONE prefill and ONE decode program.
+    assert engine.compile_counts() == (1, 1)
+
+
+def test_decode_burst_bit_identical_and_single_program(small_lm):
+    """Multi-step scheduling (``decode_burst=4``): the same mixed-mode
+    workload advanced FOUR tokens per dispatch, through 3 slots with
+    mid-flight admissions.  Several ``max_new_tokens`` here are not
+    burst multiples and one request stops on EOS mid-burst, so lanes
+    finish inside a burst and their overrun tokens must be discarded —
+    streams still byte-equal solo ``generate()``, and the burst length
+    being a construction-time constant keeps the program count at
+    exactly (1, 1)."""
+    model, params = small_lm
+    eng = InferenceEngine(
+        model, params, max_slots=3, prefill_chunk=8, decode_burst=4,
+        registry=reglib.MetricsRegistry(),
+    )
+    rng0 = jax.random.key(7)
+    reqs = _mk_requests(rng0)
+    sched = ContinuousBatchingScheduler(
+        eng, max_prefill_tokens=64, registry=eng.registry
+    )
+    for r in reqs[:4]:
+        sched.submit(r)
+    done = list(sched.step())  # admits 3 (slots full), one burst
+    assert sched.active_count == 3 and sched.waiting_count == 1
+    sched.submit(reqs[4])
+    done.extend(sched.step())
+    sched.submit(reqs[5])  # late arrival at a burst boundary
+    done.extend(sched.run_until_idle())
+    comps = {c.request_id: c for c in done}
+    assert sorted(comps) == list(range(6))
+    for i, r in enumerate(reqs):
+        t, k, p = CONFIGS[i]
+        rng = jax.random.fold_in(rng0, i) if t > 0 else None
+        solo = generate(
+            model, params, jnp.asarray(r.prompt)[None], MAXNEW[i],
+            temperature=t, top_k=k, top_p=p, rng=rng,
+        )
+        solo_new = np.asarray(solo)[0, len(r.prompt):].tolist()
+        assert comps[i].tokens == solo_new, (
+            f"request {i} mode {CONFIGS[i]}: burst stream diverged"
+        )
+        assert len(comps[i].tokens) == MAXNEW[i]
+
+    # EOS landing mid-burst: the lane's overrun is discarded and the
+    # stream stops at the EOS, exactly like the solo run.
+    prompt = np.asarray([1, 2, 3], np.int32)
+    solo = np.asarray(
+        generate(model, params, jnp.asarray(prompt)[None], 8)
+    )[0, len(prompt):].tolist()
+    eos = solo[2]
+    sched.submit(
+        Request(request_id=9, prompt=prompt, max_new_tokens=8, eos_id=eos)
+    )
+    (comp,) = sched.run_until_idle()
+    assert comp.finish_reason == "eos"
+    assert comp.tokens == solo[: solo.index(eos) + 1]
+    assert eng.compile_counts() == (1, 1)
+
+
+def test_eos_retirement_matches_solo(engine, small_lm):
+    """A request stopping on EOS retires early with reason "eos" and its
+    stream equals the solo run's up to (and including) the EOS."""
+    model, params = small_lm
+    prompt = np.asarray([1, 2, 3], np.int32)
+    solo = np.asarray(
+        generate(model, params, jnp.asarray(prompt)[None], 8)
+    )[0, len(prompt):].tolist()
+    eos = solo[2]  # force a stop at the 3rd generated token
+    first_eos = solo.index(eos)
+    sched = ContinuousBatchingScheduler(engine, registry=engine.registry)
+    sched.submit(
+        Request(request_id=0, prompt=prompt, max_new_tokens=8, eos_id=eos)
+    )
+    (comp,) = sched.run_until_idle()
+    assert comp.finish_reason == "eos"
+    assert comp.tokens == solo[: first_eos + 1]
+    assert engine.slots.active_count == 0  # slot released
+
+
+def test_admission_budget_bounds_prefill_per_step(engine):
+    """With a one-chunk budget, only one waiting prompt is admitted per
+    iteration (the first is always allowed; the second would exceed the
+    budget) — the TPOT-spike bound."""
+    sched = ContinuousBatchingScheduler(
+        engine, max_prefill_tokens=engine.prefill_chunk,
+        registry=engine.registry,
+    )
+    for i in range(3):
+        sched.submit(
+            Request(
+                request_id=i,
+                prompt=np.arange(engine.prefill_chunk, dtype=np.int32),
+                max_new_tokens=4,
+            )
+        )
+    sched.step()
+    assert sched.active_count == 1 and sched.waiting_count == 2
+    sched.step()
+    assert sched.active_count == 2 and sched.waiting_count == 1
+    sched.run_until_idle()
+    assert not sched.has_work
+
+
+def test_submit_rejects_impossible_requests(engine):
+    sched = ContinuousBatchingScheduler(engine, registry=engine.registry)
+    ok = np.asarray([1, 2, 3], np.int32)
+    with pytest.raises(ValueError):  # empty prompt
+        sched.submit(Request(0, np.zeros((0,), np.int32), 4))
+    with pytest.raises(ValueError):  # max_new < 1
+        sched.submit(Request(0, ok, 0))
+    with pytest.raises(ValueError):  # total exceeds max_len
+        sched.submit(Request(0, ok, engine.max_len))
+    with pytest.raises(ValueError):  # sampling without a key
+        sched.submit(Request(0, ok, 4, temperature=0.5))
+    assert not sched.has_work  # nothing half-enqueued
+
+
+def test_check_fits_rejects_padded_overflow():
+    """A prompt whose REAL length fits but whose right-padded chunked
+    footprint would exceed the arena must be rejected at the door — a
+    clamped final-chunk write would corrupt real cache positions."""
+    model, params = _small_lm(max_len=64)
+    eng = InferenceEngine(
+        model, params, max_slots=2, prefill_chunk=12,
+        registry=reglib.MetricsRegistry(),
+    )
+    eng.check_fits(55, 5)  # padded 60 <= 64: fine
+    with pytest.raises(ValueError, match="padded"):
+        eng.check_fits(61, 1)  # total 62 fits, padded 72 does not
+
+
+def test_serving_telemetry_surface(engine):
+    """The shared engine's registry accumulated the full serving key
+    set across the tests above (requests/tokens counters, TTFT/TPOT +
+    load distributions, device spans)."""
+    snap = engine.registry.snapshot()
+    assert snap[reglib.SERVE_REQUESTS] >= 6
+    assert snap[reglib.SERVE_TOKENS] >= sum(MAXNEW)
+    for key in (
+        reglib.SERVE_TTFT, reglib.SERVE_TPOT, reglib.SERVE_PREFILL,
+        reglib.SERVE_DECODE, reglib.SERVE_QUEUE_DEPTH,
+        reglib.SERVE_SLOT_OCCUPANCY,
+    ):
+        assert snap[f"{key}/count"] > 0, key
+    # Occupancy is a fraction.
+    assert 0.0 <= snap[f"{reglib.SERVE_SLOT_OCCUPANCY}/max_s"] <= 1.0
+
+
+# -- server front half -----------------------------------------------------
+
+
+def _factory(max_slots=4, prefill_chunk=8):
+    def build():
+        model, params = _small_lm()
+        return InferenceEngine(
+            model, params, max_slots=max_slots, prefill_chunk=prefill_chunk
+        )
+
+    return build
+
+
+@pytest.mark.slow
+def test_server_lifecycle_and_drain_artifacts(tmp_path):
+    """Submit → results → stats → drain: post-drain submits are
+    rejected, and the exit leaves a schema-clean serving stats report
+    and flight record (validated by the SAME lint an operator runs)."""
+    srv = LMServer(_factory(), workdir=str(tmp_path), process_index=0)
+    with pytest.raises(RuntimeError):
+        srv.submit([1, 2], 2)  # not started
+    srv.start()
+    handles = [
+        srv.submit(
+            [1, 2, 3 + i], 6,
+            temperature=0.7 if i % 2 else 0.0,
+            top_k=5 if i % 2 else 0, seed=i,
+        )
+        for i in range(6)
+    ]
+    comps = [h.result(timeout=300) for h in handles]
+    assert [c.request_id for c in comps] == [h.request_id for h in handles]
+    assert all(len(c.tokens) == 6 for c in comps)
+
+    # A structurally-bad request fails ITS handle, not the server.
+    bad = srv.submit([5] * 100, 50)
+    with pytest.raises(ValueError):
+        bad.result(timeout=300)
+    ok = srv.submit([1], 3)
+    assert len(ok.result(timeout=300).tokens) == 3
+
+    stats = srv.stats()
+    assert stats["metrics"][reglib.SERVE_REQUESTS] == 7.0  # bad: rejected
+    srv.drain()
+    with pytest.raises(ServerDraining):
+        srv.submit([1], 1)
+
+    stats_path = tmp_path / "serving_stats_p0.json"
+    record_path = tmp_path / "flight_recorder_p0.json"
+    for path, flag in (
+        (stats_path, "--serving-report"),
+        (record_path, "--flight-recorder"),
+    ):
+        proc = subprocess.run(
+            [sys.executable, SCHEMA_LINT, str(path), flag],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+    record = json.loads(record_path.read_text())
+    names = {e["name"] for e in record["events"]}
+    assert {"serve/prefill", "serve/decode", "serve/drain"} <= names
+    assert record["reason"] == "serve_drain"
+
+
+class _StubListener:
+    """Stands in for resilience.preemption.PreemptionListener: the
+    server only reads ``.preempted``."""
+
+    def __init__(self):
+        self.preempted = False
+
+
+@pytest.mark.slow
+def test_server_sigterm_drain_finishes_accepted_work(tmp_path):
+    """The listener path: once preemption is observed, new submits are
+    rejected but every accepted request still completes (drain, not
+    abort), and the worker exits on its own."""
+    listener = _StubListener()
+    srv = LMServer(
+        _factory(), workdir=str(tmp_path), process_index=1,
+        listener=listener,
+    )
+    srv.start()
+    handles = [srv.submit([1, 2, 3 + i], 5) for i in range(5)]
+    listener.preempted = True  # "SIGTERM" mid-traffic
+    with pytest.raises(ServerDraining):
+        srv.submit([9], 2)
+    for h in handles:
+        assert len(h.result(timeout=300).tokens) == 5
+    srv.drain()  # join; worker already exiting via the listener
+    assert (tmp_path / "flight_recorder_p1.json").exists()
+    assert (tmp_path / "serving_stats_p1.json").exists()
+
+
+def test_engine_factory_failure_fails_handles_not_hangs():
+    def broken():
+        raise RuntimeError("no accelerator for you")
+
+    srv = LMServer(broken)
+    srv.start()
+    # Whether the worker died before or after this submit, the handle
+    # must fail promptly rather than wait forever.
+    try:
+        h = srv.submit([1], 1)
+        with pytest.raises((RuntimeError, ServerDraining)):
+            h.result(timeout=60)
+    except ServerDraining:
+        pass
+    with pytest.raises(RuntimeError, match="no accelerator"):
+        srv.drain()
