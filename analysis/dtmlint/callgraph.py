@@ -1,0 +1,533 @@
+"""Per-project symbol table, call graph, and function summaries.
+
+This is the interprocedural layer under the v2 rule packs
+(recompile-hazard, donation-safety, lock-discipline) and the upgraded
+collective-lockstep.  It is built once per :class:`Project` from the
+already-parsed ASTs — no re-parsing, no imports, no execution.
+
+Resolution policy (deliberately conservative — an *unknown callee is
+assumed benign*, so a miss can only hide a finding, never invent one):
+
+- bare names resolve through enclosing nested defs, then module-level
+  functions of the same file, then ``from m import f`` / ``import m``
+  edges into other project files;
+- ``self.m(...)`` resolves within the enclosing class, then same-file
+  base classes;
+- ``alias.f(...)`` resolves when ``alias`` is an imported project
+  module;
+- any other attribute call resolves only when exactly one
+  function/method with that name exists project-wide AND the name is
+  not a ubiquitous stdlib method name (``get``, ``join``, ``run``...)
+  — the "method resolution by class where unambiguous" rule.
+
+Summaries answer, per function: does it (transitively) perform a
+collective, block (queue get/put, join, wait, sleep), acquire a lock,
+or read a given ``self.<attr>``?  Receiver *types* (lock / condition /
+event / queue / thread) are inferred per file from constructor
+assignments (``self._lock = threading.Lock()``) and annotations — a
+bare ``.acquire`` on an untyped receiver is never matched, so
+``self._aot.acquire(sig)`` on the AOT cache stays invisible to the
+lock rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional
+
+from analysis.dtmlint.astutil import (
+    COLLECTIVE_CALLS,
+    call_name,
+    dotted_name,
+    walk_in_scope,
+)
+from analysis.dtmlint.core import Project, SourceFile
+
+# Constructor name -> inferred receiver kind.  Matching is on the last
+# attribute of the constructor call (``threading.Lock`` and a bare
+# ``Lock`` both register).
+_CTOR_KINDS = {
+    "Lock": "lock",
+    "RLock": "lock",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+    "Condition": "condition",
+    "Event": "event",
+    "Queue": "queue",
+    "SimpleQueue": "queue",
+    "LifoQueue": "queue",
+    "PriorityQueue": "queue",
+    "Thread": "thread",
+    "Timer": "thread",
+}
+
+# Attribute-call names too generic to resolve by project-wide
+# uniqueness: dict.get, str.join, list.pop ... resolving these through
+# an unknown receiver would be guessing, not resolution.
+_AMBIENT_METHODS = frozenset(
+    {
+        "get", "put", "join", "wait", "set", "clear", "run", "start",
+        "stop", "close", "read", "write", "update", "append", "add",
+        "pop", "items", "keys", "values", "copy", "send", "submit",
+        "result", "open", "flush", "acquire", "release", "apply",
+        "init", "get_nowait", "put_nowait", "next", "count", "index",
+        "sum", "mean", "item", "reshape", "astype", "format", "strip",
+        "split", "encode", "decode", "setdefault", "extend", "sort",
+    }
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncInfo:
+    """One function/method definition in the project."""
+
+    rel: str  # file, repo-relative posix
+    qualname: str  # "f", "Cls.m", "outer.<locals>.inner"
+    node: ast.AST  # the FunctionDef (not hashed; identity via rel+qual)
+    cls: Optional[str] = None  # enclosing class name, if a method
+
+    def __hash__(self):
+        return hash((self.rel, self.qualname))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, FuncInfo)
+            and self.rel == other.rel
+            and self.qualname == other.qualname
+        )
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    def params(self, *, skip_self: bool = False) -> list:
+        """Positional parameter names (posonly + args), optionally
+        dropping a leading ``self``/``cls``."""
+        a = self.node.args
+        names = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+        if skip_self and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        return names
+
+
+@dataclasses.dataclass
+class BlockEvent:
+    desc: str  # human-readable op, e.g. "queue.get on `self._queue`"
+    lineno: int
+
+
+@dataclasses.dataclass
+class Summary:
+    """Direct (non-transitive) facts about one function body."""
+
+    collectives: list  # [(name, lineno)]
+    blocking: list  # [BlockEvent]
+    acquires: list  # [(receiver dotted, lineno)]
+    self_reads: frozenset  # attrs read via self.<attr> (Load context)
+    calls: list  # [(FuncInfo, ast.Call)] resolved project calls
+
+
+class FileIndex:
+    """Symbols, imports and receiver types for one source file."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.functions: dict[str, FuncInfo] = {}
+        self.classes: dict[str, dict[str, FuncInfo]] = {}
+        self.bases: dict[str, list[str]] = {}  # class -> base names
+        self.import_modules: dict[str, str] = {}  # alias -> dotted module
+        self.from_imports: dict[str, tuple[str, str]] = {}  # name->(mod,attr)
+        self.typed: dict[str, Optional[str]] = {}  # name tail -> kind
+        self._index(sf.tree)
+
+    def _index(self, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            if isinstance(stmt, _FUNC_NODES):
+                self.functions[stmt.name] = FuncInfo(
+                    self.sf.rel, stmt.name, stmt
+                )
+            elif isinstance(stmt, ast.ClassDef):
+                methods = {}
+                for sub in stmt.body:
+                    if isinstance(sub, _FUNC_NODES):
+                        methods[sub.name] = FuncInfo(
+                            self.sf.rel,
+                            f"{stmt.name}.{sub.name}",
+                            sub,
+                            cls=stmt.name,
+                        )
+                self.classes[stmt.name] = methods
+                self.bases[stmt.name] = [
+                    b.id for b in stmt.bases if isinstance(b, ast.Name)
+                ]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    self.import_modules[local] = (
+                        alias.name if alias.asname
+                        else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:  # relative import: prefix with own package
+                    pkg = self.sf.rel.rsplit("/", 1)[0].replace("/", ".")
+                    for _ in range(node.level - 1):
+                        pkg = pkg.rsplit(".", 1)[0]
+                    mod = f"{pkg}.{node.module}"
+                else:
+                    mod = node.module
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.from_imports[local] = (mod, alias.name)
+            # Receiver typing: `x = threading.Lock()` / `self._q = Queue()`
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                kind = None
+                value = getattr(node, "value", None)
+                if isinstance(value, ast.Call):
+                    kind = _CTOR_KINDS.get(call_name(value))
+                ann = getattr(node, "annotation", None)
+                if kind is None and ann is not None:
+                    tail = dotted_name(ann)
+                    if tail:
+                        kind = _CTOR_KINDS.get(tail.rsplit(".", 1)[-1])
+                if kind is None:
+                    continue
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    tail = None
+                    if isinstance(t, ast.Name):
+                        tail = t.id
+                    elif isinstance(t, ast.Attribute):
+                        tail = t.attr
+                    if tail is None:
+                        continue
+                    if tail in self.typed and self.typed[tail] != kind:
+                        self.typed[tail] = None  # ambiguous -> untyped
+                    else:
+                        self.typed[tail] = kind
+
+    def kind_of(self, receiver: Optional[str]) -> Optional[str]:
+        """Inferred kind for a dotted receiver (matched by tail)."""
+        if not receiver:
+            return None
+        return self.typed.get(receiver.rsplit(".", 1)[-1])
+
+    def class_method(self, cls: str, name: str) -> Optional[FuncInfo]:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            fi = self.classes.get(c, {}).get(name)
+            if fi is not None:
+                return fi
+            stack.extend(self.bases.get(c, []))
+        return None
+
+
+@dataclasses.dataclass
+class Ctx:
+    """Where a call site sits: needed to resolve names correctly."""
+
+    rel: str
+    cls: Optional[str] = None  # enclosing class name
+    func_stack: tuple = ()  # enclosing FunctionDef nodes, outer->inner
+
+
+class CallGraph:
+    """Symbol table + resolver + memoised summaries for a project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.by_rel = {sf.rel: FileIndex(sf) for sf in project.files}
+        # For unambiguous attribute resolution: name -> all defs.
+        self._by_name: dict[str, list[FuncInfo]] = {}
+        for idx in self.by_rel.values():
+            for fi in idx.functions.values():
+                self._by_name.setdefault(fi.name, []).append(fi)
+            for methods in idx.classes.values():
+                for fi in methods.values():
+                    self._by_name.setdefault(fi.name, []).append(fi)
+        self._summaries: dict[FuncInfo, Summary] = {}
+        self._collective_chain: dict[FuncInfo, Optional[tuple]] = {}
+        self._block_chain: dict[FuncInfo, Optional[tuple]] = {}
+        self._reads_closure: dict[FuncInfo, frozenset] = {}
+
+    @classmethod
+    def of(cls, project: Project) -> "CallGraph":
+        """The project's call graph, built once and cached."""
+        cg = getattr(project, "_dtmlint_callgraph", None)
+        if cg is None:
+            cg = cls(project)
+            project._dtmlint_callgraph = cg
+        return cg
+
+    # -- resolution --------------------------------------------------------
+
+    def _module_index(self, dotted: str) -> Optional[FileIndex]:
+        rel = self.project.resolve_module(dotted)
+        return self.by_rel.get(rel) if rel else None
+
+    def resolve(self, call: ast.Call, ctx: Ctx) -> Optional[FuncInfo]:
+        """FuncInfo for a call's target, or None (= unknown, benign)."""
+        return self.resolve_target(call.func, ctx)
+
+    def resolve_target(self, func: ast.AST, ctx: Ctx) -> Optional[FuncInfo]:
+        idx = self.by_rel.get(ctx.rel)
+        if idx is None:
+            return None
+        if isinstance(func, ast.Name):
+            return self._resolve_bare(func.id, idx, ctx)
+        if isinstance(func, ast.Attribute):
+            # self.method() within the enclosing class (and same-file
+            # bases).
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id in ("self", "cls")
+                and ctx.cls
+            ):
+                return idx.class_method(ctx.cls, func.attr)
+            dotted = dotted_name(func)
+            if dotted:
+                head, _, rest = dotted.partition(".")
+                mod = idx.import_modules.get(head)
+                if mod is not None:
+                    return self._resolve_dotted(f"{mod}.{rest}")
+                if head in idx.from_imports:
+                    fmod, fattr = idx.from_imports[head]
+                    sub = self._module_index(f"{fmod}.{fattr}")
+                    if sub is not None and "." not in rest:
+                        return sub.functions.get(rest)
+            # Unknown receiver: resolve only when the method name is
+            # project-unique and not an ambient stdlib name.
+            if func.attr in _AMBIENT_METHODS:
+                return None
+            cands = self._by_name.get(func.attr, [])
+            if len(cands) == 1 and cands[0].cls is not None:
+                return cands[0]
+            return None
+        return None
+
+    def _resolve_bare(
+        self, name: str, idx: FileIndex, ctx: Ctx
+    ) -> Optional[FuncInfo]:
+        # Nested defs, innermost enclosing scope first.
+        for fn in reversed(ctx.func_stack):
+            for stmt in fn.body:
+                if isinstance(stmt, _FUNC_NODES) and stmt.name == name:
+                    return FuncInfo(
+                        ctx.rel,
+                        f"{fn.name}.<locals>.{name}",
+                        stmt,
+                        cls=None,
+                    )
+        fi = idx.functions.get(name)
+        if fi is not None:
+            return fi
+        if name in idx.from_imports:
+            mod, attr = idx.from_imports[name]
+            sub = self._module_index(mod)
+            if sub is not None:
+                return sub.functions.get(attr)
+        return None
+
+    def _resolve_dotted(self, dotted: str) -> Optional[FuncInfo]:
+        """``pkg.mod.func`` -> FuncInfo when pkg.mod is a project file."""
+        mod, _, attr = dotted.rpartition(".")
+        if not mod or not attr:
+            return None
+        sub = self._module_index(mod)
+        if sub is None:
+            return None
+        return sub.functions.get(attr)
+
+    # -- direct summaries --------------------------------------------------
+
+    def blocking_op(
+        self, call: ast.Call, idx: FileIndex
+    ) -> Optional[str]:
+        """Describe ``call`` if it can block the calling thread."""
+        name = call_name(call)
+        dotted = dotted_name(call.func)
+        if dotted in ("time.sleep", "subprocess.run", "subprocess.call",
+                      "subprocess.check_call", "subprocess.check_output"):
+            return f"`{dotted}`"
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        recv = dotted_name(call.func.value)
+        kind = idx.kind_of(recv)
+        if kind is None:
+            return None
+        if name in ("get", "put") and kind == "queue":
+            for kw in call.keywords:
+                if kw.arg == "block" and isinstance(
+                    kw.value, ast.Constant
+                ) and kw.value.value is False:
+                    return None
+            return f"queue.{name} on `{recv}`"
+        if name == "join" and kind in ("thread", "queue"):
+            return f"{kind}.join on `{recv}`"
+        if name == "wait" and kind in ("event", "condition"):
+            return f"{kind}.wait on `{recv}`"
+        if name == "acquire" and kind in ("lock", "condition"):
+            return f"{kind}.acquire on `{recv}`"
+        return None
+
+    def summary(self, fi: FuncInfo) -> Summary:
+        got = self._summaries.get(fi)
+        if got is not None:
+            return got
+        idx = self.by_rel.get(fi.rel)
+        ctx = Ctx(
+            rel=fi.rel, cls=fi.cls,
+            func_stack=tuple(
+                s for s in _enclosing_chain(idx.sf.tree, fi.node)
+            ) + (fi.node,),
+        )
+        collectives, blocking, acquires, calls = [], [], [], []
+        reads = set()
+        for node in walk_in_scope(fi.node):
+            if isinstance(node, ast.Call):
+                nm = call_name(node)
+                if nm in COLLECTIVE_CALLS:
+                    collectives.append((nm, node.lineno))
+                desc = self.blocking_op(node, idx)
+                if desc:
+                    blocking.append(BlockEvent(desc, node.lineno))
+                if nm == "acquire" and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    recv = dotted_name(node.func.value)
+                    if idx.kind_of(recv) in ("lock", "condition"):
+                        acquires.append((recv, node.lineno))
+                target = self.resolve(node, ctx)
+                if target is not None and target != fi:
+                    calls.append((target, node))
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    reads.add(node.attr)
+        out = Summary(
+            collectives=collectives,
+            blocking=blocking,
+            acquires=acquires,
+            self_reads=frozenset(reads),
+            calls=calls,
+        )
+        self._summaries[fi] = out
+        return out
+
+    # -- transitive queries ------------------------------------------------
+
+    def collective_chain(self, fi: FuncInfo) -> Optional[tuple]:
+        """``(helper, ..., collective_name)`` when ``fi`` transitively
+        performs a collective; None otherwise."""
+        return self._transitive(
+            fi, self._collective_chain,
+            lambda s: s.collectives[0][0] if s.collectives else None,
+        )
+
+    def block_chain(self, fi: FuncInfo) -> Optional[tuple]:
+        """``(helper, ..., op_desc)`` when ``fi`` transitively blocks."""
+        return self._transitive(
+            fi, self._block_chain,
+            lambda s: s.blocking[0].desc if s.blocking else None,
+        )
+
+    def _transitive(self, fi, memo, leaf, _stack=None):
+        if fi in memo:
+            return memo[fi]
+        stack = _stack if _stack is not None else set()
+        if fi in stack:  # recursion cycle: nothing new on this path
+            return None
+        stack.add(fi)
+        try:
+            s = self.summary(fi)
+            hit = leaf(s)
+            if hit is not None:
+                memo[fi] = (hit,)
+                return memo[fi]
+            for target, _ in s.calls:
+                sub = self._transitive(target, memo, leaf, stack)
+                if sub is not None:
+                    memo[fi] = (target.name,) + sub
+                    return memo[fi]
+            memo[fi] = None
+            return None
+        finally:
+            stack.discard(fi)
+
+    def reads_self_attrs(self, fi: FuncInfo) -> frozenset:
+        """self.<attr> names read by ``fi`` or any same-class method it
+        (transitively) calls through ``self``."""
+        got = self._reads_closure.get(fi)
+        if got is not None:
+            return got
+        seen: set = set()
+        attrs: set = set()
+        stack = [fi]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            s = self.summary(cur)
+            attrs |= s.self_reads
+            for target, _ in s.calls:
+                if target.cls is not None and target.cls == cur.cls:
+                    stack.append(target)
+        out = frozenset(attrs)
+        self._reads_closure[fi] = out
+        return out
+
+
+def _enclosing_chain(tree: ast.Module, target: ast.AST) -> list:
+    """Function defs lexically enclosing ``target`` (outer -> inner)."""
+    chain: list = []
+
+    def visit(node, acc):
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                chain.extend(acc)
+                return True
+            nxt = acc + [child] if isinstance(child, _FUNC_NODES) else acc
+            if visit(child, nxt):
+                return True
+        return False
+
+    visit(tree, [])
+    return chain
+
+
+def iter_functions(sf: SourceFile) -> Iterator[tuple]:
+    """Yield ``(FuncInfo, Ctx)`` for every function def in a file
+    (module-level, methods, nested), with correct resolution context."""
+
+    def visit(node, cls, func_stack, qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                q = f"{qual}.{child.name}" if qual else child.name
+                fi = FuncInfo(sf.rel, q, child, cls=cls)
+                yield fi, Ctx(sf.rel, cls=cls, func_stack=func_stack)
+                yield from visit(
+                    child, cls, func_stack + (child,),
+                    f"{q}.<locals>",
+                )
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name, func_stack, child.name)
+            else:
+                yield from visit(child, cls, func_stack, qual)
+
+    yield from visit(sf.tree, None, (), "")

@@ -278,8 +278,17 @@ def run(
     only: Optional[Iterable[str]] = None,
     disable: Iterable[str] = (),
     baseline: Optional[Sequence[Finding]] = None,
+    restrict_paths: Optional[Iterable[str]] = None,
 ) -> LintResult:
-    """Lint the configured tree and return the filtered result."""
+    """Lint the configured tree and return the filtered result.
+
+    ``restrict_paths`` keeps only findings (and baseline entries) whose
+    path is in the given set — the whole tree is still *parsed*, so
+    interprocedural rules see full call-graph context, but only the
+    named files can report.  This is ``--changed-only``'s engine: a
+    one-file change agrees with the full run for that file by
+    construction.
+    """
     from analysis.dtmlint import rules as rules_pkg
 
     all_rules = rules_pkg.ALL_RULES
@@ -329,7 +338,13 @@ def run(
                     )
                 )
 
-    new, old, stale = apply_baseline(kept, baseline or [])
+    base = list(baseline or [])
+    if restrict_paths is not None:
+        restrict = set(restrict_paths)
+        kept = [f for f in kept if f.path in restrict]
+        base = [b for b in base if b.path in restrict]
+
+    new, old, stale = apply_baseline(kept, base)
     return LintResult(
         new=sorted(new),
         baselined=sorted(old),

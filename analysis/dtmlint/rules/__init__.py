@@ -3,9 +3,12 @@
 
 from analysis.dtmlint.rules import (
     determinism,
+    donation,
     jaxfree,
+    locks,
     lockstep,
     metric_keys,
+    recompile,
     threads,
     wire,
 )
@@ -17,4 +20,7 @@ ALL_RULES = [
     (threads.RULE_ID, threads.check),
     (determinism.RULE_ID, determinism.check),
     (metric_keys.RULE_ID, metric_keys.check),
+    (recompile.RULE_ID, recompile.check),
+    (donation.RULE_ID, donation.check),
+    (locks.RULE_ID, locks.check),
 ]

@@ -1,0 +1,242 @@
+"""donation-safety — a donated buffer must not be read afterwards.
+
+``jax.jit(fn, donate_argnums=(1,))`` hands argument 1's device buffer
+to the compiled program, which is free to scribble over it in place —
+the caller's reference is *invalidated* the moment the call launches.
+The engine's arena protocol survives this by always rebinding in the
+same statement (``self.arena, tok = self._prefill_j(self.params,
+self.arena, ...)``): every read after the call sees the fresh buffer.
+The bug class this rule catches is the other path — donate, then touch
+the stale handle:
+
+- donate then read in a later statement (``out = step(state); log(
+  state.loss)``) — garbage or a runtime "buffer donated" error;
+- donate inside a loop without rebinding — iteration 2 re-donates a
+  dead buffer;
+- interprocedurally: donate ``self.arena`` then call a method whose
+  (transitive) summary reads ``self.arena``.
+
+Resolution is per file: a donated *binding* is ``name = jax.jit(fn,
+donate_argnums=(ints...))`` where the target is a local or a
+``self.<attr>`` (matched at call sites by tail, exactly how the engine
+spells ``self._prefill_j``).  Non-constant ``donate_argnums`` (the
+train loop's ``(0,) if donate else ()``) make the binding invisible —
+conservative, never noisy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from analysis.dtmlint.astutil import dotted_name, fold_int
+from analysis.dtmlint.callgraph import CallGraph, iter_functions
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "donation-safety"
+
+_JIT_NAMES = frozenset({"jax.jit", "jit", "jax.pmap", "pmap"})
+
+
+def _donated_bindings(tree: ast.Module) -> dict:
+    """``{target tail: (positions...)}`` for constant donate_argnums."""
+    out: dict[str, tuple] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        call = node.value
+        if not (
+            isinstance(call, ast.Call)
+            and dotted_name(call.func) in _JIT_NAMES
+        ):
+            continue
+        positions: Optional[tuple] = None
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            elts = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            folded = [fold_int(e) for e in elts]
+            if any(v is None for v in folded):
+                positions = None  # dynamic spec: stay silent
+            else:
+                positions = tuple(folded)
+        if not positions:
+            continue
+        t = node.targets[0]
+        tail = t.id if isinstance(t, ast.Name) else (
+            t.attr if isinstance(t, ast.Attribute) else None
+        )
+        if tail:
+            out[tail] = positions
+    return out
+
+
+def _target_names(t: ast.AST) -> list:
+    """Dotted names bound by an assignment target."""
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            e = e.value if isinstance(e, ast.Starred) else e
+            out.extend(_target_names(e))
+        return out
+    dn = dotted_name(t)
+    return [dn] if dn else []
+
+
+def _stmt_of(func_node: ast.AST, call: ast.Call) -> Optional[ast.stmt]:
+    for node in ast.walk(func_node):
+        for field in ("body", "orelse", "finalbody"):
+            seq = getattr(node, field, None)
+            if not isinstance(seq, list):
+                continue
+            for stmt in seq:
+                if isinstance(stmt, ast.stmt) and any(
+                    n is call for n in ast.walk(stmt)
+                ):
+                    inner = _stmt_of(stmt, call)
+                    return inner if inner is not None else stmt
+    return None
+
+
+def _assigns(stmt: ast.stmt, name: str) -> bool:
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Assign):
+            if any(name in _target_names(t) for t in node.targets):
+                return True
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if name in _target_names(node.target):
+                return True
+    return False
+
+
+def _enclosing_loop(func_node, call_stmt) -> Optional[ast.stmt]:
+    """Innermost For/While containing ``call_stmt`` within the
+    function (not crossing into nested defs)."""
+    loops = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if child is call_stmt:
+                return True
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.Lambda)
+            ):
+                continue
+            if isinstance(child, (ast.For, ast.While)):
+                loops.append(child)
+                if visit(child):
+                    return True
+                loops.pop()
+            elif visit(child):
+                return True
+        return False
+
+    visit(func_node)
+    return loops[-1] if loops else None
+
+
+def check(project: Project):
+    cg = CallGraph.of(project)
+    for sf in project.files:
+        bindings = _donated_bindings(sf.tree)
+        if not bindings:
+            continue
+        for fi, ctx in iter_functions(sf):
+            if "<locals>" in fi.qualname:
+                continue  # analysed via their enclosing function walk
+            yield from _check_function(cg, sf, fi, ctx, bindings)
+
+
+def _check_function(cg, sf, fi, ctx, bindings):
+    func = fi.node
+    for call in [
+        n for n in ast.walk(func) if isinstance(n, ast.Call)
+    ]:
+        dn = dotted_name(call.func)
+        if dn is None:
+            continue
+        tail = dn.rsplit(".", 1)[-1]
+        positions = bindings.get(tail)
+        if positions is None:
+            continue
+        for pos in positions:
+            if pos >= len(call.args):
+                continue
+            donated = dotted_name(call.args[pos])
+            if donated is None:
+                continue  # fresh temporary, nothing to invalidate
+            yield from _check_donated(
+                cg, sf, fi, ctx, func, call, donated
+            )
+
+
+def _check_donated(cg, sf, fi, ctx, func, call, donated):
+    call_stmt = _stmt_of(func, call)
+    if call_stmt is None:
+        return
+    if _assigns(call_stmt, donated):
+        return  # rebound in the same statement: the sanctioned pattern
+    loop = _enclosing_loop(func, call_stmt)
+    if loop is not None and not any(
+        _assigns(s, donated) for s in loop.body
+    ):
+        yield Finding(
+            sf.rel, call.lineno, RULE_ID,
+            f"`{donated}` is donated at line {call.lineno} inside a "
+            "loop but never rebound — the next iteration re-donates a "
+            "dead buffer",
+        )
+        return
+    # Straight-line: first later touch decides.  Loads and stores on
+    # the same line keep runtime order (call arguments are read before
+    # the assignment stores).
+    events = []
+    self_attr = (
+        donated.split(".", 1)[1].split(".")[0]
+        if donated.startswith("self.") else None
+    )
+    for node in ast.walk(func):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if dotted_name(node) != donated:
+                continue
+            if node.lineno <= (call_stmt.end_lineno or call_stmt.lineno):
+                continue
+            is_store = isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            )
+            events.append((node.lineno, 0 if not is_store else 1,
+                           is_store, node))
+        elif (
+            self_attr is not None
+            and isinstance(node, ast.Call)
+            and node.lineno > (call_stmt.end_lineno or call_stmt.lineno)
+        ):
+            target = cg.resolve(node, ctx)
+            if target is None or target.cls is None:
+                continue
+            if self_attr in cg.reads_self_attrs(target):
+                events.append((node.lineno, 0, "call", node))
+    for lineno, _, kind, node in sorted(events, key=lambda e: e[:2]):
+        if kind is True:  # store: handle is rebound, donation is over
+            return
+        if kind == "call":
+            target = cg.resolve(node, ctx)
+            yield Finding(
+                sf.rel, lineno, RULE_ID,
+                f"`{donated}` was donated at line {call.lineno}; "
+                f"`{target.name}()` reads `self.{self_attr}` after the "
+                "buffer is gone",
+            )
+            return
+        yield Finding(
+            sf.rel, lineno, RULE_ID,
+            f"`{donated}` read here but its buffer was donated at "
+            f"line {call.lineno} (donate_argnums) — rebind in the "
+            "same statement or stop reading the stale handle",
+        )
+        return

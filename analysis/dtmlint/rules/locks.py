@@ -1,0 +1,198 @@
+"""lock-discipline — locks that outlive exceptions, and blocking
+while holding one.
+
+The serving front half is a thread-per-request admission path feeding
+a single worker (``serving/server.py``); the data pipeline is a stage
+graph of daemon threads and bounded queues (``data/pipeline.py``).  In
+both, the deadlock recipes are always the same three:
+
+1. **bare acquire** — ``lock.acquire()`` without ``with`` or a
+   try/finally release: the first exception leaves the lock held
+   forever and every other thread wedges at the next acquire;
+2. **blocking under a lock** — ``queue.get``/``put``, ``join``,
+   ``wait``, ``time.sleep`` (or, interprocedurally, a helper whose
+   summary says it blocks) inside a ``with lock:`` body: the blocked
+   thread holds the lock the unblocking thread needs — classic
+   lock-ordering inversion with a queue in the middle;
+3. **naked Condition.wait** — ``cond.wait()`` outside a ``while``
+   predicate loop: spurious wakeups are allowed by the memory model,
+   so straight-line waits are latent races (``wait_for`` is fine — it
+   loops internally).
+
+Receivers are matched by *inferred type only* (constructor
+assignments like ``self._lock = threading.Lock()``), never by bare
+method name — ``self._aot.acquire(sig)`` on the AOT-cache object and
+``dict.get`` stay invisible.  ``cond.wait()`` while holding ``cond``
+itself is exempt from (2): Condition.wait releases its own lock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from analysis.dtmlint.astutil import call_name, dotted_name
+from analysis.dtmlint.callgraph import CallGraph, Ctx, iter_functions
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "lock-discipline"
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _receiver(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return dotted_name(call.func.value)
+    return None
+
+
+def _release_guarded(func_node: ast.AST, tail: str) -> bool:
+    """True when some try/finally in the function releases ``tail``."""
+    for node in ast.walk(func_node):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        for fin in node.finalbody:
+            for sub in ast.walk(fin):
+                if (
+                    isinstance(sub, ast.Call)
+                    and call_name(sub) == "release"
+                ):
+                    recv = _receiver(sub)
+                    if recv and recv.rsplit(".", 1)[-1] == tail:
+                        return True
+    return False
+
+
+def check(project: Project):
+    cg = CallGraph.of(project)
+    for sf in project.files:
+        idx = cg.by_rel.get(sf.rel)
+        if idx is None:
+            continue
+        # Every check here keys on a typed receiver (lock / condition /
+        # queue) or a call into one — a file that constructs none and a
+        # project with no blocking helpers reachable from it can only
+        # matter through resolved calls, which `_held_region` still
+        # checks; but without a single lock-typed name in the file there
+        # is no held region and no acquire/wait to inspect.
+        if not any(idx.typed.values()):
+            continue
+        # Module level counts as a scope too (script bodies take locks).
+        yield from _scope(cg, idx, sf, sf.tree, Ctx(sf.rel))
+        for fi, ctx in iter_functions(sf):
+            fctx = Ctx(
+                rel=ctx.rel, cls=ctx.cls,
+                func_stack=ctx.func_stack + (fi.node,),
+            )
+            yield from _scope(cg, idx, sf, fi.node, fctx)
+
+
+def _direct_children(node: ast.AST):
+    """Child statements/expressions without crossing scope boundaries."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, _SCOPE_NODES + (ast.ClassDef,)):
+            continue
+        yield child
+
+
+def _walk_scope(node: ast.AST):
+    stack = list(_direct_children(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        stack.extend(_direct_children(n))
+
+
+def _scope(cg, idx, sf, scope_node, ctx):
+    """Lint one function (or module) body, no descent into nested
+    defs — they get their own visit."""
+    yield from _bare_acquires(idx, sf, scope_node)
+    yield from _naked_waits(idx, sf, scope_node)
+    for node in _walk_scope(scope_node):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            lock = dotted_name(item.context_expr)
+            kind = idx.kind_of(lock)
+            if kind not in ("lock", "condition"):
+                continue
+            yield from _held_region(
+                cg, idx, sf, ctx, node, lock, kind
+            )
+
+
+def _held_region(cg, idx, sf, ctx, with_node, lock, kind):
+    for node in _walk_scope(with_node):
+        if not isinstance(node, ast.Call):
+            continue
+        recv = _receiver(node)
+        # Condition.wait on the condition we hold releases it: the
+        # one blocking call that is *designed* to happen under `with`.
+        if (
+            kind == "condition"
+            and call_name(node) in ("wait", "wait_for")
+            and recv == lock
+        ):
+            continue
+        desc = cg.blocking_op(node, idx)
+        if desc:
+            yield Finding(
+                sf.rel, node.lineno, RULE_ID,
+                f"{desc} while holding `{lock}` (line "
+                f"{with_node.lineno}) — the thread that would unblock "
+                "this may need the same lock",
+            )
+            continue
+        target = cg.resolve(node, ctx)
+        if target is None:
+            continue
+        chain = cg.block_chain(target)
+        if chain:
+            via = " -> ".join(f"`{c}`" for c in chain[:-1])
+            via = f" via {via}" if via else ""
+            yield Finding(
+                sf.rel, node.lineno, RULE_ID,
+                f"`{target.name}()` blocks ({chain[-1]}{via}) while "
+                f"`{lock}` is held (line {with_node.lineno}) — "
+                "helpers called under a lock must be non-blocking",
+            )
+
+
+def _bare_acquires(idx, sf, scope_node):
+    for node in _walk_scope(scope_node):
+        if not (
+            isinstance(node, ast.Call)
+            and call_name(node) == "acquire"
+        ):
+            continue
+        recv = _receiver(node)
+        if idx.kind_of(recv) not in ("lock", "condition"):
+            continue
+        tail = recv.rsplit(".", 1)[-1]
+        if _release_guarded(scope_node, tail):
+            continue
+        yield Finding(
+            sf.rel, node.lineno, RULE_ID,
+            f"`{recv}.acquire()` without `with` or try/finally "
+            "release — an exception here leaves the lock held forever",
+        )
+
+
+def _naked_waits(idx, sf, scope_node):
+    # cond.wait() must sit inside a `while` predicate loop.
+    def visit(node, in_while):
+        for child in _direct_children(node):
+            if isinstance(child, ast.Call) and call_name(child) == "wait":
+                recv = _receiver(child)
+                if idx.kind_of(recv) == "condition" and not in_while:
+                    yield Finding(
+                        sf.rel, child.lineno, RULE_ID,
+                        f"`{recv}.wait()` outside a `while` predicate "
+                        "loop — spurious wakeups make straight-line "
+                        "waits a race (or use wait_for)",
+                    )
+            yield from visit(
+                child, in_while or isinstance(child, ast.While)
+            )
+
+    yield from visit(scope_node, False)

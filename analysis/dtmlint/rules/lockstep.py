@@ -24,20 +24,27 @@ identifier:
 
 Collectives *inside the test itself* are evaluated before the branch
 and are therefore always uniform — not flagged.
+
+"Performs a collective" is interprocedural (v2): a call that resolves
+through the project call graph to a function whose transitive summary
+contains a collective counts exactly like a direct
+``consensus.broadcast_int`` — hiding the collective inside a helper no
+longer hides it from the rule.  Unknown callees stay benign.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List
+from typing import List, Tuple
 
 from analysis.dtmlint.astutil import (
+    COLLECTIVE_CALLS,
     call_name,
-    collective_calls,
     identifiers,
     terminates,
     walk_in_scope,
 )
+from analysis.dtmlint.callgraph import CallGraph, Ctx, iter_functions
 from analysis.dtmlint.core import Finding, Project
 
 RULE_ID = "collective-lockstep"
@@ -71,37 +78,67 @@ def _per_process_test(test: ast.AST) -> List[str]:
     return sorted(set(identifiers(test)) & PER_PROCESS)
 
 
-def _scopes(tree: ast.Module) -> Iterator[ast.AST]:
-    yield tree
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node
+def _collectives(cg: CallGraph, ctx: Ctx, node: ast.AST) -> List[Tuple]:
+    """``(call, label)`` for every collective reachable from ``node``:
+    direct calls, plus calls resolving to helpers whose transitive
+    summary performs one."""
+    out: List[Tuple] = []
+    for n in walk_in_scope(node):
+        if not isinstance(n, ast.Call):
+            continue
+        nm = call_name(n)
+        if nm in COLLECTIVE_CALLS:
+            out.append((n, f"`{nm}`"))
+            continue
+        target = cg.resolve(n, ctx)
+        if target is None:
+            continue
+        chain = cg.collective_chain(target)
+        if chain:
+            hops = (target.name,) + chain[:-1]
+            via = " -> ".join(f"`{h}`" for h in hops)
+            out.append((n, f"`{chain[-1]}` (inside helper {via})"))
+    return out
 
 
-def _collectives_after(scope: ast.AST, stmt: ast.If) -> List[ast.Call]:
+def _collectives_after(
+    cg: CallGraph, ctx: Ctx, scope: ast.AST, stmt: ast.If
+) -> List[Tuple]:
     """Collectives lexically after ``stmt`` in the same statement list."""
-    out: List[ast.Call] = []
+    out: List[Tuple] = []
     for node in walk_in_scope(scope):
-        body = getattr(node, "body", None)
         for attr in ("body", "orelse", "finalbody"):
             seq = getattr(node, attr, None)
             if isinstance(seq, list) and stmt in seq:
                 idx = seq.index(stmt)
                 for later in seq[idx + 1:]:
-                    out.extend(collective_calls(later))
+                    out.extend(_collectives(cg, ctx, later))
                 return out
     # top-level statement list of the scope itself
     seq = getattr(scope, "body", [])
     if stmt in seq:
         idx = seq.index(stmt)
         for later in seq[idx + 1:]:
-            out.extend(collective_calls(later))
+            out.extend(_collectives(cg, ctx, later))
     return out
 
 
 def check(project: Project):
+    cg = CallGraph.of(project)
     for sf in project.files:
-        for scope in _scopes(sf.tree):
+        scopes = [(sf.tree, Ctx(sf.rel))]
+        for fi, fctx in iter_functions(sf):
+            scopes.append(
+                (
+                    fi.node,
+                    Ctx(
+                        rel=fctx.rel,
+                        cls=fctx.cls,
+                        func_stack=fctx.func_stack + (fi.node,),
+                    ),
+                )
+            )
+        for scope, ctx in scopes:
             for node in walk_in_scope(scope):
                 if not isinstance(node, ast.If):
                     continue
@@ -111,12 +148,12 @@ def check(project: Project):
                 in_body = [
                     c
                     for stmt in node.body
-                    for c in collective_calls(stmt)
+                    for c in _collectives(cg, ctx, stmt)
                 ]
                 in_orelse = [
                     c
                     for stmt in node.orelse
-                    for c in collective_calls(stmt)
+                    for c in _collectives(cg, ctx, stmt)
                 ]
                 why = f"per-process condition ({', '.join(markers)})"
                 if bool(in_body) != bool(in_orelse):
@@ -127,14 +164,16 @@ def check(project: Project):
                     falls_through = not (
                         empty_side and terminates(empty_side)
                     )
-                    if falls_through and _collectives_after(scope, node):
+                    if falls_through and _collectives_after(
+                        cg, ctx, scope, node
+                    ):
                         continue
-                    bad = (in_body or in_orelse)[0]
+                    bad, label = (in_body or in_orelse)[0]
                     yield Finding(
                         sf.rel,
                         bad.lineno,
                         RULE_ID,
-                        f"collective `{call_name(bad)}` under {why} at "
+                        f"collective {label} under {why} at "
                         f"line {node.lineno} has no matching collective "
                         "on the other path; hosts that skip this branch "
                         "never enter it (one-host deadlock)",
@@ -146,14 +185,14 @@ def check(project: Project):
                 exits_orelse = bool(node.orelse) and terminates(node.orelse)
                 if exits_body == exits_orelse:
                     continue
-                later = _collectives_after(scope, node)
+                later = _collectives_after(cg, ctx, scope, node)
                 if later:
                     yield Finding(
                         sf.rel,
                         node.lineno,
                         RULE_ID,
                         f"early exit under {why} skips collective "
-                        f"`{call_name(later[0])}` at line "
-                        f"{later[0].lineno}; exiting hosts never reach "
+                        f"{later[0][1]} at line "
+                        f"{later[0][0].lineno}; exiting hosts never reach "
                         "it (one-host deadlock)",
                     )

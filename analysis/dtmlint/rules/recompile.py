@@ -1,0 +1,603 @@
+"""recompile-hazard — Python-value-dependence inside compiled code.
+
+The serving engine stakes its latency win on ``compile_counts()``
+staying pinned at (1, 1): exactly one prefill program, one decode
+program, forever.  The train loop makes the same bet per donated step.
+A recompile (or a trace-time concretization error) sneaks in whenever
+code reached from a ``jax.jit`` / ``lax.scan`` entry point lets a
+*traced* value influence Python-level control flow or array shapes:
+
+- ``int()`` / ``float()`` / ``bool()`` / ``len()`` / ``.item()`` on a
+  traced value — concretizes the tracer (error under jit, silent
+  device sync and per-value recompile under looser transforms);
+- a traced value flowing into a shape position (``jnp.zeros``,
+  ``.reshape``, ``broadcast_to``, ``arange``...) — a new shape means a
+  new program;
+- ``if`` / ``while`` on tracer truthiness — Python takes one branch at
+  trace time, so the compiled program silently bakes it in (or errors);
+- a traced value as a *slice bound* — dynamic slice sizes are dynamic
+  shapes (``x[i]`` indexing is fine: that's a gather).
+
+Reachability is interprocedural via the call graph: entry points are
+functions passed to / decorated with ``jax.jit`` (incl. bound methods
+like ``self._prefill_fn``), ``pmap``, ``vmap``, ``grad``, and
+``lax.scan`` / ``while_loop`` / ``fori_loop`` / ``cond`` bodies.
+Taint starts at the entry's parameters (minus ``static_argnums`` /
+``static_argnames``) and propagates through local assignments and
+resolved calls (argument -> parameter).  ``.shape`` / ``.ndim`` /
+``.dtype`` / ``.size`` reads are static at trace time and drop taint —
+``x.shape[0]`` is the sanctioned spelling.  Unknown callees and
+unparseable static-arg specs make the entry *benign*, never noisy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from analysis.dtmlint.astutil import call_name, dotted_name, fold_int
+from analysis.dtmlint.callgraph import CallGraph, Ctx, FuncInfo, iter_functions
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "recompile-hazard"
+
+# Transform spellings (by dotted name) whose first argument becomes a
+# traced entry point.
+_JIT_NAMES = frozenset({"jax.jit", "jit", "jax.pmap", "pmap"})
+_ALL_TRACED = frozenset(
+    {
+        "jax.vmap", "vmap", "jax.grad", "grad", "jax.value_and_grad",
+        "value_and_grad", "jax.checkpoint", "jax.remat",
+        "lax.scan", "jax.lax.scan",
+        "lax.map", "jax.lax.map",
+    }
+)
+_WHILE_NAMES = frozenset({"lax.while_loop", "jax.lax.while_loop"})
+_FORI_NAMES = frozenset({"lax.fori_loop", "jax.lax.fori_loop"})
+_COND_NAMES = frozenset({"lax.cond", "jax.lax.cond"})
+_SWITCH_NAMES = frozenset({"lax.switch", "jax.lax.switch"})
+
+# Attribute reads that are static at trace time (they come from the
+# abstract value, not the runtime one).
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+
+_CONCRETIZERS = frozenset({"int", "float", "bool", "len"})
+_CONCRETIZE_METHODS = frozenset({"item", "tolist"})
+
+# tail name -> positional indices carrying shapes ("rest" = 1:)
+_SHAPE_FNS = {
+    "zeros": (0,), "ones": (0,), "empty": (0,), "full": (0,),
+    "eye": (0, 1),
+    "arange": "all", "linspace": "all",
+    "reshape": "rest", "broadcast_to": "rest", "tile": "rest",
+}
+_SHAPE_KWARGS = frozenset({"shape", "newshape", "reps"})
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[tuple]:
+    """Fold ``0`` / ``(0, 2)`` / ``[1]`` into a tuple of ints."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            v = fold_int(e)
+            if v is None:
+                return None
+            out.append(v)
+        return tuple(out)
+    v = fold_int(node)
+    return None if v is None else (v,)
+
+
+def _const_str_tuple(node: ast.AST) -> Optional[tuple]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _entry_traced_params(
+    fi: FuncInfo, call: Optional[ast.Call], bound: bool
+) -> Optional[frozenset]:
+    """Traced parameter names for a jit-style entry, honouring
+    static_argnums/static_argnames.  None = spec unparseable, skip."""
+    params = fi.params(skip_self=bound)
+    static: set = set()
+    for kw in (call.keywords if call is not None else []):
+        if kw.arg == "static_argnums":
+            nums = _const_int_tuple(kw.value)
+            if nums is None:
+                return None
+            static |= {params[i] for i in nums if 0 <= i < len(params)}
+        elif kw.arg == "static_argnames":
+            names = _const_str_tuple(kw.value)
+            if names is None:
+                return None
+            static |= set(names)
+    return frozenset(p for p in params if p not in static)
+
+
+class _Pass:
+    """One traced-function analysis: local taint + hazards + enqueue."""
+
+    def __init__(self, rule: "_Engine", fi: FuncInfo, ctx: Ctx,
+                 taint: set, origin: str):
+        self.rule = rule
+        self.fi = fi
+        self.ctx = ctx
+        self.taint = taint
+        self.origin = origin
+        self.report = False
+
+    def run(self) -> None:
+        body = self.fi.node.body
+        self.report = False
+        self._stmts(body)  # pass 1: settle loop-carried taint
+        self.report = True
+        self._stmts(body)
+
+    # -- taint -------------------------------------------------------------
+
+    def _tainted(self, e: ast.AST) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.taint
+        if isinstance(e, ast.Attribute):
+            if e.attr in _STATIC_ATTRS:
+                return False
+            return self._tainted(e.value)
+        if isinstance(e, ast.Call):
+            if any(self._tainted(a) for a in e.args):
+                return True
+            if any(self._tainted(k.value) for k in e.keywords):
+                return True
+            if isinstance(e.func, ast.Attribute):
+                return self._tainted(e.func.value)
+            return False
+        if isinstance(e, (ast.Constant, ast.Lambda)):
+            return False
+        return any(self._tainted(c) for c in ast.iter_child_nodes(e))
+
+    def _bare(self, e: ast.AST) -> Optional[str]:
+        """A traced name reached without laundering through a call or a
+        static attribute — the direct "this value is a tracer" case.
+        Returns the name for the message, or None."""
+        if isinstance(e, ast.Name):
+            return e.id if e.id in self.taint else None
+        if isinstance(e, ast.Attribute):
+            if e.attr in _STATIC_ATTRS:
+                return None
+            hit = self._bare(e.value)
+            return f"{hit}.{e.attr}" if hit else None
+        if isinstance(e, (ast.Call, ast.Constant, ast.Lambda)):
+            return None
+        for c in ast.iter_child_nodes(e):
+            hit = self._bare(c)
+            if hit:
+                return hit
+        return None
+
+    def _assign_names(self, target: ast.AST) -> list:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out = []
+            for e in target.elts:
+                e = e.value if isinstance(e, ast.Starred) else e
+                out.extend(self._assign_names(e))
+            return out
+        return []  # attribute/subscript targets don't bind local names
+
+    def _update(self, targets, value_tainted: bool) -> None:
+        for t in targets:
+            for name in self._assign_names(t):
+                if value_tainted:
+                    self.taint.add(name)
+                else:
+                    self.taint.discard(name)
+
+    # -- statements --------------------------------------------------------
+
+    def _stmts(self, body: list) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, _FUNC_NODES + (ast.ClassDef,)):
+            return  # nested defs run when *called*; entries handle them
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            self._update(stmt.targets, self._tainted(stmt.value))
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+                aug = isinstance(stmt, ast.AugAssign)
+                was = self._tainted(stmt.target) if aug else False
+                self._update(
+                    [stmt.target], was or self._tainted(stmt.value)
+                )
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._branch_test(stmt)
+            self._expr(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            self._update([stmt.target], self._tainted(stmt.iter))
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+            self._stmts(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+
+    # -- expressions / hazards ---------------------------------------------
+
+    def _expr(self, e: ast.AST) -> None:
+        for node in ast.walk(e):
+            if isinstance(node, (ast.Lambda,) + _FUNC_NODES):
+                continue
+            if isinstance(node, ast.Call):
+                self._call(node)
+            elif isinstance(node, ast.Subscript):
+                self._slice(node)
+
+    def _call(self, call: ast.Call) -> None:
+        name = call_name(call)
+        dotted = dotted_name(call.func)
+        # H1: int()/float()/bool()/len() on a traced value.
+        if (
+            isinstance(call.func, ast.Name)
+            and name in _CONCRETIZERS
+            and len(call.args) == 1
+        ):
+            hit = self._bare(call.args[0])
+            if hit:
+                self._flag(
+                    call.lineno,
+                    f"`{name}()` on traced value `{hit}` concretizes the "
+                    "tracer",
+                )
+        # H2: .item()/.tolist() on a traced value.
+        if (
+            isinstance(call.func, ast.Attribute)
+            and name in _CONCRETIZE_METHODS
+        ):
+            hit = self._bare(call.func.value)
+            if hit:
+                self._flag(
+                    call.lineno,
+                    f"`.{name}()` on traced value `{hit}` forces a host "
+                    "sync / concretization",
+                )
+        # H3: traced value in a shape position.
+        self._shape(call, name, dotted)
+        # Propagation: enqueue transform bodies and resolved callees.
+        if self.report:
+            self.rule.enqueue_from_call(call, self.ctx, self)
+
+    def _shape(self, call, name, dotted) -> None:
+        spec = _SHAPE_FNS.get(name)
+        if spec is None:
+            return
+        is_method_reshape = (
+            name == "reshape"
+            and isinstance(call.func, ast.Attribute)
+            and not (dotted and dotted.split(".")[0] in
+                     ("jnp", "np", "numpy", "jax"))
+        )
+        if not isinstance(call.func, ast.Attribute):
+            return  # bare zeros(...) is some local helper, not numpy
+        if spec == "all":
+            idxs = range(len(call.args))
+        elif spec == "rest" and not is_method_reshape:
+            idxs = range(1, len(call.args))
+        elif spec == "rest":  # x.reshape(a, b): every arg is shape
+            idxs = range(len(call.args))
+        elif is_method_reshape:
+            idxs = range(len(call.args))
+        else:
+            idxs = [i for i in spec if i < len(call.args)]
+        exprs = [call.args[i] for i in idxs]
+        exprs += [
+            k.value for k in call.keywords if k.arg in _SHAPE_KWARGS
+        ]
+        for e in exprs:
+            hit = self._bare(e)
+            if hit:
+                self._flag(
+                    call.lineno,
+                    f"traced value `{hit}` flows into the shape of "
+                    f"`{name}` — every new value compiles a new program",
+                )
+                return
+
+    def _slice(self, sub: ast.Subscript) -> None:
+        s = sub.slice
+        parts = s.elts if isinstance(s, ast.Tuple) else [s]
+        for el in parts:
+            if not isinstance(el, ast.Slice):
+                continue
+            for bound in (el.lower, el.upper, el.step):
+                if bound is None:
+                    continue
+                hit = self._bare(bound)
+                if hit:
+                    self._flag(
+                        sub.lineno,
+                        f"traced value `{hit}` as a slice bound is a "
+                        "dynamic shape (use lax.dynamic_slice with a "
+                        "static size, or index instead)",
+                    )
+                    return
+
+    def _branch_test(self, stmt) -> None:
+        hit = self._branch_hit(stmt.test)
+        if hit:
+            kw = "while" if isinstance(stmt, ast.While) else "if"
+            self._flag(
+                stmt.lineno,
+                f"`{kw}` on traced value `{hit}` — Python branches at "
+                "trace time (use jnp.where / lax.cond)",
+            )
+
+    def _branch_hit(self, test: ast.AST) -> Optional[str]:
+        if isinstance(test, ast.Call):
+            return None  # isinstance()/callable()-style host predicates
+        if isinstance(test, ast.Compare):
+            if all(isinstance(o, (ast.Is, ast.IsNot)) for o in test.ops):
+                return None  # `x is None` is a static identity check
+            for side in [test.left] + list(test.comparators):
+                hit = self._bare(side)
+                if hit:
+                    return hit
+            return None
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                hit = self._branch_hit(v)
+                if hit:
+                    return hit
+            return None
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._branch_hit(test.operand)
+        return self._bare(test)
+
+    def _flag(self, lineno: int, msg: str) -> None:
+        if self.report:
+            self.rule.flag(self.fi, lineno, msg, self.origin)
+
+
+class _Engine:
+    """Worklist over traced functions, seeded by the entry scan."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.cg = CallGraph.of(project)
+        self.findings: dict = {}
+        self._seen: dict = {}  # (rel, qualname) -> union taint processed
+        self._work: list = []
+        self._steps = 0
+
+    def flag(self, fi: FuncInfo, lineno: int, msg: str, origin: str):
+        key = (fi.rel, lineno, msg)
+        if key not in self.findings:
+            self.findings[key] = Finding(
+                fi.rel, lineno, RULE_ID, f"{msg} (reached from {origin})"
+            )
+
+    def enqueue(self, fi: FuncInfo, ctx: Ctx, taint: frozenset,
+                origin: str) -> None:
+        key = (fi.rel, fi.qualname)
+        have = self._seen.get(key, frozenset())
+        if taint <= have:
+            return
+        self._seen[key] = have | taint
+        self._work.append((fi, ctx, self._seen[key], origin))
+
+    def _ctx_for(self, fi: FuncInfo, caller: Ctx) -> Ctx:
+        if fi.rel == caller.rel and fi.node in caller.func_stack:
+            return caller
+        stack = caller.func_stack if fi.rel == caller.rel else ()
+        # Nested defs resolved from the caller keep its stack so their
+        # own bare-name calls still see enclosing defs.
+        return Ctx(rel=fi.rel, cls=fi.cls, func_stack=stack)
+
+    def enqueue_from_call(
+        self, call: ast.Call, ctx: Ctx, p: _Pass
+    ) -> None:
+        dotted = dotted_name(call.func)
+        # Transform call inside a traced (or host) function: its target
+        # becomes an entry.  Closure taint flows into nested defs.
+        self._maybe_entry(call, dotted, ctx, closure=p.taint)
+        target = self.cg.resolve(call, ctx)
+        if target is None:
+            return
+        bound = (
+            target.cls is not None
+            and isinstance(call.func, ast.Attribute)
+        )
+        params = target.params(skip_self=bound)
+        traced = set()
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                break
+            if i < len(params) and p._tainted(a):
+                traced.add(params[i])
+        for kw in call.keywords:
+            if kw.arg and kw.arg in params and p._tainted(kw.value):
+                traced.add(kw.arg)
+        if traced:
+            self.enqueue(
+                target,
+                self._ctx_for(target, ctx),
+                frozenset(traced),
+                f"{p.origin} -> `{target.name}`",
+            )
+
+    # -- entry discovery ---------------------------------------------------
+
+    def _maybe_entry(
+        self, call: ast.Call, dotted: Optional[str], ctx: Ctx,
+        closure: Optional[set] = None,
+    ) -> None:
+        if dotted is None:
+            return
+
+        def resolve_fn(arg):
+            fi = self.cg.resolve_target(arg, ctx)
+            if fi is None:
+                return None, False
+            bound = (
+                fi.cls is not None and isinstance(arg, ast.Attribute)
+            )
+            return fi, bound
+
+        def seed(fi, bound, traced, what):
+            if fi is None or traced is None:
+                return
+            extra = frozenset()
+            if closure:
+                shadowed = set(fi.params()) | set(
+                    self._local_names(fi.node)
+                )
+                extra = frozenset(closure) - shadowed
+            self.enqueue(
+                fi, self._ctx_for(fi, ctx), frozenset(traced) | extra,
+                f"{what} entry `{fi.name}`",
+            )
+
+        if dotted in _JIT_NAMES and call.args:
+            fi, bound = resolve_fn(call.args[0])
+            if fi is not None:
+                seed(fi, bound,
+                     _entry_traced_params(fi, call, bound), "jit")
+        elif dotted in _ALL_TRACED and call.args:
+            fi, bound = resolve_fn(call.args[0])
+            if fi is not None:
+                seed(fi, bound, fi.params(skip_self=bound),
+                     dotted.rsplit(".", 1)[-1])
+        elif dotted in _WHILE_NAMES:
+            for arg in call.args[:2]:
+                fi, bound = resolve_fn(arg)
+                if fi is not None:
+                    seed(fi, bound, fi.params(skip_self=bound),
+                         "while_loop")
+        elif dotted in _FORI_NAMES and len(call.args) >= 3:
+            fi, bound = resolve_fn(call.args[2])
+            if fi is not None:
+                seed(fi, bound, fi.params(skip_self=bound), "fori_loop")
+        elif dotted in _COND_NAMES:
+            for arg in call.args[1:3]:
+                fi, bound = resolve_fn(arg)
+                if fi is not None:
+                    seed(fi, bound, fi.params(skip_self=bound), "cond")
+        elif dotted in _SWITCH_NAMES and len(call.args) >= 2:
+            branches = call.args[1]
+            if isinstance(branches, (ast.Tuple, ast.List)):
+                for arg in branches.elts:
+                    fi, bound = resolve_fn(arg)
+                    if fi is not None:
+                        seed(fi, bound, fi.params(skip_self=bound),
+                             "switch")
+
+    @staticmethod
+    def _local_names(node: ast.AST) -> set:
+        out = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(
+                n.ctx, (ast.Store, ast.Del)
+            ):
+                out.add(n.id)
+        return out
+
+    def _scan_entries(self) -> None:
+        for sf in self.project.files:
+            # Module-level transform calls (incl. inside class bodies
+            # and host functions — `self._prefill_j = jax.jit(...)`).
+            for fi, ctx in iter_functions(sf):
+                fctx = Ctx(
+                    rel=ctx.rel, cls=ctx.cls,
+                    func_stack=ctx.func_stack + (fi.node,),
+                )
+                for node in ast.walk(fi.node):
+                    if isinstance(node, ast.Call):
+                        self._maybe_entry(
+                            node, dotted_name(node.func), fctx
+                        )
+                self._decorated(fi, ctx)
+            mod_ctx = Ctx(rel=sf.rel)
+            for stmt in sf.tree.body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, _FUNC_NODES):
+                        break
+                    if isinstance(node, ast.Call):
+                        self._maybe_entry(
+                            node, dotted_name(node.func), mod_ctx
+                        )
+
+    def _decorated(self, fi: FuncInfo, ctx: Ctx) -> None:
+        for dec in getattr(fi.node, "decorator_list", []):
+            dotted = dotted_name(dec)
+            if dotted in _JIT_NAMES or dotted in _ALL_TRACED:
+                self.enqueue(
+                    fi, self._ctx_for(fi, ctx),
+                    frozenset(fi.params(skip_self=fi.cls is not None)),
+                    f"@{dotted} entry `{fi.name}`",
+                )
+            elif isinstance(dec, ast.Call):
+                dd = dotted_name(dec.func)
+                if dd in _JIT_NAMES:
+                    traced = _entry_traced_params(
+                        fi, dec, fi.cls is not None
+                    )
+                    if traced is not None:
+                        self.enqueue(
+                            fi, self._ctx_for(fi, ctx), traced,
+                            f"@jit entry `{fi.name}`",
+                        )
+                elif dd in ("partial", "functools.partial") and dec.args:
+                    inner = dotted_name(dec.args[0])
+                    if inner in _JIT_NAMES:
+                        traced = _entry_traced_params(
+                            fi, dec, fi.cls is not None
+                        )
+                        if traced is not None:
+                            self.enqueue(
+                                fi, self._ctx_for(fi, ctx), traced,
+                                f"@partial(jit) entry `{fi.name}`",
+                            )
+
+    def run(self) -> list:
+        self._scan_entries()
+        while self._work and self._steps < 4000:
+            self._steps += 1
+            fi, ctx, taint, origin = self._work.pop()
+            inner_ctx = Ctx(
+                rel=ctx.rel, cls=ctx.cls,
+                func_stack=tuple(ctx.func_stack)
+                + ((fi.node,) if fi.node not in ctx.func_stack else ()),
+            )
+            _Pass(self, fi, inner_ctx, set(taint), origin).run()
+        return sorted(self.findings.values())
+
+
+def check(project: Project):
+    return _Engine(project).run()

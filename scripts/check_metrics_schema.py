@@ -60,7 +60,12 @@ side: the lint rule stops ad-hoc keys that the schema never heard of,
 this mode catches declared keys that no code path ever emits (dead
 constants, or a metric whose emission silently regressed).  Keys whose
 emission is legitimately load- or topology-dependent are excused with
-``--allow-missing PREFIX`` (repeatable).
+``--allow-missing PREFIX`` (repeatable); ``--only-prefix PREFIX``
+restricts the declared set instead, for reports that own exactly one
+subsystem's keys (a serving stats report covers the ``serve/``
+constants and nothing else — together the training run's coverage
+check and the serving report's ``--only-prefix serve/`` check tile the
+whole registry without a blanket allow on either side).
 
 With ``--serving-report`` the path is validated as a serving stats
 report (``<workdir>/serving_stats_p<i>.json``, serving/server.py)
@@ -419,18 +424,26 @@ def check_declared_coverage(
     report: dict,
     declared: dict[str, str],
     allow_missing: Iterable[str] = (),
+    only_prefix: Iterable[str] = (),
 ) -> list[str]:
     """Declared keys absent from the report's ``metrics`` snapshot.
 
     A key counts as emitted when it appears exactly (counters, gauges)
     or as a ``key/...`` expansion (timer stats, gauge families).
+    ``only_prefix`` restricts the declared set to keys under the given
+    prefixes — the positive-scope twin of ``allow_missing``, for
+    reports that own one subsystem's keys (a serving stats report
+    covers ``serve/`` and nothing else).
     """
     errors: list[str] = []
     snap = report.get("metrics") if isinstance(report, dict) else None
     if not isinstance(snap, dict):
         return ["report carries no 'metrics' snapshot object"]
     prefixes = tuple(allow_missing)
+    only = tuple(only_prefix)
     for key in sorted(declared):
+        if only and not key.startswith(only):
+            continue
         if key in snap or any(k.startswith(key + "/") for k in snap):
             continue
         if prefixes and key.startswith(prefixes):
@@ -488,6 +501,15 @@ def main(argv=None) -> int:
         help="with --declared-coverage: excuse declared keys matching "
         "this prefix (load/topology-dependent emission); repeatable",
     )
+    p.add_argument(
+        "--only-prefix",
+        action="append",
+        default=[],
+        metavar="PREFIX",
+        help="with --declared-coverage: check only declared keys under "
+        "this prefix (a report that owns one subsystem's keys, e.g. "
+        "a serving stats report with serve/); repeatable",
+    )
     args = p.parse_args(argv)
     if args.declared_coverage:
         try:
@@ -498,14 +520,22 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 1
         errors = check_declared_coverage(
-            report, declared, allow_missing=args.allow_missing
+            report, declared, allow_missing=args.allow_missing,
+            only_prefix=args.only_prefix,
         )
         if errors:
             for e in errors:
                 print(f"{args.path}: {e}", file=sys.stderr)
             return 1
+        only = tuple(args.only_prefix)
+        checked = sum(
+            1 for k in declared if not only or k.startswith(only)
+        )
         print(
-            f"{args.path}: OK ({len(declared)} declared keys all emitted"
+            f"{args.path}: OK ({checked} declared keys all emitted"
+            + (
+                f", scoped to {', '.join(only)}" if only else ""
+            )
             + (
                 f", {len(args.allow_missing)} allowed-missing prefixes"
                 if args.allow_missing

@@ -9,6 +9,8 @@ Usage::
     python scripts/dtm_lint.py --disable determinism-hazard
     python scripts/dtm_lint.py path/a.py b.py  # explicit files, strict mode
     python scripts/dtm_lint.py --write-baseline  # grandfather current findings
+    python scripts/dtm_lint.py --changed-only  # only files changed vs HEAD
+    python scripts/dtm_lint.py --changed-only origin/main  # ...vs a ref
 
 Exit status: 0 when no new findings (baselined ones don't count),
 1 when there are new findings, 2 on configuration/baseline errors.
@@ -53,6 +55,34 @@ def _split(csv):
     return out
 
 
+def _git_changed(root, ref):
+    """Repo-relative .py files changed vs ``ref`` plus untracked ones,
+    or None when git can't answer (not a repo, bad ref, no binary)."""
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", ref, "--"],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    files = set(diff.stdout.splitlines())
+    try:
+        untracked = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30,
+        )
+        if untracked.returncode == 0:
+            files |= set(untracked.stdout.splitlines())
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return {f.strip() for f in files if f.strip().endswith(".py")}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="dtm_lint", description=__doc__,
@@ -84,6 +114,14 @@ def main(argv=None) -> int:
         "--write-baseline", action="store_true",
         help="write current findings to the baseline file and exit 0",
     )
+    ap.add_argument(
+        "--changed-only", nargs="?", const="HEAD", default=None,
+        metavar="REF",
+        help="report findings only for files changed vs REF (default "
+        "HEAD) plus untracked files; the whole tree is still parsed so "
+        "interprocedural rules keep full context.  Falls back to the "
+        "full tree when git is unavailable.",
+    )
     args = ap.parse_args(argv)
 
     only = _split(args.only) or None
@@ -91,6 +129,10 @@ def main(argv=None) -> int:
 
     try:
         if args.paths:
+            if args.changed_only is not None:
+                raise LintError(
+                    "--changed-only only applies to whole-tree runs"
+                )
             config = strict_config(args.paths, args.root)
             baseline = None
         else:
@@ -105,7 +147,21 @@ def main(argv=None) -> int:
                     if os.path.exists(bl_path)
                     else None
                 )
-        result = run(config, only=only, disable=disable, baseline=baseline)
+        restrict = None
+        if args.changed_only is not None and not args.paths:
+            changed = _git_changed(args.root, args.changed_only)
+            if changed is None:
+                print(
+                    "dtm-lint: note: git unavailable or REF invalid; "
+                    "falling back to full-tree run",
+                    file=sys.stderr,
+                )
+            else:
+                restrict = changed & set(config.files)
+        result = run(
+            config, only=only, disable=disable, baseline=baseline,
+            restrict_paths=restrict,
+        )
         if args.write_baseline:
             if args.paths:
                 raise LintError(
@@ -138,6 +194,11 @@ def main(argv=None) -> int:
             if n
             else "dtm-lint: clean"
         )
+        if restrict is not None:
+            summary += (
+                f" [changed-only: {len(restrict)} file(s) vs "
+                f"{args.changed_only}]"
+            )
         if result.baselined:
             summary += f" ({len(result.baselined)} baselined)"
         if result.stale_baseline:

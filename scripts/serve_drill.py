@@ -30,7 +30,7 @@ work happens in the spawned replicas.  Exit 0 when every check passes.
 
 Usage::
 
-    python scripts/serve_drill.py [--requests 24] [--keep]
+    python scripts/serve_drill.py [--requests 24] [--keep] [--no-lint]
 """
 
 from __future__ import annotations
@@ -251,7 +251,36 @@ def main(argv=None) -> int:
         "--keep", action="store_true",
         help="keep the scratch dir (queue, responses, flight records)",
     )
+    p.add_argument(
+        "--no-lint", action="store_true",
+        help="skip the dtm-lint pre-drill gate (debugging only: a tree "
+        "with recompile-hazard or lock-discipline findings can hang or "
+        "thrash the very serving path this drill certifies)",
+    )
     args = p.parse_args(argv)
+
+    # Pre-drill gate: the serving hot path is exactly what the new rule
+    # packs police — a recompile hazard in prefill/decode turns the
+    # drill into a compile storm, a blocking call under a lock wedges
+    # the admission thread, and a donation bug corrupts the arena the
+    # determinism check reads.  Refuse to spend drill budget
+    # rediscovering what the AST proves for free.
+    if not args.no_lint:
+        lint = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "dtm_lint.py")
+        proc = subprocess.run(
+            [sys.executable, lint], capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            print(proc.stdout, end="", file=sys.stderr)
+            print(
+                "serve_drill: dtm-lint gate failed; fix the findings "
+                "(or rerun with --no-lint to debug anyway)",
+                file=sys.stderr,
+            )
+            return proc.returncode
+        print("dtm-lint gate: clean")
+
     scratch = args.scratch or tempfile.mkdtemp(prefix="dtm-serve-drill-")
     os.makedirs(scratch, exist_ok=True)
     failed = False

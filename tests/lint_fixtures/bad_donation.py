@@ -1,0 +1,17 @@
+"""Known-bad: reading a buffer after donating it to a jitted program."""
+
+
+class Engine:
+    def __init__(self, fn):
+        self._step = jax.jit(fn, donate_argnums=(1,))
+
+    def run(self, params, arena, tok):
+        out = self._step(params, arena, tok)
+        stale = arena.sum()
+        return out, stale
+
+    def loop(self, params, arena, toks):
+        out = None
+        for tok in toks:
+            out = self._step(params, arena, tok)
+        return out

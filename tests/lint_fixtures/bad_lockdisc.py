@@ -1,0 +1,24 @@
+"""Known-bad: bare acquire, blocking under a lock, naked wait."""
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._q = queue.Queue()
+
+    def bare(self):
+        self._lock.acquire()
+        x = self._q.get_nowait()
+        self._lock.release()
+        return x
+
+    def blocked(self):
+        with self._lock:
+            return self._q.get()
+
+    def waits(self):
+        with self._cond:
+            self._cond.wait()

@@ -1,0 +1,27 @@
+"""Known-bad: Python-value-dependence inside jitted code.
+
+No module-level jax import on purpose (fixtures are linted as jax-free
+roots in strict mode); the rule keys on the ``jax.jit`` spelling, not
+on imports, and nothing here is ever executed.
+"""
+
+
+def step(state, n, flag):
+    out = jnp.zeros(n)
+    k = int(flag)
+    if flag:
+        out = out + k
+    head = state[:n]
+    return out, head
+
+
+def helper(m):
+    return m.item()
+
+
+def outer(x):
+    return helper(x) + len(x)
+
+
+step_j = jax.jit(step)
+outer_j = jax.jit(outer)

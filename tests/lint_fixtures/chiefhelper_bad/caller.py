@@ -1,0 +1,8 @@
+"""Known-bad: a collective hidden in a helper, reached only on chief."""
+import helper
+
+
+def run(consensus, is_chief, value):
+    if is_chief:
+        return helper.announce(consensus, value)
+    return None

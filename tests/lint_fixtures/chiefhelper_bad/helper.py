@@ -1,0 +1,5 @@
+"""Helper whose summary says: performs a collective."""
+
+
+def announce(consensus, value):
+    return consensus.broadcast_int(value)

@@ -1,0 +1,8 @@
+"""Known-good twin: the helper's collective is matched on both paths."""
+import helper
+
+
+def run(consensus, is_chief, value):
+    if is_chief:
+        return helper.announce(consensus, value)
+    return helper.announce(consensus, 0)

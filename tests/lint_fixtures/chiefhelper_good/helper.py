@@ -1,0 +1,5 @@
+"""Helper twin: still performs a collective."""
+
+
+def announce(consensus, value):
+    return consensus.broadcast_int(value)

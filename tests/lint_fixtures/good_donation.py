@@ -1,0 +1,26 @@
+"""Known-good twins: the always-rebind arena protocol."""
+
+
+class Engine:
+    def __init__(self, fn, make_arena):
+        self._step = jax.jit(fn, donate_argnums=(1,))
+        self._make = make_arena
+
+    def run(self, params, arena, tok):
+        # Rebinding in the same statement is the sanctioned pattern:
+        # every later read sees the fresh buffer, never the donated one.
+        arena, out = self._step(params, arena, tok)
+        total = arena.sum()
+        return arena, out, total
+
+    def loop(self, params, toks):
+        arena = self._make()
+        out = None
+        for tok in toks:
+            arena, out = self._step(params, arena, tok)
+        return arena, out
+
+    def fresh(self, params, tok):
+        # A donated temporary nobody holds a name for is fine too.
+        _, out = self._step(params, self._make(), tok)
+        return out

@@ -1,0 +1,34 @@
+"""Known-good twins: guarded acquire, non-blocking critical sections,
+predicate-loop waits."""
+import queue
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._q = queue.Queue()
+        self._ready = False
+
+    def guarded(self):
+        self._lock.acquire()
+        try:
+            return self._q.get_nowait()
+        finally:
+            self._lock.release()
+
+    def nonblocking_section(self):
+        with self._lock:
+            x = self._q.get(block=False)
+        y = self._q.get()  # blocking is fine once the lock is dropped
+        return x, y
+
+    def waits(self):
+        with self._cond:
+            while not self._ready:
+                self._cond.wait()
+
+    def waits_for(self):
+        with self._cond:
+            self._cond.wait_for(lambda: self._ready)

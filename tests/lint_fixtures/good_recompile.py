@@ -1,0 +1,25 @@
+"""Known-good twins: static shapes, where-branches, static argnums."""
+
+
+def good(x, n):
+    b = x.shape[0]
+    out = jnp.zeros((b, 4))
+    y = jnp.where(x > 0, x, 0.0)
+    z = x[n]  # dynamic *index* is a gather, not a shape change
+    pad = jnp.zeros(n)  # n is static (static_argnums below)
+    return out, y, z, pad
+
+
+def sized(x, width):
+    return jnp.zeros(width) + x.sum()
+
+
+def host_side(batch, limit):
+    # Not reached from any jit entry: host code may branch freely.
+    if limit:
+        return batch[:limit]
+    return batch
+
+
+good_j = jax.jit(good, static_argnums=(1,))
+sized_j = jax.jit(sized, static_argnames=("width",))

@@ -1,0 +1,8 @@
+"""Helper whose summary says: blocks on a queue."""
+import queue
+
+_Q = queue.Queue()
+
+
+def drain_one():
+    return _Q.get()

@@ -1,0 +1,11 @@
+"""Known-bad: a helper that blocks, called while holding a lock."""
+import threading
+
+import helper
+
+_LOCK = threading.Lock()
+
+
+def pump():
+    with _LOCK:
+        return helper.drain_one()

@@ -1,0 +1,11 @@
+"""Helper twin: non-blocking drain."""
+import queue
+
+_Q = queue.Queue()
+
+
+def drain_one():
+    try:
+        return _Q.get_nowait()
+    except queue.Empty:
+        return None

@@ -1,0 +1,11 @@
+"""Known-good twin: the helper called under the lock never blocks."""
+import threading
+
+import helper
+
+_LOCK = threading.Lock()
+
+
+def pump():
+    with _LOCK:
+        return helper.drain_one()

@@ -55,6 +55,17 @@ BAD_EXPECT = {
     "bad_wallclock_cursor.py": {("determinism-hazard", 7),
                                 ("determinism-hazard", 8)},
     "bad_metric_key.py": {("metric-key-registry", 5)},
+    "bad_recompile.py": {("recompile-hazard", 10),
+                         ("recompile-hazard", 11),
+                         ("recompile-hazard", 12),
+                         ("recompile-hazard", 14),
+                         ("recompile-hazard", 19),
+                         ("recompile-hazard", 23)},
+    "bad_donation.py": {("donation-safety", 10),
+                        ("donation-safety", 16)},
+    "bad_lockdisc.py": {("lock-discipline", 13),
+                        ("lock-discipline", 20),
+                        ("lock-discipline", 24)},
 }
 
 GOOD_FILES = [
@@ -63,6 +74,9 @@ GOOD_FILES = [
     "good_thread.py",
     "good_wallclock_cursor.py",
     "good_metric_key.py",
+    "good_recompile.py",
+    "good_donation.py",
+    "good_lockdisc.py",
 ]
 
 
@@ -105,6 +119,81 @@ def test_jaxzone_bad_reports_transitive_chain():
 def test_jaxzone_good_lazy_and_type_only_imports_pass():
     result = lint_files("jaxzone_good/supervisor.py")
     assert result.new == [], result.new
+
+
+# --------------------------------------------------------------------------
+# Interprocedural pairs: the finding is at the *call site*, the evidence
+# lives in another file — the call-graph layer has to connect them.
+# --------------------------------------------------------------------------
+
+
+def test_helper_blocks_under_lock_cross_file():
+    result = lint_files(
+        "lockhelper_bad/helper.py", "lockhelper_bad/pump.py"
+    )
+    assert len(result.new) == 1, result.new
+    f = result.new[0]
+    assert (f.rule, f.line) == ("lock-discipline", 11)
+    assert f.path.endswith("lockhelper_bad/pump.py")
+    # The message names the helper and the blocking op it hides.
+    assert "drain_one" in f.message and "queue.get" in f.message
+
+
+def test_helper_nonblocking_under_lock_is_silent():
+    result = lint_files(
+        "lockhelper_good/helper.py", "lockhelper_good/pump.py"
+    )
+    assert result.new == [], result.new
+
+
+def test_helper_collective_under_chief_branch_cross_file():
+    result = lint_files(
+        "chiefhelper_bad/helper.py", "chiefhelper_bad/caller.py"
+    )
+    assert len(result.new) == 1, result.new
+    f = result.new[0]
+    assert (f.rule, f.line) == ("collective-lockstep", 7)
+    assert f.path.endswith("chiefhelper_bad/caller.py")
+    assert "announce" in f.message and "broadcast_int" in f.message
+
+
+def test_helper_collective_matched_on_both_paths_is_silent():
+    result = lint_files(
+        "chiefhelper_good/helper.py", "chiefhelper_good/caller.py"
+    )
+    assert result.new == [], result.new
+
+
+def test_interprocedural_donation_read_via_method():
+    # Donate self.arena, then call a method whose summary reads it —
+    # the read is a whole method away from the donate site.
+    import textwrap
+
+    src = textwrap.dedent(
+        '''
+        class Eng:
+            def __init__(self, fn):
+                self._step = jax.jit(fn, donate_argnums=(0,))
+
+            def peek(self):
+                return self.arena.sum()
+
+            def go(self):
+                out = self._step(self.arena)
+                return out, self.peek()
+        '''
+    ).strip() + "\n"
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "eng.py")
+        with open(p, "w") as fh:
+            fh.write(src)
+        result = run(strict_config([p], td))
+    assert [(f.rule, f.line) for f in result.new] == [
+        ("donation-safety", 10)
+    ], result.new
+    assert "peek" in result.new[0].message
 
 
 # --------------------------------------------------------------------------
@@ -253,6 +342,103 @@ def test_cli_nonzero_with_rule_and_location_on_bad_fixture():
 
 
 # --------------------------------------------------------------------------
+# --changed-only: findings restricted to files changed vs a git ref
+# --------------------------------------------------------------------------
+
+BAD_SNIPPET = (
+    '"""scratch."""\n\n\n'
+    "def chief_only(consensus, is_chief, value):\n"
+    "    if is_chief:\n"
+    "        return consensus.broadcast_int(value)\n"
+    "    return None\n"
+)
+
+
+def _scratch_repo(tmp_path, *, git=True):
+    pkg = tmp_path / "distributed_tensorflow_models_tpu"
+    pkg.mkdir()
+    (pkg / "clean.py").write_text('"""clean."""\n\nX = 1\n')
+    if git:
+        env = dict(
+            os.environ,
+            GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+            GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t",
+        )
+        for cmd in (
+            ["git", "init", "-q"],
+            ["git", "add", "-A"],
+            ["git", "commit", "-qm", "seed"],
+        ):
+            subprocess.run(cmd, cwd=tmp_path, env=env, check=True)
+    return pkg
+
+
+def _lint_cli(root, *flags):
+    return subprocess.run(
+        [sys.executable, DTM_LINT, "--root", str(root), "--json", *flags],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+
+
+def test_changed_only_reports_new_file_and_agrees_with_full_run(tmp_path):
+    pkg = _scratch_repo(tmp_path)
+    (pkg / "gated.py").write_text(BAD_SNIPPET)  # untracked = changed
+    changed = _lint_cli(tmp_path, "--changed-only")
+    full = _lint_cli(tmp_path)
+    assert changed.returncode == 1, changed.stdout + changed.stderr
+    got = json.loads(changed.stdout)["findings"]
+    want = json.loads(full.stdout)["findings"]
+    # One file changed: the changed-only run agrees with the full run
+    # for that file exactly (here: the full run has nothing else).
+    assert got == want and len(got) == 1
+    assert got[0]["rule"] == "collective-lockstep"
+    assert got[0]["path"].endswith("gated.py")
+
+
+def test_changed_only_skips_committed_violations(tmp_path):
+    pkg = _scratch_repo(tmp_path)
+    (pkg / "gated.py").write_text(BAD_SNIPPET)
+    env = dict(
+        os.environ,
+        GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+        GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t",
+    )
+    subprocess.run(["git", "add", "-A"], cwd=tmp_path, env=env, check=True)
+    subprocess.run(
+        ["git", "commit", "-qm", "grandfather"],
+        cwd=tmp_path, env=env, check=True,
+    )
+    (pkg / "touched.py").write_text('"""touched."""\n\nY = 2\n')
+    changed = _lint_cli(tmp_path, "--changed-only")
+    # gated.py is dirty in the *tree* but unchanged vs HEAD, so its
+    # finding is out of scope; the touched file is clean.
+    assert changed.returncode == 0, changed.stdout + changed.stderr
+    assert json.loads(changed.stdout)["findings"] == []
+    # The full run still fails: --changed-only narrows scope, it does
+    # not bless the tree.
+    assert _lint_cli(tmp_path).returncode == 1
+
+
+def test_changed_only_falls_back_to_full_tree_without_git(tmp_path):
+    pkg = _scratch_repo(tmp_path, git=False)
+    (pkg / "gated.py").write_text(BAD_SNIPPET)
+    proc = _lint_cli(tmp_path, "--changed-only")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "falling back to full-tree" in proc.stderr
+    assert len(json.loads(proc.stdout)["findings"]) == 1
+
+
+def test_changed_only_rejects_explicit_paths():
+    proc = subprocess.run(
+        [sys.executable, DTM_LINT,
+         os.path.join(FIXTURES, "good_thread.py"), "--changed-only"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 2
+    assert "whole-tree" in proc.stderr
+
+
+# --------------------------------------------------------------------------
 # Declared-vs-emitted coverage (check_metrics_schema --declared-coverage)
 # --------------------------------------------------------------------------
 
@@ -290,3 +476,12 @@ def test_declared_coverage_flags_never_emitted_keys(tmp_path):
     assert mod.check_declared_coverage({}, declared) == [
         "report carries no 'metrics' snapshot object"
     ]
+    # only_prefix scopes the declared set: a report owning one
+    # subsystem's keys is checked against that slice alone.
+    assert mod.check_declared_coverage(
+        report, declared, only_prefix=["pipeline/"]
+    ) == []
+    errors = mod.check_declared_coverage(
+        report, declared, only_prefix=["train/"]
+    )
+    assert len(errors) == 1 and "train/dead" in errors[0]

@@ -392,6 +392,24 @@ def test_server_lifecycle_and_drain_artifacts(tmp_path):
             capture_output=True, text=True,
         )
         assert proc.returncode == 0, proc.stderr + proc.stdout
+    # Declared-vs-emitted coverage for the serving slice of the
+    # registry: every SERVE_* constant must appear in this report's
+    # snapshot — the serving twin of test_telemetry's training-side
+    # coverage check, which excuses serve/ precisely because it is
+    # owned here.  No --allow-missing: a served-traffic report that
+    # misses any serve/ key is a writer regression.
+    registry_py = os.path.join(
+        os.path.dirname(SCHEMA_LINT), "..",
+        "distributed_tensorflow_models_tpu", "telemetry", "registry.py",
+    )
+    proc = subprocess.run(
+        [sys.executable, SCHEMA_LINT, str(stats_path),
+         "--declared-coverage", registry_py,
+         "--only-prefix", "serve/"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "scoped to serve/" in proc.stdout
     record = json.loads(record_path.read_text())
     names = {e["name"] for e in record["events"]}
     assert {"serve/prefill", "serve/decode", "serve/drain"} <= names
