@@ -1521,6 +1521,19 @@ def run_serving(args):
     weight-stream-bound at B=1, so aggregate tokens/sec should scale
     near-linearly with occupancy until compute saturates; the headline
     is batched-vs-sequential at concurrency 8.  Matmul-only, CPU-safe.
+
+    Two paged-arena mixes ride along (ISSUE 12), both on a second
+    longer-``max_len`` model and both stream-pinned to solo
+    ``generate`` the same way:
+
+    - **shared_prefix**: a long system prompt + short unique tails,
+      served warm (radix prefix cache resident, 2 prefill lanes)
+      vs the cache-off lanes-1 baseline — the PR10 slotted behavior.
+      Headline ``ttft_speedup`` is mean-TTFT baseline/warm at
+      concurrency 8.
+    - **long_context**: distinct long prompts, prefill lanes 2 vs 1
+      with the cache off — isolates the batched-prefill dispatch
+      amortization on TTFT/throughput.
     """
     import jax
     import jax.numpy as jnp
@@ -1663,6 +1676,189 @@ def run_serving(args):
         }
         log(f"serving batched c={c}: {json.dumps(batched[str(c)])}")
 
+    # ---- paged-arena mixes: one longer-max_len model shared by both.
+    # Shapes keep every prompt page-aligned: page == chunk divides the
+    # shared length, so the warm path resumes exactly at the cached
+    # page boundary.
+    if smoke:
+        sp_shared, sp_tail, sp_new = 8, 2, 4
+        sp_page = 2
+        mix_requests, mix_slots = 4, 4
+        lc_plen = 8
+    else:
+        sp_shared, sp_tail, sp_new = 96, 16, 32
+        sp_page = 16
+        mix_requests, mix_slots = 8, 8
+        lc_plen = 112
+    sp_plen = sp_shared + sp_tail
+    mix_max_len = max(sp_plen, lc_plen) + sp_new
+
+    model2 = get_model(
+        "transformer_lm", **dims, max_len=mix_max_len,
+        dropout_rate=0.0, dtype=jnp.float32,
+    )
+    params2 = model2.init(
+        rng0, jnp.zeros((1, sp_plen), jnp.int32)
+    )["params"]
+    seq_fn2 = jax.jit(
+        lambda p, prompt, rng: generate(
+            model2, p, prompt, sp_new, temperature=temperature,
+            top_k=top_k, top_p=top_p, rng=rng,
+        )
+    )
+
+    def solo_expected(mix_prompts, mix_rngs):
+        return [
+            np.asarray(seq_fn2(params2, jnp.asarray(q)[None], r))[
+                0, len(q):
+            ].tolist()
+            for q, r in zip(mix_prompts, mix_rngs)
+        ]
+
+    def serve_mix(mix_prompts, mix_rngs, mix_expected, *, lanes,
+                  cache, budget, passes, label):
+        """Serve the mix ``1 + passes`` times on one engine (pass 0
+        compiles and, with the cache on, makes the prefix resident);
+        return best-pass mean TTFT / wall and assert every pass's
+        streams against solo generate."""
+        engine = InferenceEngine(
+            model2, params2, max_slots=mix_slots, prefill_chunk=sp_page,
+            decode_burst=decode_burst, prefill_lanes=lanes,
+            kv_page_tokens=sp_page, prefix_cache=cache,
+            registry=reglib.MetricsRegistry(),
+        )
+
+        def serve_all():
+            sched = ContinuousBatchingScheduler(
+                engine, max_prefill_tokens=budget,
+                registry=engine.registry,
+            )
+            for i in range(len(mix_prompts)):
+                sched.submit(Request(
+                    request_id=i, prompt=mix_prompts[i],
+                    max_new_tokens=sp_new, temperature=temperature,
+                    top_k=top_k, top_p=top_p, rng=mix_rngs[i],
+                ))
+            return sched.run_until_idle()
+
+        ok = True
+        best_wall, best_ttft = float("inf"), float("inf")
+        for p in range(1 + passes):
+            t0 = time.perf_counter()
+            comps = {x.request_id: x for x in serve_all()}
+            wall = time.perf_counter() - t0
+            for i, want in enumerate(mix_expected):
+                if comps[i].tokens != want:
+                    ok = False
+                    log(f"serving {label} pass {p} request {i}: "
+                        f"stream DIVERGED from solo generate")
+            if p == 0:
+                continue  # compile + cache-residency pass: untimed
+            best_wall = min(best_wall, wall)
+            best_ttft = min(
+                best_ttft,
+                sum(c.ttft_s for c in comps.values()) / len(comps),
+            )
+        if engine.compile_counts() != (1, 1):
+            ok = False
+            log(f"serving {label}: compile counts "
+                f"{engine.compile_counts()}")
+        stats = {
+            "mean_ttft_s": round(best_ttft, 4),
+            "wall_s": round(best_wall, 3),
+            "tokens_per_sec": round(
+                len(mix_prompts) * sp_new / best_wall, 1
+            ),
+        }
+        log(f"serving {label}: {json.dumps(stats)}")
+        return stats, ok
+
+    # shared-prefix mix: warm radix cache + 2 lanes vs cache-off
+    # lanes-1 (the slotted PR10 behavior on identical streams).
+    shared_tok = np.asarray(
+        jax.random.randint(
+            jax.random.fold_in(rng0, 500), (sp_shared,), 0,
+            dims["vocab_size"],
+        ), np.int32,
+    )
+    sp_prompts = [
+        np.concatenate([
+            shared_tok,
+            np.asarray(
+                jax.random.randint(
+                    jax.random.fold_in(rng0, 600 + i), (sp_tail,), 0,
+                    dims["vocab_size"],
+                ), np.int32,
+            ),
+        ])
+        for i in range(mix_requests)
+    ]
+    sp_rngs = [
+        jax.random.fold_in(rng0, 700 + i) for i in range(mix_requests)
+    ]
+    sp_expected = solo_expected(sp_prompts, sp_rngs)
+    sp_budget = 2 * sp_plen  # two cold prompts per admission wave
+    sp_warm, ok_w = serve_mix(
+        sp_prompts, sp_rngs, sp_expected, lanes=2, cache=True,
+        budget=sp_budget, passes=repeats, label="shared-prefix warm",
+    )
+    sp_base, ok_b = serve_mix(
+        sp_prompts, sp_rngs, sp_expected, lanes=1, cache=False,
+        budget=sp_budget, passes=repeats, label="shared-prefix baseline",
+    )
+    bit_identical = bit_identical and ok_w and ok_b
+    shared_prefix = {
+        "warm": sp_warm,
+        "baseline": sp_base,
+        "ttft_speedup": round(
+            sp_base["mean_ttft_s"] / sp_warm["mean_ttft_s"], 2
+        ),
+        "shared_len": sp_shared,
+        "tail_len": sp_tail,
+        "new_tokens": sp_new,
+        "page_tokens": sp_page,
+        "requests": mix_requests,
+        "concurrency": mix_slots,
+    }
+
+    # long-context mix: distinct long prompts, lanes 2 vs 1, cache off
+    # both sides — pure batched-prefill effect.
+    lc_prompts = [
+        np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng0, 800 + i), (lc_plen,), 0,
+                dims["vocab_size"],
+            ), np.int32,
+        )
+        for i in range(mix_requests)
+    ]
+    lc_rngs = [
+        jax.random.fold_in(rng0, 900 + i) for i in range(mix_requests)
+    ]
+    lc_expected = solo_expected(lc_prompts, lc_rngs)
+    lc_budget = 2 * lc_plen
+    lc_on, ok_on = serve_mix(
+        lc_prompts, lc_rngs, lc_expected, lanes=2, cache=False,
+        budget=lc_budget, passes=repeats, label="long-context lanes=2",
+    )
+    lc_off, ok_off = serve_mix(
+        lc_prompts, lc_rngs, lc_expected, lanes=1, cache=False,
+        budget=lc_budget, passes=repeats, label="long-context lanes=1",
+    )
+    bit_identical = bit_identical and ok_on and ok_off
+    long_context = {
+        "lanes_on": lc_on,
+        "lanes_off": lc_off,
+        "ttft_speedup": round(
+            lc_off["mean_ttft_s"] / lc_on["mean_ttft_s"], 2
+        ),
+        "prompt_len": lc_plen,
+        "new_tokens": sp_new,
+        "page_tokens": sp_page,
+        "requests": mix_requests,
+        "concurrency": mix_slots,
+    }
+
     return {
         "metric": "serving_throughput",
         # Headline: aggregate tokens/sec at concurrency 8 over the
@@ -1673,6 +1869,8 @@ def run_serving(args):
         "sequential_tokens_per_sec": round(seq_tps, 1),
         "sequential_wall_s": round(seq_wall, 3),
         "batched": batched,
+        "shared_prefix": shared_prefix,
+        "long_context": long_context,
         "requests": n_requests,
         "prompt_len": plen,
         "new_tokens": max_new,

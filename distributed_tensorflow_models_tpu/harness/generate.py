@@ -77,6 +77,15 @@ def _sample(logits_last, key, temperature, top_k, top_p, dtype):
     return jnp.argmax(logits_last, axis=-1).astype(dtype)
 
 
+def key_schedule(rng, max_new_tokens: int):
+    """The per-token key schedule: key i samples generated token i (key 0
+    consumes the prompt's last logits row).  Shared with the serving
+    engine (``serving/engine.py``) so offline and served sampling can
+    never drift — byte-identity of served streams vs :func:`generate`
+    depends on both paths splitting the request key identically."""
+    return jax.random.split(rng, max_new_tokens)
+
+
 def generate(
     model,
     params,
@@ -130,7 +139,7 @@ def generate(
     sample = lambda lg, key: _sample(
         lg, key, temperature, top_k, top_p, prompt.dtype
     )
-    keys = jax.random.split(rng, max_new_tokens)  # one per new token
+    keys = key_schedule(rng, max_new_tokens)  # one per new token
     first = sample(logits[:, -1], keys[0])
 
     def step(carry, key):
@@ -201,7 +210,7 @@ def generate_rnn(
     sample = lambda lg, key: _sample(
         lg, key, temperature, top_k, top_p, prompt.dtype
     )
-    keys = jax.random.split(rng, max_new_tokens)
+    keys = key_schedule(rng, max_new_tokens)
     first = sample(logits[:, -1], keys[0])
 
     def step(state, key):

@@ -1,65 +1,87 @@
-"""Slotted inference engine: two compiled programs, bit-identical sampling.
+"""Paged inference engine: two compiled programs, bit-identical sampling.
 
-Ties the KV arena (:mod:`.kv_slots`) to the existing transformer decode
-path (``models/transformer_lm.py`` ``decode=True``) under two jitted
+Ties the paged KV arena (:mod:`.kv_slots`) and the radix prefix cache
+(:mod:`.prefix_cache`) to the existing transformer decode path
+(``models/transformer_lm.py`` ``decode=True``) under two jitted
 programs whose shapes never depend on traffic:
 
-- **prefill** — one ``prefill_chunk``-token right-padded chunk of one
-  request's prompt into one slot (traced slot index), returning the
-  first sampled token when the chunk is the prompt's last.
-- **decode** — ONE batched dispatch for ALL slots: the unmodified B=1
-  single-token apply vmapped over the arena's slot axis, advanced
-  ``decode_burst`` tokens by an in-program ``lax.scan`` (each lane's
-  sample feeds straight back as its next input token, so the burst is
-  the same autoregressive recurrence ``generate()`` runs).  Every
-  in-flight request advances ``decode_burst`` tokens per dispatch, the
-  parameter stream from HBM amortizes over the whole batch, and the
-  per-dispatch host cost (launch, sync, lane bookkeeping) amortizes
-  over the burst — multi-step scheduling, the same lever vLLM's
-  ``--num-scheduler-steps`` pulls.  ``decode_burst=1`` (the default)
-  degrades to classic one-token iteration-level scheduling with the
-  lowest admission latency; the burst length is a construction-time
-  constant, so there is still exactly ONE decode program.
+- **prefill** — ONE batched dispatch for up to ``prefill_lanes``
+  requests: each lane gathers its cache view through its block table,
+  runs one ``prefill_chunk``-token right-padded chunk of its prompt
+  (traced per-lane start/last indices), and every touched page
+  scatters back to the pool in a single flattened write.  An admission
+  burst prefills many prompts per dispatch instead of serially; lanes
+  beyond the burst ride along inert (all-sentinel tables, zero
+  tokens).  Sampling runs per lane so the final chunk's lane returns
+  the request's first generated token.
+- **decode** — ONE batched dispatch for ALL slots over a *persistent
+  working set*: the engine keeps one resident contiguous view per slot
+  (:func:`.kv_slots.make_views`), donated in and out of every dispatch.
+  A lane re-adopts its view from the pool (gather through its block
+  table, :func:`.kv_slots.adopt_lanes`) only on the first dispatch
+  after its prefill — one ``lax.cond`` over the whole working set,
+  gated on any lane needing adoption, so steady-state dispatches
+  execute the identity branch and copy nothing.  The unmodified B=1 single-token
+  apply (vmapped over lanes) then advances the views ``decode_burst``
+  tokens by an in-program ``lax.scan`` (each lane's sample feeds
+  straight back as its next input token — the same autoregressive
+  recurrence ``generate()`` runs).  Decode never writes the pool: the
+  prefix cache shares only PROMPT pages (written by prefill), so
+  decode-written suffix positions are never read from the pool by
+  anyone, and steady-state decode pays zero gather/scatter — the same
+  per-dispatch cost as a dedicated-slot engine.
 
-``tests/test_serving.py`` pins ``_cache_size() == 1`` for both programs
-after a mixed workload: admission, retirement, and slot recycling are
-host bookkeeping and must never trigger a recompile.
+Block tables, lengths, and key material are DATA (padded int32/uint32
+arrays); admission, prefix sharing, copy-on-write, retirement, and
+block recycling only change their values.  ``tests/test_serving.py``
+pins ``_cache_size() == 1`` for both programs after mixed workloads at
+several page sizes: paging and prefix caching add zero compiled
+programs.
 
-**Why right-padding is sound.**  A chunk shorter than ``prefill_chunk``
-is zero-padded on the right; the model writes garbage K/V at the padded
-positions.  Those positions are strictly after every real query position
-in the chunk, so causal masking hides them from the chunk's own logits;
-every later read happens only after a later chunk or a decode step has
-overwritten the position with real K/V (the cache write lands *before*
-attention in the apply).  Same argument covers a recycled slot's stale
-K/V from its previous request.  Counters are force-set to the real
-lengths around each apply (:func:`.kv_slots.set_counters`), and the
-returned logits row is read at the last REAL position — so padding
-never reaches sampling.  Admission must still respect the arena bound:
-the padded prompt (``ceil(len/chunk) * chunk`` positions) has to fit in
-``max_len``, or the final chunk's ``dynamic_update_slice`` would clamp
-backwards onto real positions — :meth:`InferenceEngine.check_fits`
-enforces it.
+**Why paging cannot move a bit.**  Each lane's adopted view is
+byte-for-byte the ``[1, max_len, ...]`` cache a dedicated slot would
+have held (gather through the block table, then advanced in place
+across dispatches exactly as a dedicated slot's cache would be), and
+the model apply over it is unmodified — same reduction shapes and order as the slotted engine,
+and as solo ``generate()`` (decode attention always reduces over the
+full ``max_len`` view with masked scores exactly zeroed; constant
+reduction length, so batch composition, page size, and table layout
+cannot change a single bit).  Right-padding is sound for the same
+reason it was in PR 10: garbage K/V written at padded positions is
+strictly after every real query position (causally masked), lands in
+the lane's own private or sentinel blocks — never in a shared resident
+block (shared pages sit strictly below the prefill start and the
+decode write head) — and every later read of a real position happens
+only after real K/V overwrote it.  Counters are reconstructed from
+host-tracked true lengths around each apply, so the model's
+``dynamic_update_slice`` writes and RoPE rotations see exactly the
+positions solo decoding would.
 
-**Bit-identity.**  :func:`sample_dynamic` recomputes ``generate()``'s
-``_filter_logits`` + ``_sample`` with (temperature, top_k, top_p) as
-*traced per-slot values* instead of Python statics, gated by
-``jnp.where`` so one compiled program serves every sampling mode.  Each
-gate is exact, not approximate: top_k off ⇒ threshold -inf masks
-nothing; top_p off ⇒ the nucleus mask is bypassed wholesale; greedy ⇒
-argmax of the unscaled row, same as ``_sample``.  Combined with the
-model's own padding invariance (decode attention always reduces over
-the full ``max_len`` cache with masked scores exactly zeroed — constant
-reduction length, so batch composition cannot move a single bit) and
-per-request keys precomputed as ``jax.random.split(rng, max_new)``
-(exactly ``generate()``'s schedule), a request's token stream is
-bit-identical to a solo ``generate()`` run regardless of what it was
-batched with — the serving contract ``tests/test_serving.py`` pins
-mode-by-mode.
+**Warm-prefix reuse is exact**, not approximate: the per-position K/V
+a prefill writes is bitwise invariant to how the prompt was chunked and
+to what followed it (each position's projection reads only that
+position's embedding; attention never feeds back into the cache), so a
+resident block holds exactly the bytes the new request's own prefill
+would have produced, and skipping the cached prefix leaves the stream
+byte-identical at any cache warmth — the contract
+``tests/test_serving.py`` pins cold, warm, and mid-divergence.
+
+**Bit-identity of sampling.**  :func:`sample_dynamic` recomputes
+``generate()``'s ``_filter_logits`` + ``_sample`` with (temperature,
+top_k, top_p) as *traced per-lane values* instead of Python statics,
+gated by ``jnp.where`` so one compiled program serves every sampling
+mode.  Each gate is exact: top_k off ⇒ a -inf threshold masks nothing;
+top_p off ⇒ the nucleus mask is bypassed wholesale; greedy ⇒ argmax of
+the unscaled row.  Per-request keys are precomputed via
+:func:`~..harness.generate.key_schedule` — the exact
+``jax.random.split(rng, max_new)`` schedule ``generate()`` uses — so a
+request's token stream is bit-identical to a solo ``generate()`` run
+regardless of what it was batched with.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
@@ -67,7 +89,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from distributed_tensorflow_models_tpu.harness.generate import key_schedule
 from distributed_tensorflow_models_tpu.serving import kv_slots
+from distributed_tensorflow_models_tpu.serving.prefix_cache import (
+    RadixPrefixCache,
+    prompt_pages,
+)
 from distributed_tensorflow_models_tpu.telemetry import registry as reglib
 
 
@@ -113,18 +140,27 @@ def sample_dynamic(row, keydata, temperature, top_k, top_p, dtype):
 
 
 class InferenceEngine:
-    """The device half of serving: arena + the two jitted programs.
+    """The device half of serving: paged pool + the two jitted programs.
 
     ``model`` is the TRAINING-configured ``TransformerLM`` (re-cloned
     here with ``decode=True``, like ``generate()``); ``params`` its
-    trained parameters.  The engine owns the arena and the
-    :class:`~.kv_slots.SlotManager`; the scheduler decides WHICH
-    requests occupy slots, the engine only moves tokens.
+    trained parameters.  The engine owns the pool, the block allocator,
+    the prefix cache, and the :class:`~.kv_slots.SlotManager`; the
+    scheduler decides WHICH requests get admitted, the engine moves
+    tokens and blocks.
 
-    The arena is donated to both jitted programs, so each step updates
-    it in place (no second arena's worth of HBM) — callers must treat
-    ``self.arena`` as consumed across calls, which the engine does
-    internally by always rebinding it.
+    The pool is donated to both jitted programs, so each step updates
+    it in place (no second pool's worth of HBM) — callers must treat
+    ``self.pool`` as consumed across calls, which the engine does
+    internally by always rebinding it in the same statement as the
+    dispatch.
+
+    Admission is two-resource: a decode lane (slot) AND enough free
+    blocks for the request's whole reservation,
+    ``ceil((prompt + max_new) / kv_page_tokens)`` pages, taken up front
+    (minus whatever the prefix cache already holds) so a request can
+    never be stranded mid-decode by pool exhaustion — exhaustion is
+    admission backpressure, not preemption.
     """
 
     def __init__(
@@ -135,6 +171,11 @@ class InferenceEngine:
         max_slots: int = 8,
         prefill_chunk: int = 32,
         decode_burst: int = 1,
+        prefill_lanes: int = 1,
+        kv_page_tokens: Optional[int] = None,
+        kv_pool_blocks: Optional[int] = None,
+        prefix_cache: bool = True,
+        prefix_cache_blocks: Optional[int] = None,
         registry: Optional[reglib.MetricsRegistry] = None,
     ):
         if decode_burst < 1:
@@ -150,16 +191,70 @@ class InferenceEngine:
                 f"prefill_chunk {prefill_chunk} exceeds model max_len "
                 f"{model.max_len}"
             )
+        if prefill_lanes < 1:
+            raise ValueError(
+                f"prefill_lanes must be >= 1, got {prefill_lanes}"
+            )
         self.model = model
         self.params = params
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.decode_burst = int(decode_burst)
+        self.prefill_lanes = int(prefill_lanes)
         self.max_len = int(model.max_len)
+        if kv_page_tokens is None:
+            # Largest page that both divides max_len (tables must tile
+            # it exactly) and divides prefill_chunk or vice versa —
+            # gcd satisfies both and degrades gracefully for any pair.
+            kv_page_tokens = math.gcd(self.max_len, self.prefill_chunk)
+        if kv_page_tokens < 1 or self.max_len % kv_page_tokens != 0:
+            raise ValueError(
+                f"kv_page_tokens {kv_page_tokens} must be >= 1 and "
+                f"divide max_len {self.max_len}"
+            )
+        self.kv_page_tokens = int(kv_page_tokens)
+        self._page = self.kv_page_tokens
+        self._bps = self.max_len // self._page  # table width (blocks/seq)
+        if kv_pool_blocks is None:
+            # Sentinel + a full max_len reservation per slot: the paged
+            # default can admit at least everything the slotted arena
+            # could, and the prefix cache only adds headroom on top.
+            kv_pool_blocks = self.max_slots * self._bps + 1
+        if kv_pool_blocks < self._bps + 1:
+            raise ValueError(
+                f"kv_pool_blocks {kv_pool_blocks} cannot hold one "
+                f"max_len sequence ({self._bps} blocks + sentinel)"
+            )
+        self.num_blocks = int(kv_pool_blocks)
         self.registry = registry if registry is not None else reglib.get_registry()
         self.slots = kv_slots.SlotManager(max_slots)
+        self.blocks = kv_slots.BlockPool(self.num_blocks)
+        self.prefix_cache = (
+            RadixPrefixCache(
+                self.blocks, self._page, max_blocks=prefix_cache_blocks
+            )
+            if prefix_cache else None
+        )
+        self._evictions_seen = 0  # cache.evictions already mirrored
         self._decode_model = model.clone(decode=True, dropout_rate=0.0)
-        self.arena = kv_slots.make_arena(self._decode_model, max_slots)
+        self.pool = kv_slots.make_pool(
+            self._decode_model, self.num_blocks, self._page
+        )
+        # Decode working set: one resident contiguous view per slot,
+        # donated through every decode dispatch.  _views_fresh[s] marks
+        # "the pool holds newer bytes than slot s's view" (set when a
+        # prefill completes, cleared when decode adopts the lane).
+        self._views = kv_slots.make_views(
+            self._decode_model, self.max_slots, self.max_len
+        )
+        self._views_fresh = np.zeros((self.max_slots,), bool)
+        # Host mirrors of per-slot device inputs: block-table rows and
+        # true sequence lengths (counters are derived from these on
+        # every dispatch — the pool itself holds no positions).
+        self._tables = np.zeros((self.max_slots, self._bps), np.int32)
+        self._lengths = np.zeros((self.max_slots,), np.int32)
+        self._slot_blocks: dict = {}  # slot -> blocks this request holds
+        self._slot_cached: dict = {}  # slot -> cached prefix length
         # Key-material layout for this backend's PRNG impl (threefry:
         # uint32[2] per key) — probed, not hardcoded, so an rbg/unsafe
         # impl switch keeps working.
@@ -172,14 +267,23 @@ class InferenceEngine:
     # -- request bookkeeping helpers --------------------------------------
 
     def padded_len(self, prompt_len: int) -> int:
-        """Arena positions a prompt occupies after right-padded chunking."""
+        """Positions a cold prompt occupies after right-padded chunking."""
         c = self.prefill_chunk
         return -(-prompt_len // c) * c
 
+    def padded_suffix(self, prompt_len: int, cached_len: int = 0) -> int:
+        """Positions the UNCACHED tail of a prompt occupies after
+        right-padded chunking from ``cached_len`` — the prefill work a
+        warm request actually pays (and what admission budgets)."""
+        c = self.prefill_chunk
+        return -(-(prompt_len - cached_len) // c) * c
+
     def check_fits(self, prompt_len: int, max_new_tokens: int) -> None:
-        """Admission bound: real tokens AND the padded prefill footprint
-        must fit in ``max_len`` (a clamped final-chunk write would
-        corrupt real positions — module docstring)."""
+        """Admission bound: real tokens AND the cold padded prefill
+        footprint must fit in ``max_len`` (a clamped final-chunk write
+        would corrupt real positions — module docstring).  Cold is the
+        worst case; warm admission only shrinks the footprint
+        (:meth:`_usable_cached_len` re-checks at the actual warmth)."""
         if prompt_len < 1:
             raise ValueError("prompt must be non-empty")
         total = prompt_len + max_new_tokens
@@ -197,10 +301,10 @@ class InferenceEngine:
 
     def request_keys(self, rng, max_new_tokens: int) -> np.ndarray:
         """Per-token key material, ``[max_new_tokens, *key_shape]`` —
-        exactly ``generate()``'s ``jax.random.split(rng, max_new)``
-        schedule, so token i of this request samples with the same key
-        solo decoding would have used."""
-        keys = jax.random.split(rng, max_new_tokens)
+        exactly ``generate()``'s ``key_schedule`` (the shared helper),
+        so token i of this request samples with the same key solo
+        decoding would have used."""
+        keys = key_schedule(rng, max_new_tokens)
         return np.asarray(jax.random.key_data(keys))
 
     def zero_keys(self, max_new_tokens: int) -> np.ndarray:
@@ -210,89 +314,325 @@ class InferenceEngine:
             (max_new_tokens,) + self._key_shape, self._key_dtype
         )
 
+    # -- block/prefix admission --------------------------------------------
+
+    def _matchable(self, prompt) -> list:
+        """The prompt's shareable pages: full pages only, and never the
+        final page of an exactly-page-aligned prompt — at least one real
+        token must prefill so the first sampled token has a logits row
+        (partial-page sharing would need a third compiled copy program)."""
+        pages = prompt_pages(prompt, self._page)
+        return pages[: (len(prompt) - 1) // self._page]
+
+    def _usable_cached_len(self, prompt_len: int, depth: int) -> int:
+        """Cached tokens actually usable at warmth ``depth`` (matched
+        blocks): stepped down page-by-page until the right-padded
+        uncached suffix fits ``max_len`` — a warm start must never push
+        the final chunk's padded write past the table (terminates at 0,
+        which :meth:`check_fits` already guaranteed fits)."""
+        cached = min(
+            depth * self._page,
+            (prompt_len - 1) // self._page * self._page,
+        )
+        while cached > 0 and (
+            cached + self.padded_suffix(prompt_len, cached) > self.max_len
+        ):
+            cached -= self._page
+        return cached
+
+    def peek_prefill_cost(self, prompt) -> int:
+        """Padded uncached-suffix length admission WOULD pay for this
+        prompt right now, without touching cache state (LRU stamps,
+        counters) — the scheduler's budget estimate."""
+        plen = len(prompt)
+        depth = (
+            self.prefix_cache.peek(self._matchable(prompt))
+            if self.prefix_cache is not None else 0
+        )
+        return self.padded_suffix(plen, self._usable_cached_len(plen, depth))
+
+    def admit(self, request_id: int, prompt,
+              max_new_tokens: int) -> Optional[tuple]:
+        """Two-resource admission: claim a slot AND the request's whole
+        block reservation, reusing the longest resident prefix.  Returns
+        ``(slot, cached_len)`` or None (no slot / not enough blocks even
+        after evicting idle residents — backpressure, nothing leaked).
+
+        ``prompt`` must already satisfy :meth:`check_fits` together with
+        ``max_new_tokens`` — the caller validated at submit.  The
+        reservation covers prompt + max_new rounded up to whole pages,
+        so the request can never run out of blocks mid-decode.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = len(prompt)
+        n_pages = -(-(plen + max_new_tokens) // self._page)
+        if self.slots.free_count < 1:
+            return None
+        matchable = (
+            self._matchable(prompt) if self.prefix_cache is not None else []
+        )
+        depth = (
+            self.prefix_cache.peek(matchable) if matchable else 0
+        )
+        cached = self._usable_cached_len(plen, depth)
+        keep = cached // self._page
+        matched = (
+            self.prefix_cache.match(matchable[:keep]) if keep > 0 else []
+        )
+        if matched:
+            # Retain BEFORE any eviction below: an evicted-but-matched
+            # block must stay allocated for this request.
+            self.blocks.retain(matched)
+        need = n_pages - len(matched)
+        if need > self.blocks.free_count and self.prefix_cache is not None:
+            self.prefix_cache.evict(need - self.blocks.free_count)
+            self._sync_eviction_counter()
+        fresh = self.blocks.alloc(need)
+        if fresh is None:
+            if matched:
+                self.blocks.release(matched)
+            return None
+        if matchable:
+            hits = len(matched)
+            misses = len(matchable) - hits
+            if hits:
+                self.registry.counter(
+                    reglib.SERVE_PREFIX_CACHE_HITS
+                ).inc(hits)
+            if misses:
+                self.registry.counter(
+                    reglib.SERVE_PREFIX_CACHE_MISSES
+                ).inc(misses)
+        blocks = matched + fresh
+        slot = self.slots.alloc(request_id)
+        row = np.zeros((self._bps,), np.int32)  # padding -> sentinel 0
+        row[: len(blocks)] = blocks
+        self._tables[slot] = row
+        self._lengths[slot] = cached
+        self._slot_blocks[slot] = blocks
+        self._slot_cached[slot] = cached
+        return slot, cached
+
+    def release(self, slot: int) -> int:
+        """Retire ``slot``: drop the request's block references (pages
+        the prefix cache adopted stay resident; the rest go back on the
+        free list) and clear its table row.  Returns the request id."""
+        request_id = self.slots.free(slot)
+        self.blocks.release(self._slot_blocks.pop(slot))
+        self._slot_cached.pop(slot, None)
+        self._tables[slot] = 0
+        self._lengths[slot] = 0
+        self._views_fresh[slot] = False
+        return request_id
+
+    def _sync_eviction_counter(self) -> None:
+        delta = (
+            self.prefix_cache.evictions - self._evictions_seen
+            if self.prefix_cache is not None else 0
+        )
+        if delta:
+            self.registry.counter(
+                reglib.SERVE_PREFIX_CACHE_EVICTIONS
+            ).inc(delta)
+            self._evictions_seen = self.prefix_cache.evictions
+
+    # -- pool telemetry -----------------------------------------------------
+
+    @property
+    def blocks_free(self) -> int:
+        return self.blocks.free_count
+
+    @property
+    def blocks_resident(self) -> int:
+        return (
+            self.prefix_cache.resident_count
+            if self.prefix_cache is not None else 0
+        )
+
+    def fragmentation(self) -> float:
+        """Internal fragmentation of active reservations: the fraction
+        of block-granular token capacity reserved by in-flight requests
+        that holds no live token yet (0.0 when idle).  High values mean
+        ``kv_page_tokens`` is coarse relative to typical lengths."""
+        reserved = sum(len(b) for b in self._slot_blocks.values())
+        if reserved == 0:
+            return 0.0
+        live = sum(int(self._lengths[s]) for s in self._slot_blocks)
+        return 1.0 - live / (reserved * self._page)
+
     # -- the two device programs ------------------------------------------
 
-    def _prefill_fn(self, params, arena, slot, tokens, start, new_len,
-                    keydata, temperature, top_k, top_p, last):
-        """One prompt chunk into one slot.  ``tokens`` is ``[1, chunk]``
-        right-padded; ``start``/``new_len`` the real positions before and
-        after; ``last`` the chunk-local index of the last real token
-        (its logits seed the first generated token on the final chunk —
-        the caller ignores the sample for earlier chunks)."""
-        cache = kv_slots.extract_slot(arena, slot)
-        cache = kv_slots.set_counters(cache, start)
-        (logits, _), mutated = self._decode_model.apply(
-            {"params": params, "cache": cache}, tokens,
-            train=False, mutable=["cache"],
-        )
-        cache = kv_slots.set_counters(mutated["cache"], new_len)
-        arena = kv_slots.write_slot(arena, cache, slot)
-        row = logits[0].astype(jnp.float32)[last]
-        tok = sample_dynamic(
-            row, keydata, temperature, top_k, top_p, jnp.int32
-        )
-        return arena, tok
+    def _prefill_fn(self, params, pool, tables, tokens, start, keydata,
+                    temperature, top_k, top_p, last):
+        """One prompt chunk per lane, ``prefill_lanes`` lanes per
+        dispatch.  Per lane: ``tokens`` row is ``[chunk]`` right-padded,
+        ``start`` the real position before it, ``last`` the chunk-local
+        index of the last real token (its logits seed the first
+        generated token on the final chunk — the caller ignores the
+        sample for earlier chunks and for inert lanes).  Every lane's
+        pages scatter back in one flattened write; shared and sentinel
+        blocks may repeat across lanes, carrying identical (resp.
+        unreachable) values — see :mod:`.kv_slots`."""
 
-    def _decode_fn(self, params, arena, tokens, keydata, temperature,
-                   top_k, top_p):
-        """One batched decode dispatch: the unmodified B=1 single-token
-        apply vmapped over the slot axis, advanced ``decode_burst``
-        tokens by ``lax.scan`` — each lane's sampled token feeds back as
-        its next input, exactly ``generate()``'s recurrence, so burst
-        length cannot move a bit.  ``keydata`` is ``[S, K, *key]`` (one
-        key row per lane per burst token); returns the ``[K, S]`` token
-        matrix.  Free slots ride along as zero lanes (their writes land
-        at their own counters, harmless; their samples are discarded
-        host-side)."""
-
-        def one(cache, tok, kd, t, k, p):
+        def one(table, toks, s, kd, t, k, p, li):
+            cache = kv_slots.gather_cache(pool, table, s)
             (logits, _), mutated = self._decode_model.apply(
-                {"params": params, "cache": cache}, tok[None, None],
+                {"params": params, "cache": cache}, toks[None],
                 train=False, mutable=["cache"],
             )
-            row = logits[0, -1].astype(jnp.float32)
-            return mutated["cache"], sample_dynamic(
-                row, kd, t, k, p, jnp.int32
-            )
+            row = logits[0].astype(jnp.float32)[li]
+            tok = sample_dynamic(row, kd, t, k, p, jnp.int32)
+            return kv_slots.cache_pages(mutated["cache"], self._page), tok
+
+        pages, toks = jax.vmap(one)(
+            tables, tokens, start, keydata, temperature, top_k, top_p,
+            last,
+        )
+        flat = jax.tree_util.tree_map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), pages
+        )
+        pool = kv_slots.scatter_pages(pool, flat, tables.reshape(-1))
+        return pool, toks
+
+    def _decode_fn(self, params, views, pool, refresh, tables, lengths,
+                   tokens, keydata, temperature, top_k, top_p):
+        """One batched decode dispatch over the persistent decode
+        working set (``views``, donated in and out): lanes the host
+        flagged in ``refresh`` first re-adopt their view from the pool
+        — a gather through their block table, paid once per admission,
+        not per dispatch (ONE ``lax.cond`` over the whole working set,
+        so dispatches with no refresh execute the identity branch and
+        copy nothing) — then
+        the unmodified B=1 single-token apply (vmapped over lanes)
+        advances every view ``decode_burst`` tokens by ``lax.scan``,
+        each lane's sample feeding back as its next input token:
+        exactly ``generate()``'s recurrence, and exactly the slotted
+        engine's decode program over the same bytes, so paging, burst
+        length, and adoption timing cannot move a bit.  The pool is
+        READ-ONLY here; generated K/V lives only in the views (nothing
+        ever reads a suffix page from the pool — the prefix cache
+        shares prompt pages, which prefill wrote).  ``keydata`` is
+        ``[S, K, *key]``; returns the ``[K, S]`` token matrix.  Overrun
+        lanes clamp their writes inside their own view and the caller
+        discards their samples; free slots ride along as inert lanes."""
+        views = lax.cond(
+            jnp.any(refresh),
+            lambda v: kv_slots.adopt_lanes(v, pool, tables, refresh),
+            lambda v: v,
+            views,
+        )
+        caches = kv_slots.set_counters(views, lengths)
 
         def burst_step(carry, kd_t):
-            arena, toks = carry
-            arena, nxt = jax.vmap(one)(
-                arena, toks, kd_t, temperature, top_k, top_p
-            )
-            return (arena, nxt), nxt
+            caches_t, toks = carry
 
-        (arena, _), out = lax.scan(
-            burst_step, (arena, tokens), jnp.swapaxes(keydata, 0, 1)
+            def one(cache, tok, kd, t, k, p):
+                (logits, _), mutated = self._decode_model.apply(
+                    {"params": params, "cache": cache}, tok[None, None],
+                    train=False, mutable=["cache"],
+                )
+                row = logits[0, -1].astype(jnp.float32)
+                nxt = sample_dynamic(row, kd, t, k, p, jnp.int32)
+                return mutated["cache"], nxt
+
+            caches_t, nxt = jax.vmap(one)(
+                caches_t, toks, kd_t, temperature, top_k, top_p
+            )
+            return (caches_t, nxt), nxt
+
+        (caches, _), out = lax.scan(
+            burst_step, (caches, tokens), jnp.swapaxes(keydata, 0, 1)
         )
-        return arena, out
+        return kv_slots.placeholder_counters(views, caches), out
 
     # -- host-facing ops ---------------------------------------------------
 
     def prefill(self, slot: int, prompt: np.ndarray, keydata: np.ndarray,
                 temperature: float, top_k: int, top_p: float) -> int:
-        """Run one request's full (chunked) prompt into ``slot``; returns
-        the first generated token (sampled with ``keydata`` — key 0 of
-        the request's schedule, matching ``generate()``'s seeding of the
-        first token from the prompt's last logits)."""
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
-        c = self.prefill_chunk
-        tok = None
+        """Run one request's uncached prompt suffix into ``slot``;
+        returns the first generated token.  Single-request convenience
+        over :meth:`prefill_batch`."""
+        return self.prefill_batch(
+            [(slot, prompt, keydata, temperature, top_k, top_p)]
+        )[slot]
+
+    def prefill_batch(self, items: list) -> dict:
+        """Prefill a burst of admitted requests, ``prefill_lanes`` at a
+        time per dispatch of the ONE prefill program.  ``items`` is a
+        list of ``(slot, prompt, keydata0, temperature, top_k, top_p)``
+        (``keydata0`` = key 0 of the request's schedule, matching
+        ``generate()``'s seeding of the first token from the prompt's
+        last logits).  Each lane starts at its admitted cached length —
+        resident prefix pages are never re-prefilled.  Lanes with
+        shorter suffixes go inert once done (sentinel tables).  After
+        the burst completes, every prompt's shareable pages are inserted
+        into the prefix cache — never earlier, so a same-burst twin
+        cannot match blocks that are still being filled.  Returns
+        ``{slot: first_token}``."""
+        lanes, c = self.prefill_lanes, self.prefill_chunk
+        out = {}
         with self.registry.span(reglib.SERVE_PREFILL):
-            for lo in range(0, len(prompt), c):
-                chunk = prompt[lo:lo + c]
-                real = len(chunk)
-                padded = np.zeros((c,), np.int32)
-                padded[:real] = chunk
-                self.arena, tok = self._prefill_j(
-                    self.params, self.arena, jnp.int32(slot),
-                    jnp.asarray(padded)[None], jnp.int32(lo),
-                    jnp.int32(lo + real), jnp.asarray(keydata),
-                    jnp.float32(temperature), jnp.int32(top_k),
-                    jnp.float32(top_p), jnp.int32(real - 1),
-                )
-            tok = int(tok)
-        return tok
+            for g in range(0, len(items), lanes):
+                plans = []
+                for slot, prompt, kd0, t, k, p in items[g:g + lanes]:
+                    prompt = np.asarray(prompt, np.int32).reshape(-1)
+                    lo0 = self._slot_cached.get(slot, 0)
+                    bounds = [
+                        (lo, min(lo + c, len(prompt)))
+                        for lo in range(lo0, len(prompt), c)
+                    ]
+                    plans.append((slot, prompt, kd0, t, k, p, bounds))
+                for w in range(max(len(pl[6]) for pl in plans)):
+                    tables = np.zeros((lanes, self._bps), np.int32)
+                    tokens = np.zeros((lanes, c), np.int32)
+                    starts = np.zeros((lanes,), np.int32)
+                    keydata = np.zeros(
+                        (lanes,) + self._key_shape, self._key_dtype
+                    )
+                    temperature = np.zeros((lanes,), np.float32)
+                    top_k = np.zeros((lanes,), np.int32)
+                    top_p = np.ones((lanes,), np.float32)
+                    last = np.zeros((lanes,), np.int32)
+                    for i, (slot, prompt, kd0, t, k, p, bounds) in (
+                        enumerate(plans)
+                    ):
+                        if w >= len(bounds):
+                            continue  # inert lane
+                        lo, hi = bounds[w]
+                        tables[i] = self._tables[slot]
+                        tokens[i, : hi - lo] = prompt[lo:hi]
+                        starts[i] = lo
+                        keydata[i] = np.asarray(
+                            kd0, self._key_dtype
+                        ).reshape(self._key_shape)
+                        temperature[i] = t
+                        top_k[i] = k
+                        top_p[i] = p
+                        last[i] = hi - lo - 1
+                    self.pool, toks = self._prefill_j(
+                        self.params, self.pool, jnp.asarray(tables),
+                        jnp.asarray(tokens), jnp.asarray(starts),
+                        jnp.asarray(keydata), jnp.asarray(temperature),
+                        jnp.asarray(top_k), jnp.asarray(top_p),
+                        jnp.asarray(last),
+                    )
+                    toks = np.asarray(toks)
+                    for i, (slot, *_rest, bounds) in enumerate(plans):
+                        if w == len(bounds) - 1:
+                            out[slot] = int(toks[i])
+                for slot, prompt, *_rest in plans:
+                    self._lengths[slot] = len(prompt)
+                    self._views_fresh[slot] = True
+                    if self.prefix_cache is not None:
+                        pages = self._matchable(prompt)
+                        if pages:
+                            self.prefix_cache.insert(
+                                pages,
+                                [int(b) for b in
+                                 self._tables[slot][:len(pages)]],
+                            )
+                            self._sync_eviction_counter()
+        return out
 
     def decode_step(self, lanes: dict) -> dict:
         """One batched decode dispatch (``decode_burst`` tokens).
@@ -304,15 +644,20 @@ class InferenceEngine:
         discard — such a lane finishes inside this burst, so its slot is
         retired and the overrun never reaches a live request).  Returns
         ``{slot: [token, ...]}`` (``decode_burst`` tokens per lane) for
-        the same slots.  Inactive slots run as inert zero lanes — the
-        program shape never depends on how many requests are live."""
+        the same slots.  Inactive slots run as inert sentinel lanes —
+        the program shape never depends on how many requests are live."""
         s, k = self.max_slots, self.decode_burst
+        tables = np.zeros((s, self._bps), np.int32)
+        lengths = np.zeros((s,), np.int32)
         tokens = np.zeros((s,), np.int32)
         keydata = np.zeros((s, k) + self._key_shape, self._key_dtype)
         temperature = np.zeros((s,), np.float32)
         top_k = np.zeros((s,), np.int32)
         top_p = np.ones((s,), np.float32)
+        refresh = np.zeros((s,), bool)
         for slot, (tok, kd, t, tk, p) in lanes.items():
+            tables[slot] = self._tables[slot]
+            lengths[slot] = self._lengths[slot]
             tokens[slot] = tok
             kd = np.asarray(kd, self._key_dtype).reshape(
                 (-1,) + self._key_shape
@@ -321,13 +666,23 @@ class InferenceEngine:
             temperature[slot] = t
             top_k[slot] = tk
             top_p[slot] = p
+            # Adopt only lanes whose pool bytes are newer than their
+            # view AND whose real table row is on this dispatch (a
+            # fresh slot not decoded yet keeps its flag for later).
+            if self._views_fresh[slot]:
+                refresh[slot] = True
         with self.registry.span(reglib.SERVE_DECODE):
-            self.arena, nxt = self._decode_j(
-                self.params, self.arena, jnp.asarray(tokens),
+            self._views, nxt = self._decode_j(
+                self.params, self._views, self.pool,
+                jnp.asarray(refresh), jnp.asarray(tables),
+                jnp.asarray(lengths), jnp.asarray(tokens),
                 jnp.asarray(keydata), jnp.asarray(temperature),
                 jnp.asarray(top_k), jnp.asarray(top_p),
             )
             nxt = np.asarray(nxt)  # [K, S]
+        self._views_fresh[refresh] = False
+        for slot in lanes:
+            self._lengths[slot] += k
         return {
             slot: [int(nxt[i, slot]) for i in range(k)] for slot in lanes
         }

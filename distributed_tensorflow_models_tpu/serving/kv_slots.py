@@ -1,31 +1,57 @@
-"""Slotted KV arena: static device shapes, host-side slot bookkeeping.
+"""Paged KV arena: block pool + block tables, static device shapes.
 
 The transformer decode cache for ONE sequence is a pytree of
 ``[1, max_len, kv_heads, head_dim]`` leaves plus two scalar counters
 (``cache_index`` — next write position, ``pos_index`` — next absolute
-position; see ``models/transformer_lm.py``).  Serving needs many
-sequences in flight with *independent* positions, but the model's
-counters are scalars — so instead of teaching the model a batch of
-counters, the arena stacks ``max_slots`` complete single-sequence
-caches along a new leading axis and the engine vmaps the unmodified
-B=1 decode over it.  Scalar counter leaves become ``[max_slots]``
-arrays under the same stacking, which is exactly what vmap expects.
+position; see ``models/transformer_lm.py``).  PR 10's slotted arena
+stacked ``max_slots`` complete caches, reserving ``max_len`` positions
+per slot no matter the actual lengths.  This module replaces that with
+PagedAttention-style block granularity:
 
-Why this is TPU-shaped: the arena is allocated ONCE with static shapes;
-admitting, retiring, or recycling a request never changes any device
-shape.  ``extract_slot`` / ``write_slot`` are ``lax.dynamic_*_in_dim``
-on the leading axis (traced slot index), so the prefill program is
-identical for every slot and compiles once.  Alloc/free/occupancy are
-pure host-side index bookkeeping (:class:`SlotManager`) — the device
-never sees them.  The fixed-shape trade-off vs PagedAttention: every
-slot reserves ``max_len`` positions, so memory is
-``max_slots × max_len`` regardless of actual lengths — the right trade
-on TPU, where dynamic shapes force recompiles that cost more than the
-reserved HBM.
+- **Pool** (:func:`make_pool`): every K/V leaf becomes
+  ``[num_blocks, page_tokens, kv_heads, head_dim]`` — one preallocated
+  pool of fixed-size pages, allocated ONCE.  Counter leaves are kept as
+  scalar placeholders only so the pool mirrors the cache's tree
+  structure; real counters are reconstructed from host-tracked lengths
+  on every dispatch (:func:`gather_cache`), which is what lets many
+  sequences share one pool without teaching the model batched counters.
+- **Block tables**: each sequence owns a padded ``[max_len //
+  page_tokens]`` int32 row of physical block ids (block 0 is a
+  never-allocated sentinel; padding entries point at it).  Tables are
+  data, never shapes: admission, sharing, retirement and recycling
+  change table *values* only, so the two compiled programs survive any
+  traffic (the ``compile_counts() == (1, 1)`` pin).
+- **Gather / scatter** (:func:`gather_cache`, :func:`cache_pages`,
+  :func:`scatter_pages`): attention reads KV through the table by
+  gathering the sequence's pages into a contiguous ``[1, max_len, ...]``
+  view, running the UNMODIFIED model apply, and — in prefill —
+  scattering touched pages back.  The view is bit-identical to what the
+  slotted arena held, so the serving bit-identity contract is page-size
+  independent.  Scatter indices may repeat across lanes (shared prefix
+  blocks get identical values from every sharer; sentinel block 0
+  collects padding garbage no live table row of a live position ever
+  reads) — duplicate-index nondeterminism can therefore never reach a
+  served token.
+- **Decode working set** (:func:`make_views`, :func:`adopt_lanes`,
+  :func:`placeholder_counters`): decode keeps one resident view per
+  slot, donated across dispatches, and gathers a lane from the pool
+  only when admission/prefill made the pool newer.  Decode never
+  writes the pool — generated-suffix pages exist there as reserved
+  capacity only (nothing ever reads them: the prefix cache shares
+  PROMPT pages, written by prefill) — so shared blocks are
+  decode-untouchable by construction, and per-token KV traffic in
+  steady state is zero, matching the slotted engine's.
+
+Alloc/free/refcount/residency are pure host bookkeeping
+(:class:`BlockPool`); the device never sees them.  :class:`SlotManager`
+(decode-lane bookkeeping) is unchanged from the slotted engine — lanes
+are a program-shape resource, blocks are a memory resource, and the two
+are now decoupled.
 """
 
 from __future__ import annotations
 
+import heapq
 from typing import Optional
 
 import jax
@@ -33,17 +59,16 @@ import jax.numpy as jnp
 from jax import lax
 
 # Scalar position counters in the decode cache (see SelfAttention /
-# TransformerLM ``decode=True`` variables).  Stacked per-slot by the
-# arena; force-set around chunked prefill by the engine.
+# TransformerLM ``decode=True`` variables).  Kept as scalar placeholders
+# in the pool; reconstructed from host lengths around every apply.
 COUNTER_LEAVES = ("cache_index", "pos_index")
 
 
 def set_counters(cache, value):
     """Return ``cache`` with every counter leaf set to ``value`` (cast to
-    the leaf's dtype).  Chunked prefill needs this twice per chunk: the
-    model advances its counters by the full (padded) chunk length, but
-    the real sequence position is ``start + real_tokens`` — the engine
-    pins the counters to the truth on the way in and the way out."""
+    the leaf's dtype).  The engine pins counters to the true sequence
+    position around each apply — the model advances them by the full
+    (padded) chunk length, the host knows the real one."""
 
     def walk(node):
         if isinstance(node, dict):
@@ -57,48 +82,252 @@ def set_counters(cache, value):
     return walk(cache)
 
 
-def make_arena(decode_model, max_slots: int, params=None):
-    """Allocate the ``[max_slots, ...]`` KV arena for ``decode_model``
-    (a model cloned with ``decode=True``): one zeroed single-sequence
-    cache per slot, stacked on a new leading axis.
+def make_pool(decode_model, num_blocks: int, page_tokens: int):
+    """Allocate the paged KV pool for ``decode_model`` (a model cloned
+    with ``decode=True``): every ``[1, max_len, H, Dh]`` cache leaf
+    becomes ``[num_blocks, page_tokens, H, Dh]``; counter leaves stay as
+    scalar placeholders (values never read — lengths live on the host).
 
     Shapes come from ``jax.eval_shape`` over a one-token init — no
-    device work, no params needed (pass ``params`` only to silence
-    re-init cost concerns; it is unused because eval_shape is abstract).
-    Zero-init is safe for recycled slots too: stale K/V at positions at
-    or beyond the live sequence's write head is either causally masked
-    (position > query) or overwritten just-in-time by the next write —
-    the engine's padding argument, see ``engine.py``.
+    device work.  Zero-init is safe exactly as it was for the slotted
+    arena: stale K/V in a recycled block is either causally masked
+    (position > query) or overwritten just-in-time before any read.
     """
-    del params  # shapes only — eval_shape never touches values
     shapes = jax.eval_shape(
         lambda: decode_model.init(
             jax.random.key(0), jnp.zeros((1, 1), jnp.int32)
         )
     )["cache"]
-    return jax.tree.map(
-        lambda s: jnp.zeros((max_slots,) + s.shape, s.dtype), shapes
-    )
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {
+                k: (jnp.zeros((), v.dtype) if k in COUNTER_LEAVES
+                    else walk(v))
+                for k, v in node.items()
+            }
+        return jnp.zeros(
+            (num_blocks, page_tokens) + node.shape[2:], node.dtype
+        )
+
+    return walk(shapes)
 
 
-def extract_slot(arena, slot):
-    """One slot's single-sequence cache view (traced ``slot`` ok)."""
-    return jax.tree.map(
-        lambda a: lax.dynamic_index_in_dim(a, slot, 0, keepdims=False),
-        arena,
-    )
+def gather_cache(pool, table, length):
+    """One sequence's contiguous ``[1, max_len, ...]`` cache view,
+    gathered through its block table (traced ``table`` / ``length`` ok).
+
+    Counter leaves materialize from ``length`` (the host-tracked true
+    position).  The gathered view is byte-for-byte the cache a
+    dedicated ``max_len`` slot would have held, so the unmodified model
+    apply over it reduces identically — paging cannot move a bit.
+    """
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {
+                k: (jnp.asarray(length, v.dtype) if k in COUNTER_LEAVES
+                    else walk(v))
+                for k, v in node.items()
+            }
+        pages = jnp.take(node, table, axis=0)  # [bps, page, H, Dh]
+        return pages.reshape(
+            (1, pages.shape[0] * pages.shape[1]) + pages.shape[2:]
+        )
+
+    return walk(pool)
 
 
-def write_slot(arena, cache, slot):
-    """Write a single-sequence cache back into its arena slot."""
-    return jax.tree.map(
-        lambda a, c: lax.dynamic_update_index_in_dim(a, c, slot, 0),
-        arena, cache,
-    )
+def cache_pages(cache, page_tokens: int):
+    """A mutated view's K/V leaves re-paged to ``[bps, page, H, Dh]``,
+    ready to scatter back through the same table that gathered them.
+    Counter leaves ride along unchanged (:func:`scatter_pages` ignores
+    them — lengths are host truth)."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {
+                k: (v if k in COUNTER_LEAVES else walk(v))
+                for k, v in node.items()
+            }
+        return node.reshape((-1, page_tokens) + node.shape[2:])
+
+    return walk(cache)
+
+
+def make_views(decode_model, max_slots: int, max_len: int):
+    """Allocate the decode working set: one RESIDENT contiguous
+    ``[1, max_len, ...]`` view per slot (stacked to
+    ``[max_slots, 1, max_len, H, Dh]`` leaves), donated in and out of
+    every decode dispatch.  A lane's view is (re)built from the pool —
+    a gather through its block table (:func:`adopt_lanes`) — only when
+    the pool holds newer bytes than the view (admission/prefill);
+    between refreshes decode advances the views in place and never
+    touches the pool, so steady-state decode pays ZERO gather/scatter
+    traffic, exactly like the slotted arena it replaced.  Counter
+    leaves are scalar placeholders as in :func:`make_pool` (distinct
+    zero buffers, so donation never sees one buffer twice); real
+    counters come from host lengths via :func:`set_counters` on every
+    dispatch."""
+    shapes = jax.eval_shape(
+        lambda: decode_model.init(
+            jax.random.key(0), jnp.zeros((1, 1), jnp.int32)
+        )
+    )["cache"]
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {
+                k: (jnp.zeros((), v.dtype) if k in COUNTER_LEAVES
+                    else walk(v))
+                for k, v in node.items()
+            }
+        return jnp.zeros(
+            (max_slots, 1, max_len) + node.shape[2:], node.dtype
+        )
+
+    return walk(shapes)
+
+
+def adopt_lanes(views, pool, tables, refresh):
+    """``views`` with every lane flagged in ``refresh`` (bool ``[S]``)
+    replaced by a fresh gather through its ``tables`` row; unflagged
+    lanes keep their resident bytes.  One batched gather + select —
+    the caller gates the whole call behind a single ``lax.cond`` on
+    ``refresh.any()`` so steady-state dispatches execute the identity
+    branch and copy nothing (a per-lane cond chain would make XLA
+    materialise the full working set once per lane, per dispatch).
+    An adopted view is byte-for-byte :func:`gather_cache`'s — the
+    cache a dedicated slot would have held — so adoption cannot move a
+    bit; it just moves the copy from every dispatch to once per
+    admission.  Counter leaves ride along unchanged (placeholders)."""
+
+    def walk(vnode, pnode):
+        if isinstance(vnode, dict):
+            return {
+                k: (vnode[k] if k in COUNTER_LEAVES
+                    else walk(vnode[k], pnode[k]))
+                for k in vnode
+            }
+        pages = jnp.take(pnode, tables, axis=0)  # [S, bps, page, H, Dh]
+        flat = pages.reshape(
+            (pages.shape[0], 1, pages.shape[1] * pages.shape[2])
+            + pages.shape[3:]
+        )
+        sel = refresh.reshape((-1,) + (1,) * (flat.ndim - 1))
+        return jnp.where(sel, flat, vnode)
+
+    return walk(views, pool)
+
+
+def placeholder_counters(views, caches):
+    """``caches``' K/V leaves under ``views``' scalar counter
+    placeholders: the decode program returns this so the donated
+    working set keeps the pool's placeholder convention (counters are
+    host truth, rebuilt from lengths every dispatch — the advanced
+    in-cache counters after a burst are deliberately dropped)."""
+
+    def walk(vnode, cnode):
+        if isinstance(vnode, dict):
+            return {
+                k: (vnode[k] if k in COUNTER_LEAVES
+                    else walk(vnode[k], cnode[k]))
+                for k in vnode
+            }
+        return cnode
+
+    return walk(views, caches)
+
+
+def scatter_pages(pool, pages, indices):
+    """Write ``pages`` (leaves ``[n, page, H, Dh]``) into the pool at
+    physical block ``indices`` (``[n]`` int32, traced ok).  Duplicate
+    indices carry identical values for any block a live table row can
+    read (module docstring), so scatter order cannot matter."""
+
+    def walk(pnode, gnode):
+        if isinstance(pnode, dict):
+            return {
+                k: (pnode[k] if k in COUNTER_LEAVES
+                    else walk(pnode[k], gnode[k]))
+                for k in pnode
+            }
+        return pnode.at[indices].set(gnode)
+
+    return walk(pool, pages)
+
+
+class BlockPool:
+    """Host-side block allocator: free list + refcounts over
+    ``num_blocks`` pool blocks, block 0 reserved as the sentinel
+    (padding rows of every block table point at it; it is never
+    allocated, so the garbage it collects is unreachable from live
+    positions).
+
+    Lowest-id-first allocation — deterministic, so a replayed request
+    sequence lands in the same blocks.  Refcounts let the radix prefix
+    cache and in-flight requests share blocks: a block returns to the
+    free list only when its last holder releases it.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (sentinel + 1), got {num_blocks}"
+            )
+        self.num_blocks = int(num_blocks)
+        self._free: list[int] = list(range(1, self.num_blocks))
+        heapq.heapify(self._free)
+        self._refs: dict[int, int] = {}  # block -> holders
+
+    def alloc(self, n: int) -> Optional[list]:
+        """Claim ``n`` blocks at refcount 1 (None = not enough free —
+        all-or-nothing, so a failed admission leaks nothing)."""
+        if n < 0:
+            raise ValueError(f"n must be >= 0, got {n}")
+        if n > len(self._free):
+            return None
+        blocks = [heapq.heappop(self._free) for _ in range(n)]
+        for b in blocks:
+            self._refs[b] = 1
+        return blocks
+
+    def retain(self, blocks) -> None:
+        """Add one holder to each of ``blocks`` (sharing a resident
+        prefix, or the prefix cache adopting a block)."""
+        for b in blocks:
+            if b not in self._refs:
+                raise KeyError(f"block {b} is not allocated")
+            self._refs[b] += 1
+
+    def release(self, blocks) -> list:
+        """Drop one holder from each of ``blocks``; returns the blocks
+        whose count hit zero (now back on the free list)."""
+        freed = []
+        for b in blocks:
+            if b not in self._refs:
+                raise KeyError(f"block {b} is not allocated")
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                heapq.heappush(self._free, b)
+                freed.append(b)
+        return freed
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(block, 0)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_blocks - 1 - len(self._free)
 
 
 class SlotManager:
-    """Host-side alloc/free bookkeeping over ``max_slots`` arena slots.
+    """Host-side alloc/free bookkeeping over ``max_slots`` decode lanes.
 
     Lowest-free-index-first allocation — deterministic, so a replayed
     request sequence lands in the same slots (useful when diffing two
@@ -129,7 +358,7 @@ class SlotManager:
     def owner(self, slot: int) -> Optional[int]:
         return self._owner.get(slot)
 
-    def active_slots(self) -> list[int]:
+    def active_slots(self) -> list:
         return sorted(self._owner)
 
     @property

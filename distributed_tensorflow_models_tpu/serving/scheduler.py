@@ -5,14 +5,21 @@ belong at TOKEN granularity, not request granularity.  Each
 :meth:`ContinuousBatchingScheduler.step`:
 
 1. **Admits** — packs waiting prompts (FIFO; no reordering, so TTFT is
-   arrival-ordered and starvation-free) into free slots, bounded by the
-   ``max_prefill_tokens`` budget: prefill compute is O(prompt), and an
-   unbounded admission burst would stall every RUNNING request's next
-   token behind it — the budget caps the per-iteration TPOT spike.  The
-   first admission of an iteration is always allowed (a single prompt
-   longer than the budget must not starve).  A request finishing AT
-   admission (EOS first token, or ``max_new_tokens == 1``) frees its
-   slot inside the same pass, so the next waiter takes it immediately.
+   arrival-ordered and starvation-free) into free slots AND free KV
+   blocks (``engine.admit`` reserves the request's whole paged
+   footprint up front, reusing any resident prefix), bounded by the
+   ``max_prefill_tokens`` budget: prefill compute is O(uncached
+   suffix), and an unbounded admission burst would stall every RUNNING
+   request's next token behind it — the budget caps the per-iteration
+   TPOT spike.  Budget accounting is CACHE-AWARE: a prompt whose prefix
+   is resident costs only its padded uncached suffix, so prefix-cache
+   hits buy real admission headroom.  The first admission of an
+   iteration is always allowed (a single prompt longer than the budget
+   must not starve).  The whole wave prefills through
+   ``engine.prefill_batch`` — ``prefill_lanes`` prompts per dispatch of
+   the one prefill program.  A request finishing AT admission (EOS
+   first token, or ``max_new_tokens == 1``) frees its slot and blocks
+   inside the same pass, so the next iteration's waiter takes them.
 2. **Decodes** — ONE batched dispatch advances every active slot
    ``engine.decode_burst`` tokens (1 by default — classic per-token
    scheduling; >1 amortizes per-dispatch host cost over the burst at
@@ -21,16 +28,21 @@ belong at TOKEN granularity, not request granularity.  Each
    (EOS or ``max_new_tokens``) inside a burst are discarded here and
    never emitted.
 3. **Retires** — sequences that emitted ``eos_id`` or reached
-   ``max_new_tokens`` free their slots; the NEXT iteration's admission
-   pass refills them mid-flight (no drain-the-batch barrier — the
-   whole point of continuous batching).
+   ``max_new_tokens`` release their slot and block references
+   (``engine.release``; pages the prefix cache adopted stay resident
+   for future admissions); the NEXT iteration's admission pass refills
+   them mid-flight (no drain-the-batch barrier — the whole point of
+   continuous batching).
 
 Telemetry (keys in ``telemetry/registry.py``): TTFT (submit → first
 token, timer), TPOT (inter-token gap after the first, timer),
 queue-depth and slot-occupancy sampled once per iteration into timers
 (so p50/p99 come from the same reservoir machinery as the latencies),
-``serve/requests`` / ``serve/tokens`` counters, plus the engine's own
-``serve/prefill`` / ``serve/decode`` device spans.  With
+``serve/requests`` / ``serve/tokens`` counters, the paged-arena gauges
+(``serve/blocks_free``, ``serve/blocks_resident``,
+``serve/block_fragmentation``) refreshed once per iteration, plus the
+engine's own ``serve/prefill`` / ``serve/decode`` device spans and
+prefix-cache hit/miss/eviction counters.  With
 ``decode_burst > 1`` a burst's tokens become host-visible together, so
 TPOT turns bimodal (≈0 intra-burst, the full dispatch gap at burst
 boundaries) — the p50/p99 spread IS the burst tradeoff; the mean stays
@@ -201,7 +213,7 @@ class ContinuousBatchingScheduler:
         ) or inflight.pos >= req.max_new_tokens
 
     def _retire(self, inflight, done: list) -> None:
-        self.engine.slots.free(inflight.slot)
+        self.engine.release(inflight.slot)
         reason = (
             "eos"
             if (
@@ -225,27 +237,42 @@ class ContinuousBatchingScheduler:
         """One scheduling iteration; returns retired :class:`Completion`s
         (possibly empty).  No-op when idle."""
         done: list = []
-        # 1. admission: pack waiters into free slots under the budget.
+        # 1. admission: pack a wave of waiters into free slots + free
+        # blocks under the cache-aware budget (cost = padded UNCACHED
+        # suffix — resident prefixes are free), then prefill the whole
+        # wave batched.  engine.admit returning None is backpressure
+        # (slots or blocks exhausted); retirement below frees both.
         spent = 0
-        while self._waiting and self.engine.slots.free_count > 0:
-            cost = self.engine.padded_len(
-                len(self._waiting[0].req.prompt)
-            )
-            if spent and spent + cost > self.max_prefill_tokens:
+        wave = []
+        while self._waiting:
+            req = self._waiting[0].req
+            cost = self.engine.peek_prefill_cost(req.prompt)
+            if wave and spent + cost > self.max_prefill_tokens:
                 break
-            inflight = self._waiting.popleft()
-            req = inflight.req
-            slot = self.engine.slots.alloc(req.request_id)
-            inflight.slot = slot
-            spent += cost
-            first = self.engine.prefill(
-                slot, req.prompt, inflight.keydata[0],
-                req.temperature, req.top_k, req.top_p,
+            admitted = self.engine.admit(
+                req.request_id, req.prompt, req.max_new_tokens
             )
-            if self._emit(inflight, first, time.perf_counter()):
-                self._retire(inflight, done)  # frees the slot in-pass
-            else:
-                self._active[slot] = inflight
+            if admitted is None:
+                break
+            slot, cached_len = admitted
+            inflight = self._waiting.popleft()
+            inflight.slot = slot
+            spent += self.engine.padded_suffix(
+                len(req.prompt), cached_len
+            )
+            wave.append(inflight)
+        if wave:
+            firsts = self.engine.prefill_batch([
+                (f.slot, f.req.prompt, f.keydata[0],
+                 f.req.temperature, f.req.top_k, f.req.top_p)
+                for f in wave
+            ])
+            now = time.perf_counter()
+            for inflight in wave:
+                if self._emit(inflight, firsts[inflight.slot], now):
+                    self._retire(inflight, done)  # frees slot + blocks
+                else:
+                    self._active[inflight.slot] = inflight
         # 2. one batched decode dispatch (decode_burst tokens) for every
         # active slot.  A lane with fewer tokens left than the burst
         # passes only its remaining key rows; it finishes mid-burst and
@@ -280,6 +307,15 @@ class ContinuousBatchingScheduler:
         )
         self.registry.timer(reglib.SERVE_SLOT_OCCUPANCY).record(
             self.engine.slots.occupancy
+        )
+        self.registry.gauge(reglib.SERVE_BLOCKS_FREE).set(
+            float(self.engine.blocks_free)
+        )
+        self.registry.gauge(reglib.SERVE_BLOCKS_RESIDENT).set(
+            float(self.engine.blocks_resident)
+        )
+        self.registry.gauge(reglib.SERVE_BLOCK_FRAGMENTATION).set(
+            self.engine.fragmentation()
         )
         return done
 

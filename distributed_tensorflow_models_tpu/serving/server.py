@@ -241,8 +241,18 @@ class LMServer:
         Touches each serving key first so the report ALWAYS carries the
         full set — an idle server reports zeros, not absences (the
         ``--serving-report`` schema contract)."""
-        for name in (reglib.SERVE_REQUESTS, reglib.SERVE_TOKENS):
+        for name in (
+            reglib.SERVE_REQUESTS, reglib.SERVE_TOKENS,
+            reglib.SERVE_PREFIX_CACHE_HITS,
+            reglib.SERVE_PREFIX_CACHE_MISSES,
+            reglib.SERVE_PREFIX_CACHE_EVICTIONS,
+        ):
             self.registry.counter(name)
+        for name in (
+            reglib.SERVE_BLOCKS_FREE, reglib.SERVE_BLOCKS_RESIDENT,
+            reglib.SERVE_BLOCK_FRAGMENTATION,
+        ):
+            self.registry.gauge(name)
         for name in (
             reglib.SERVE_TTFT, reglib.SERVE_TPOT, reglib.SERVE_PREFILL,
             reglib.SERVE_DECODE, reglib.SERVE_QUEUE_DEPTH,
@@ -256,6 +266,15 @@ class LMServer:
         ):
             (p99,) = self.registry.timer(name).percentiles(0.99)
             snap[f"{name}/p99_s"] = p99
+        # Cache effectiveness, computed (not stored): block-granular
+        # hit fraction of all matchable pages seen; 0.0 when cold/off.
+        hits = self.registry.counter(reglib.SERVE_PREFIX_CACHE_HITS).value
+        misses = self.registry.counter(
+            reglib.SERVE_PREFIX_CACHE_MISSES
+        ).value
+        snap[reglib.SERVE_PREFIX_CACHE_HIT_RATE] = (
+            hits / (hits + misses) if hits + misses > 0 else 0.0
+        )
         return {
             "version": 1,
             "process_index": self.process_index,
@@ -454,6 +473,11 @@ def _drill_engine_factory(args):
             model, params, max_slots=args.max_slots,
             prefill_chunk=args.prefill_chunk,
             decode_burst=args.decode_burst,
+            prefill_lanes=args.prefill_lanes,
+            kv_page_tokens=args.kv_page_tokens,
+            kv_pool_blocks=args.kv_pool_blocks,
+            prefix_cache=args.prefix_cache == "on",
+            prefix_cache_blocks=args.prefix_cache_blocks,
         )
 
     return build
@@ -629,6 +653,31 @@ def main(argv=None) -> int:
         help="decode tokens per device dispatch (multi-step "
         "scheduling); 1 = per-token admission, larger bursts trade "
         "admission latency for dispatch amortization",
+    )
+    p.add_argument(
+        "--prefill-lanes", type=int, default=1,
+        help="requests prefilled per dispatch of the one prefill "
+        "program (batched prefill lanes); 1 = serial prefill",
+    )
+    p.add_argument(
+        "--kv-page-tokens", type=int, default=None,
+        help="KV block size in tokens; must divide max_len (default: "
+        "gcd(max_len, prefill_chunk))",
+    )
+    p.add_argument(
+        "--kv-pool-blocks", type=int, default=None,
+        help="total pool blocks incl. sentinel (default: one max_len "
+        "reservation per slot + sentinel)",
+    )
+    p.add_argument(
+        "--prefix-cache", choices=("on", "off"), default="on",
+        help="radix prefix cache: reuse resident prompt pages across "
+        "requests without re-prefill",
+    )
+    p.add_argument(
+        "--prefix-cache-blocks", type=int, default=None,
+        help="bound on cache-resident blocks (default: unbounded; "
+        "eviction is LRU either way)",
     )
     p.add_argument("--max-prefill-tokens", type=int, default=None)
     p.add_argument("--drain-grace-s", type=float, default=30.0)

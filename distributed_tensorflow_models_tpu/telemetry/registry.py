@@ -128,6 +128,25 @@ SERVE_QUEUE_DEPTH = "serve/queue_depth"  # timer (per-iteration sample)
 SERVE_SLOT_OCCUPANCY = "serve/slot_occupancy"  # timer (fraction, 0-1)
 SERVE_REQUESTS = "serve/requests"  # counter
 SERVE_TOKENS = "serve/tokens"  # counter
+# Paged KV arena + radix prefix cache (PR 12).  Hits/misses count
+# BLOCKS (pages), not requests: one admission sharing a 4-page system
+# prompt is 4 hits.  Evictions count cache references dropped by LRU
+# pressure (the block itself may outlive the eviction if an in-flight
+# request still gathers it).  The gauges are per-iteration snapshots
+# recorded by the scheduler: blocks_free is pool headroom (admission
+# backpressure when it can't cover a request's reservation),
+# blocks_resident is what the prefix cache holds matchable, and
+# block_fragmentation is the fraction of block-granular capacity
+# reserved by in-flight requests that holds no live token yet (high =>
+# kv_page_tokens too coarse for the traffic).  hit_rate is computed by
+# the server report from the two counters, not stored.
+SERVE_PREFIX_CACHE_HITS = "serve/prefix_cache_hits"  # counter (blocks)
+SERVE_PREFIX_CACHE_MISSES = "serve/prefix_cache_misses"  # counter (blocks)
+SERVE_PREFIX_CACHE_EVICTIONS = "serve/prefix_cache_evictions"  # counter
+SERVE_PREFIX_CACHE_HIT_RATE = "serve/prefix_cache_hit_rate"  # report-only
+SERVE_BLOCKS_FREE = "serve/blocks_free"  # gauge
+SERVE_BLOCKS_RESIDENT = "serve/blocks_resident"  # gauge
+SERVE_BLOCK_FRAGMENTATION = "serve/block_fragmentation"  # gauge (0-1)
 
 
 class Counter:

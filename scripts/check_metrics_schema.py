@@ -261,10 +261,20 @@ def check_lines(
 # --------------------------------------------------------------------------
 
 SERVING_REQUIRED = ("version", "process_index", "draining", "metrics")
-SERVING_COUNTERS = ("serve/requests", "serve/tokens")
+SERVING_COUNTERS = (
+    "serve/requests", "serve/tokens",
+    "serve/prefix_cache_hits", "serve/prefix_cache_misses",
+    "serve/prefix_cache_evictions",
+)
 SERVING_TIMERS = (
     "serve/ttft_s", "serve/tpot_s", "serve/prefill", "serve/decode",
     "serve/queue_depth", "serve/slot_occupancy",
+)
+# Paged-arena gauges + the computed cache-effectiveness key; flat
+# values in the snapshot, exactly like counters.
+SERVING_GAUGES = (
+    "serve/blocks_free", "serve/blocks_resident",
+    "serve/block_fragmentation", "serve/prefix_cache_hit_rate",
 )
 # Tail-latency expansions the server adds on top of snapshot()'s
 # p50/p95 — the serving SLO surface.
@@ -308,6 +318,9 @@ def check_serving_report(report) -> list[str]:
     for key in SERVING_COUNTERS:
         if key not in snap:
             errors.append(f"serving counter {key!r} missing")
+    for key in SERVING_GAUGES:
+        if key not in snap:
+            errors.append(f"serving gauge {key!r} missing")
     for key in SERVING_TIMERS:
         if f"{key}/count" not in snap:
             errors.append(f"serving timer {key!r} missing (no /count)")

@@ -1,0 +1,32 @@
+"""Known-bad: paged-arena hazards — a traced length used as a gather
+view's SHAPE (recompile per length), and the donated pool read/reused
+without the same-statement rebind the paged protocol requires.
+
+No module-level jax import on purpose (fixtures are linted as jax-free
+roots in strict mode); nothing here is ever executed.
+"""
+
+
+def gather_view(pool, table, length):
+    pages = pool[table]
+    view = pages.reshape(1, length, 4)
+    return view
+
+
+class PagedEngine:
+    def __init__(self, fn):
+        self._prefill = jax.jit(fn, donate_argnums=(1,))
+
+    def run(self, params, pool, tables):
+        out = self._prefill(params, pool, tables)
+        stale = pool.sum()
+        return out, stale
+
+    def waves(self, params, pool, waves):
+        out = None
+        for wave in waves:
+            out = self._prefill(params, pool, wave)
+        return out
+
+
+gather_j = jax.jit(gather_view)

@@ -1,0 +1,35 @@
+"""Known-good twins: the paged-arena protocol done right — gather
+through the block table with shapes derived only from static leaf
+dims, and the pool + sampled-tokens rebind in ONE statement at every
+donating dispatch."""
+
+
+def gather_view(pool, table, length):
+    pages = pool[table]  # dynamic *index* is a gather, not a shape
+    bps = pages.shape[0]
+    width = pages.shape[1]
+    view = pages.reshape(1, bps * width, 4)
+    live = jnp.where(length > 0, 1.0, 0.0)  # traced length: data, not shape
+    return view * live
+
+
+class PagedEngine:
+    def __init__(self, fn, make_pool):
+        self._prefill = jax.jit(fn, donate_argnums=(1,))
+        self.pool = make_pool()
+
+    def step(self, params, tables, toks):
+        # Rebinding the donated pool and the sampled tokens in the same
+        # statement is the sanctioned paged protocol: every later read
+        # sees the fresh buffer, never the donated one.
+        self.pool, out = self._prefill(params, self.pool, tables, toks)
+        return out
+
+    def waves(self, params, waves):
+        out = None
+        for wave in waves:
+            self.pool, out = self._prefill(params, self.pool, wave, None)
+        return out
+
+
+gather_j = jax.jit(gather_view)

@@ -63,6 +63,9 @@ BAD_EXPECT = {
                          ("recompile-hazard", 23)},
     "bad_donation.py": {("donation-safety", 10),
                         ("donation-safety", 16)},
+    "bad_paged_arena.py": {("recompile-hazard", 12),
+                           ("donation-safety", 22),
+                           ("donation-safety", 28)},
     "bad_lockdisc.py": {("lock-discipline", 13),
                         ("lock-discipline", 20),
                         ("lock-discipline", 24)},
@@ -77,6 +80,7 @@ GOOD_FILES = [
     "good_recompile.py",
     "good_donation.py",
     "good_lockdisc.py",
+    "good_paged_arena.py",
 ]
 
 

@@ -29,7 +29,14 @@ import pytest
 from distributed_tensorflow_models_tpu.harness.generate import generate
 from distributed_tensorflow_models_tpu.models import get_model
 from distributed_tensorflow_models_tpu.serving.engine import InferenceEngine
-from distributed_tensorflow_models_tpu.serving.kv_slots import SlotManager
+from distributed_tensorflow_models_tpu.serving.kv_slots import (
+    BlockPool,
+    SlotManager,
+)
+from distributed_tensorflow_models_tpu.serving.prefix_cache import (
+    RadixPrefixCache,
+    prompt_pages,
+)
 from distributed_tensorflow_models_tpu.serving.scheduler import (
     ContinuousBatchingScheduler,
     Request,
@@ -332,6 +339,235 @@ def test_serving_telemetry_surface(engine):
         assert snap[f"{key}/count"] > 0, key
     # Occupancy is a fraction.
     assert 0.0 <= snap[f"{reglib.SERVE_SLOT_OCCUPANCY}/max_s"] <= 1.0
+
+
+# -- paged KV arena + radix prefix cache ------------------------------------
+
+
+def test_block_pool_and_prefix_cache_lifecycle():
+    """Host-side refcount/eviction lifecycle: request references and
+    cache references compose; a block returns to the free list only
+    when its LAST holder lets go; eviction is LRU over trie leaves and
+    never frees a block an in-flight request still gathers."""
+    pool = BlockPool(6)  # sentinel + blocks 1..5
+    assert pool.free_count == 5 and pool.used_count == 0
+    blocks = pool.alloc(2)
+    assert blocks == [1, 2]  # lowest-id-first, deterministic
+    assert pool.refcount(1) == 1
+
+    cache = RadixPrefixCache(pool, page_tokens=2)
+    pages = [(0, 1), (2, 3)]
+    assert cache.insert(pages, blocks) == 2  # both adopted
+    assert pool.refcount(1) == 2 and cache.resident_count == 2
+    assert pool.release(blocks) == []  # request retires; cache holds on
+    assert pool.free_count == 3 and pool.refcount(2) == 1
+
+    # Match bumps LRU and counts block-granular hits/misses; peek does
+    # neither.  Dedup: re-inserting an existing path adopts nothing.
+    assert cache.peek(pages + [(9, 9)]) == 2
+    assert cache.match(pages + [(9, 9)]) == [1, 2]
+    assert (cache.hits, cache.misses) == (2, 1)
+    assert cache.insert(pages, blocks) == 0
+
+    # Exhaust the pool, then evict: the LRU *leaf* goes first (interior
+    # nodes are their children's prefix), its block actually freed.
+    assert pool.alloc(3) == [3, 4, 5]
+    assert pool.alloc(1) is None and pool.free_count == 0
+    assert cache.evict(want_freed=1) == 1
+    assert cache.evictions == 1 and cache.resident_count == 1
+    assert pool.free_count == 1
+    assert cache.match(pages) == [1]  # deep page no longer matchable
+
+    # An evicted-but-still-held block frees nothing NOW (the request's
+    # reference outlives the cache's) — it counts as an eviction only.
+    pool.retain([1])  # a request still gathering block 1
+    assert cache.evict(want_freed=1) == 0
+    assert cache.evictions == 2 and cache.resident_count == 0
+    assert pool.refcount(1) == 1  # request ref survives
+    assert pool.release([1]) == [1]  # … until retirement frees it
+
+    with pytest.raises(KeyError):
+        pool.release([1])  # double free
+    with pytest.raises(ValueError):
+        BlockPool(1)  # no room for sentinel + data
+    with pytest.raises(ValueError):
+        RadixPrefixCache(pool, 2, max_blocks=0)
+    with pytest.raises(ValueError):
+        cache.insert(pages, [2])  # fewer blocks than pages
+    assert prompt_pages([1, 2, 3, 4, 5], 2) == [(1, 2), (3, 4)]
+
+
+# Shared 16-token prefix: a whole number of pages at every page size
+# below, so the radix cache can share it in all three geometries.
+_SHARED_PLEN, _TAIL, _MAXNEW = 16, 4, 6
+
+
+@pytest.mark.parametrize("page", [1, 4, 16])
+def test_paged_identity_cold_warm_and_cow(small_lm, page):
+    """The tentpole contract at page sizes {1, 4, 16}: cold admission,
+    warm re-admission (prefix resident, uncached suffix only), and two
+    concurrent sharers whose divergent tails copy-on-write into private
+    blocks — every stream byte-identical to solo ``generate()``, cache
+    warmth included, under batched 2-lane prefill, with exactly the two
+    compiled programs."""
+    model, params = small_lm
+    eng = InferenceEngine(
+        model, params, max_slots=2, prefill_chunk=8, prefill_lanes=2,
+        kv_page_tokens=page, registry=reglib.MetricsRegistry(),
+    )
+    sched = ContinuousBatchingScheduler(
+        eng, max_prefill_tokens=64, registry=eng.registry
+    )
+    rng0 = jax.random.key(11)
+    base = np.asarray(
+        jax.random.randint(
+            jax.random.fold_in(rng0, 500), (_SHARED_PLEN,), 0, 50
+        ),
+        np.int32,
+    )
+    tail_a = np.asarray(
+        jax.random.randint(jax.random.fold_in(rng0, 501), (_TAIL,), 0, 50),
+        np.int32,
+    )
+    tail_b = np.asarray(
+        jax.random.randint(jax.random.fold_in(rng0, 502), (_TAIL,), 0, 50),
+        np.int32,
+    )
+    prompt_a = np.concatenate([base, tail_a])
+    prompt_b = np.concatenate([base, tail_b])
+    rng_a = jax.random.fold_in(rng0, 1)
+
+    def solo(prompt, t, k, p, rng):
+        out = generate(
+            model, params, jnp.asarray(prompt)[None], _MAXNEW,
+            temperature=t, top_k=k, top_p=p, rng=rng,
+        )
+        return np.asarray(out)[0, len(prompt):].tolist()
+
+    solo_a = solo(prompt_a, 0.8, 5, 1.0, rng_a)
+    solo_b = solo(prompt_b, 0.0, 0, 1.0, None)
+
+    # Round 1 — cold: nothing resident, every matchable page misses.
+    sched.submit(
+        Request(0, prompt_a, _MAXNEW, temperature=0.8, top_k=5, rng=rng_a)
+    )
+    comps = {c.request_id: c for c in sched.run_until_idle()}
+    hits = eng.registry.counter(reglib.SERVE_PREFIX_CACHE_HITS).value
+    assert hits == 0
+    assert comps[0].tokens == solo_a, f"page={page}: cold stream diverged"
+
+    # Round 2 — warm + COW: A again (full shareable prefix resident)
+    # CONCURRENTLY with B (shares only `base`, diverges after it).  Both
+    # admitted in one wave, prefilled in one 2-lane dispatch, decoding
+    # side by side through shared resident blocks.
+    sched.submit(
+        Request(1, prompt_a, _MAXNEW, temperature=0.8, top_k=5, rng=rng_a)
+    )
+    sched.submit(Request(2, prompt_b, _MAXNEW))
+    comps = {c.request_id: c for c in sched.run_until_idle()}
+    hits = eng.registry.counter(reglib.SERVE_PREFIX_CACHE_HITS).value
+    assert hits >= _SHARED_PLEN // page  # base reused at least once
+    assert comps[1].tokens == solo_a, (
+        f"page={page}: warm stream diverged from cold/solo"
+    )
+    assert comps[2].tokens == solo_b, (
+        f"page={page}: shared-tail COW stream diverged"
+    )
+
+    # Round 3 — A once more: B's divergent tail and both decodes must
+    # not have perturbed the resident prefix by a single bit.
+    sched.submit(
+        Request(3, prompt_a, _MAXNEW, temperature=0.8, top_k=5, rng=rng_a)
+    )
+    comps = {c.request_id: c for c in sched.run_until_idle()}
+    assert comps[3].tokens == solo_a, (
+        f"page={page}: resident prefix corrupted by sharer"
+    )
+
+    # Paging + caching + batched lanes added zero compiled programs,
+    # and retirement released every non-resident block.
+    assert eng.compile_counts() == (1, 1)
+    assert eng.slots.active_count == 0
+    assert eng.blocks.used_count == eng.blocks_resident
+
+
+def test_arena_exhaustion_admission_backpressure(small_lm):
+    """Blocks are a first-class admission resource: with slots to spare
+    but a pool sized for two reservations, the third waiter is held
+    back (no preemption, nothing wedged) and admitted as soon as a
+    retirement frees its blocks — streams unaffected."""
+    model, params = small_lm
+    eng = InferenceEngine(
+        model, params, max_slots=4, prefill_chunk=8,
+        kv_page_tokens=8, kv_pool_blocks=9,  # sentinel + 8 data blocks
+        prefix_cache=False, registry=reglib.MetricsRegistry(),
+    )
+    sched = ContinuousBatchingScheduler(
+        eng, max_prefill_tokens=64, registry=eng.registry
+    )
+    prompts = [
+        np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(jax.random.key(3), i), (8,), 0, 50
+            ),
+            np.int32,
+        )
+        for i in range(4)
+    ]
+    # 8 prompt + 16 new = 3 pages each; 8 data blocks fit only two.
+    for i, p in enumerate(prompts):
+        sched.submit(Request(i, p, 16))
+    sched.step()
+    assert sched.active_count == 2 and sched.waiting_count == 2
+    assert eng.slots.free_count == 2  # slots were NOT the constraint
+    assert eng.blocks_free == 2  # 8 - 2*3: too few for a third
+    comps = {c.request_id: c for c in sched.run_until_idle()}
+    assert sorted(comps) == [0, 1, 2, 3]
+    for i, p in enumerate(prompts):
+        out = generate(model, params, jnp.asarray(p)[None], 16)
+        assert comps[i].tokens == np.asarray(out)[0, len(p):].tolist()
+    assert eng.blocks_free == 8 and eng.slots.active_count == 0
+    assert eng.compile_counts() == (1, 1)
+
+
+def test_prefix_cache_eviction_under_block_bound(small_lm):
+    """``prefix_cache_blocks`` bounds residency: inserting past it
+    evicts LRU entries (counted), an evicted prefix readmits cold, and
+    the recycled blocks still serve byte-identical streams."""
+    model, params = small_lm
+    eng = InferenceEngine(
+        model, params, max_slots=2, prefill_chunk=4, kv_page_tokens=4,
+        prefix_cache_blocks=2, registry=reglib.MetricsRegistry(),
+    )
+    sched = ContinuousBatchingScheduler(eng, registry=eng.registry)
+    p1 = np.asarray(
+        jax.random.randint(jax.random.fold_in(jax.random.key(5), 0),
+                           (12,), 0, 50),
+        np.int32,
+    )
+    p2 = np.asarray(
+        jax.random.randint(jax.random.fold_in(jax.random.key(5), 1),
+                           (12,), 0, 50),
+        np.int32,
+    )
+    solo1 = np.asarray(
+        generate(model, params, jnp.asarray(p1)[None], 4)
+    )[0, len(p1):].tolist()
+
+    sched.submit(Request(0, p1, 4))  # inserts p1's 2 shareable pages
+    first = sched.run_until_idle()[0].tokens
+    assert first == solo1
+    assert eng.blocks_resident == 2
+    sched.submit(Request(1, p2, 4))  # insert evicts p1 (LRU, bound 2)
+    sched.run_until_idle()
+    assert eng.blocks_resident <= 2
+    evictions = eng.registry.counter(
+        reglib.SERVE_PREFIX_CACHE_EVICTIONS
+    ).value
+    assert evictions >= 2
+    sched.submit(Request(2, p1, 4))  # readmits cold, same bytes
+    assert sched.run_until_idle()[0].tokens == solo1
+    assert eng.compile_counts() == (1, 1)
 
 
 # -- server front half -----------------------------------------------------
