@@ -13,9 +13,16 @@ files are parsed with :mod:`ast`, so fixtures containing deliberate
 deadlock shapes or forbidden imports are safe to check.
 """
 
+from analysis.dtmlint.cache import (  # noqa: F401
+    CACHE_DIR,
+    CacheStats,
+    cache_path,
+    run_cached,
+)
 from analysis.dtmlint.core import (  # noqa: F401
     BASELINE_VERSION,
     Finding,
+    JSON_SCHEMA_VERSION,
     LintConfig,
     LintError,
     LintResult,

@@ -1,0 +1,462 @@
+"""Incremental result cache: full-tree lint cost scales with the diff.
+
+dtm-lint is on the pre-drill path of ``fleet_drill``/``serve_drill``
+and in the tier-1 gate, so whole-tree latency is paid many times a day
+on trees that barely changed between runs.  The cache makes the common
+case — nothing changed, or one file changed — cost hashing plus the
+work actually implied by the diff:
+
+- **fast path** (every hash matches): nothing is parsed; the stored
+  findings replay and only the baseline/restrict filters run.
+- **slow path**: the tree is parsed once (texts were already read for
+  hashing), but the scoped rules re-analyze only *dirty* files — files
+  whose content hash changed plus every file whose stored dependency
+  closure reaches a changed file.  Clean files' findings replay from
+  their cache entries.
+
+Keying is by **content hash** (sha256), never mtime — an editor that
+rewrites a file without bumping mtime still invalidates.  Entries are
+guarded by three fingerprints, any mismatch discarding the whole cache:
+
+- the **engine fingerprint** — a hash over every ``analysis/dtmlint``
+  source of the *running* checker, so editing any rule (or this file)
+  re-analyzes the world; a cache written by an older engine version is
+  never trusted;
+- the **config fingerprint** — the serialized :class:`LintConfig`
+  minus ``root``; it contains the file list, so adding/removing a file
+  (which shifts module resolution project-wide) is a global event;
+- the **cache schema** version.
+
+Per-file dependencies are the file's resolved imports and resolved
+call targets (plus the configured metric registry and mesh-axis
+module), stored as direct edges and closed transitively at load time.
+Two deliberately global escape hatches keep the merge exact:
+
+- **global rules** (:data:`GLOBAL_RULES`) — jax-free-zone walks import
+  reachability *into* a file and recompile-hazard anchors findings in
+  the jitted function's file, so file A's findings can change when
+  only file B does.  They re-run on the full tree every slow path and
+  their findings live in one global bucket (replayed only on the fast
+  path).
+- **symbol-set invalidation** — attribute calls resolve by
+  project-unique method name, so *adding* ``def frobnicate`` anywhere
+  can re-bind a call in an untouched file.  Each entry stores the
+  file's defined function/method names; a changed file whose name set
+  changed discards the whole cache.
+
+Files whose suppressions could silence a global rule (or use
+``disable=all``) are marked ``force_fresh`` and re-analyzed every slow
+path, so their unused-suppression findings never go stale.
+
+The cache lives at ``.dtmlint_cache/cache.json`` under the lint root
+(gitignored) and is only consulted for full-tree default-rule runs —
+``--only``/``--disable``/explicit paths bypass it, ``--changed-only``
+composes with it (restriction applies after the merge).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Iterable, Optional, Sequence
+
+from analysis.dtmlint.core import (
+    Finding,
+    LintConfig,
+    LintResult,
+    Project,
+    apply_baseline,
+    run,
+)
+
+CACHE_DIR = ".dtmlint_cache"
+CACHE_FILE = "cache.json"
+CACHE_SCHEMA = 1
+
+# Rules whose findings in file A can change when only file B does
+# (reverse-direction interprocedural reach) — always re-run on the full
+# tree, cached only as one global bucket for the fast path.
+GLOBAL_RULES = frozenset({"jax-free-zone", "recompile-hazard"})
+
+
+@dataclasses.dataclass
+class CacheStats:
+    """What the cache did this run — surfaced by ``--stats``."""
+
+    enabled: bool
+    fast_path: bool = False
+    cold: bool = False  # no usable cache: everything analyzed
+    total_files: int = 0
+    analyzed: list = dataclasses.field(default_factory=list)  # rel paths
+    reused: int = 0
+    hash_s: float = 0.0
+    total_s: float = 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "cache": (
+                "disabled" if not self.enabled
+                else "cold" if self.cold
+                else "warm"
+            ),
+            "fast_path": self.fast_path,
+            "files": self.total_files,
+            "analyzed": len(self.analyzed),
+            "analyzed_files": sorted(self.analyzed),
+            "reused": self.reused,
+            "hash_s": round(self.hash_s, 6),
+            "total_s": round(self.total_s, 6),
+        }
+
+    def render(self) -> str:
+        mode = (
+            "disabled" if not self.enabled
+            else "cold" if self.cold
+            else "fast-path" if self.fast_path
+            else "warm"
+        )
+        return (
+            f"dtm-lint stats: cache={mode} files={self.total_files} "
+            f"analyzed={len(self.analyzed)} reused={self.reused} "
+            f"total={self.total_s:.3f}s"
+        )
+
+
+def _sha(data: str) -> str:
+    return hashlib.sha256(data.encode("utf-8", "surrogatepass")).hexdigest()
+
+
+def engine_fingerprint() -> str:
+    """Hash of every source file of the *running* checker, so any rule
+    edit (or a checkout of a different engine version) discards the
+    cache wholesale."""
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    h.update(f"schema={CACHE_SCHEMA};".encode())
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg_dir)
+            h.update(rel.encode())
+            try:
+                with open(path, "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(b"<unreadable>")
+    return h.hexdigest()
+
+
+def config_fingerprint(config: LintConfig) -> str:
+    d = dataclasses.asdict(config)
+    d.pop("root", None)  # same tree at a different mount point is fine
+    return _sha(json.dumps(d, sort_keys=True, default=list))
+
+
+def cache_path(root: str) -> str:
+    return os.path.join(root, CACHE_DIR, CACHE_FILE)
+
+
+def _load(root: str) -> Optional[dict]:
+    try:
+        with open(cache_path(root), encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def _store(root: str, data: dict) -> None:
+    """Atomic write; a cache that cannot be written is silently not a
+    cache (the run's correctness never depends on persisting it)."""
+    path = cache_path(root)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _finding_to_json(f: Finding) -> list:
+    return [f.path, f.line, f.rule, f.message]
+
+
+def _finding_from_json(row) -> Finding:
+    return Finding(str(row[0]), int(row[1]), str(row[2]), str(row[3]))
+
+
+def _symbols(idx) -> list:
+    """Defined function/method names — the inputs to project-unique
+    attribute-call resolution."""
+    names = set(idx.functions)
+    for methods in idx.classes.values():
+        names.update(methods)
+    return sorted(names)
+
+
+def _force_fresh(sf) -> bool:
+    """Suppressions that could silence a global rule (or anything, via
+    ``disable=all``) must be re-checked for usedness every slow path."""
+    hot = GLOBAL_RULES | {"all", "*"}
+    return any(sup.rules & hot for sup in sf.suppressions)
+
+
+def _direct_deps(cg, sf, config: LintConfig) -> list:
+    """Direct file-level dependencies: resolved imports + resolved call
+    targets + the configured cross-file knowledge modules."""
+    from analysis.dtmlint.callgraph import Ctx, iter_functions
+
+    import ast as _ast
+
+    project = cg.project
+    idx = cg.by_rel.get(sf.rel)
+    deps: set = set()
+    if idx is not None:
+        for mod in idx.import_modules.values():
+            rel = project.resolve_module(mod)
+            if rel:
+                deps.add(rel)
+        for mod, attr in idx.from_imports.values():
+            for dotted in (mod, f"{mod}.{attr}"):
+                rel = project.resolve_module(dotted)
+                if rel:
+                    deps.add(rel)
+        for fi, ctx in iter_functions(sf):
+            fctx = Ctx(
+                rel=ctx.rel, cls=ctx.cls,
+                func_stack=ctx.func_stack + (fi.node,),
+            )
+            for node in _ast.walk(fi.node):
+                if isinstance(node, _ast.Call):
+                    target = cg.resolve(node, fctx)
+                    if target is not None:
+                        deps.add(target.rel)
+        mod_ctx = Ctx(rel=sf.rel)
+        for stmt in sf.tree.body:
+            for node in _ast.walk(stmt):
+                if isinstance(node, _ast.Call):
+                    target = cg.resolve(node, mod_ctx)
+                    if target is not None:
+                        deps.add(target.rel)
+    if config.metric_registry:
+        deps.add(config.metric_registry)
+    if config.mesh_axis_module:
+        deps.add(config.mesh_axis_module)
+    deps.discard(sf.rel)
+    return sorted(deps)
+
+
+def _dirty_closure(changed: set, entries: dict, files) -> set:
+    """Changed files plus every file whose stored dependency chain
+    reaches one (clean files' stored deps are still valid: their own
+    content is unchanged and resolution shifts are global events)."""
+    rdeps: dict = {}
+    for rel in files:
+        e = entries.get(rel)
+        for dep in (e or {}).get("deps", []):
+            rdeps.setdefault(dep, set()).add(rel)
+    dirty = set(changed)
+    stack = list(changed)
+    while stack:
+        cur = stack.pop()
+        for dependent in rdeps.get(cur, ()):
+            if dependent not in dirty:
+                dirty.add(dependent)
+                stack.append(dependent)
+    return dirty
+
+
+def _finalize(
+    kept: Sequence[Finding],
+    enabled,
+    baseline: Optional[Sequence[Finding]],
+    restrict_paths: Optional[Iterable[str]],
+    timings: dict,
+) -> LintResult:
+    """The tail of :func:`analysis.dtmlint.core.run`: restrict, then
+    baseline-split, over an already-merged finding list."""
+    kept = list(kept)
+    base = list(baseline or [])
+    if restrict_paths is not None:
+        restrict = set(restrict_paths)
+        kept = [f for f in kept if f.path in restrict]
+        base = [b for b in base if b.path in restrict]
+    new, old, stale = apply_baseline(kept, base)
+    return LintResult(
+        new=sorted(new),
+        baselined=sorted(old),
+        stale_baseline=sorted(stale),
+        enabled=tuple(sorted(enabled)),
+        timings=dict(timings),
+    )
+
+
+def run_cached(
+    config: LintConfig,
+    *,
+    baseline: Optional[Sequence[Finding]] = None,
+    restrict_paths: Optional[Iterable[str]] = None,
+    use_cache: bool = True,
+) -> tuple:
+    """Full-tree default-rule lint through the cache.
+
+    Returns ``(LintResult, CacheStats)``.  Must only be called for the
+    full default rule set — ``--only``/``--disable`` runs change what a
+    stored finding list means and bypass this layer entirely.
+    """
+    t_start = time.perf_counter()
+    stats = CacheStats(enabled=use_cache, total_files=len(config.files))
+    if not use_cache:
+        result = run(
+            config, baseline=baseline, restrict_paths=restrict_paths
+        )
+        stats.analyzed = list(config.files)
+        stats.total_s = time.perf_counter() - t_start
+        return result, stats
+
+    # -- hash the tree (this is also the only read of clean files) ----
+    t0 = time.perf_counter()
+    texts: dict = {}
+    hashes: dict = {}
+    for rel in config.files:
+        try:
+            with open(
+                os.path.join(config.root, rel), encoding="utf-8"
+            ) as f:
+                text = f.read()
+            texts[rel] = text
+            hashes[rel] = _sha(text)
+        except (OSError, ValueError):
+            hashes[rel] = "<unreadable>"  # never matches: always dirty
+    stats.hash_s = time.perf_counter() - t0
+
+    engine = engine_fingerprint()
+    cfg_fp = config_fingerprint(config)
+    data = _load(config.root)
+    valid = bool(
+        data
+        and data.get("schema") == CACHE_SCHEMA
+        and data.get("engine") == engine
+        and data.get("config") == cfg_fp
+        and isinstance(data.get("files"), dict)
+    )
+    entries = data["files"] if valid else {}
+
+    # -- fast path: nothing changed, nothing parsed --------------------
+    if valid and all(
+        rel in entries and entries[rel].get("hash") == hashes[rel]
+        for rel in config.files
+    ):
+        kept = [
+            _finding_from_json(row)
+            for rel in config.files
+            for row in entries[rel].get("findings", [])
+        ] + [_finding_from_json(row) for row in data.get("global", [])]
+        stats.fast_path = True
+        stats.reused = len(config.files)
+        result = _finalize(
+            kept, data.get("enabled", ()), baseline, restrict_paths, {}
+        )
+        stats.total_s = time.perf_counter() - t_start
+        return result, stats
+
+    # -- slow path ------------------------------------------------------
+    changed = {
+        rel
+        for rel in config.files
+        if not valid
+        or rel not in entries
+        or entries[rel].get("hash") != hashes[rel]
+    }
+    project = Project(config, texts=texts)
+    from analysis.dtmlint.callgraph import CallGraph
+
+    cg = CallGraph.of(project)
+    symbols = {
+        sf.rel: _symbols(cg.by_rel[sf.rel]) for sf in project.files
+    }
+    if valid:
+        for rel in sorted(changed):
+            old = entries.get(rel)
+            if old is not None and old.get("symbols") != symbols.get(
+                rel, []
+            ):
+                # Defined-name set changed: project-unique attribute
+                # resolution may re-bind calls in untouched files.
+                valid = False
+                break
+    if not valid:
+        entries = {}
+        changed = set(config.files)
+        stats.cold = True
+    dirty = _dirty_closure(changed, entries, config.files)
+    for rel, e in entries.items():
+        if e.get("force_fresh") and rel in hashes:
+            dirty.add(rel)
+    for sf in project.files:  # new force-fresh files are changed anyway
+        if sf.rel in dirty or _force_fresh(sf):
+            dirty.add(sf.rel)
+
+    res = run(config, scope=dirty, project=project)
+    fresh = res.new  # kept findings: no baseline/restrict applied yet
+
+    merged = list(fresh)
+    for rel, e in entries.items():
+        if rel in dirty or rel not in hashes:
+            continue
+        merged.extend(
+            _finding_from_json(row) for row in e.get("findings", [])
+        )
+    stats.analyzed = sorted(dirty)
+    stats.reused = len(config.files) - len(dirty)
+
+    # -- update the store ----------------------------------------------
+    by_path: dict = {}
+    for f in fresh:
+        if f.rule not in GLOBAL_RULES:
+            by_path.setdefault(f.path, []).append(f)
+    new_entries = {
+        rel: e for rel, e in entries.items() if rel in hashes
+    }
+    for rel in sorted(dirty):
+        sf = project.by_rel.get(rel)
+        new_entries[rel] = {
+            "hash": hashes[rel],
+            "deps": (
+                _direct_deps(cg, sf, config) if sf is not None else []
+            ),
+            "symbols": symbols.get(rel, []),
+            "force_fresh": bool(sf is not None and _force_fresh(sf)),
+            "findings": [
+                _finding_to_json(f)
+                for f in sorted(by_path.get(rel, []))
+            ],
+        }
+    _store(
+        config.root,
+        {
+            "schema": CACHE_SCHEMA,
+            "engine": engine,
+            "config": cfg_fp,
+            "enabled": sorted(res.enabled),
+            "global": [
+                _finding_to_json(f)
+                for f in sorted(f for f in fresh if f.rule in GLOBAL_RULES)
+            ],
+            "files": new_entries,
+        },
+    )
+
+    result = _finalize(
+        merged, res.enabled, baseline, restrict_paths, res.timings
+    )
+    stats.total_s = time.perf_counter() - t_start
+    return result, stats

@@ -127,6 +127,7 @@ class Summary:
     blocking: list  # [BlockEvent]
     acquires: list  # [(receiver dotted, lineno)]
     self_reads: frozenset  # attrs read via self.<attr> (Load context)
+    self_writes: frozenset  # attrs stored/deleted via self.<attr>
     calls: list  # [(FuncInfo, ast.Call)] resolved project calls
 
 
@@ -393,7 +394,7 @@ class CallGraph:
             ) + (fi.node,),
         )
         collectives, blocking, acquires, calls = [], [], [], []
-        reads = set()
+        reads, writes = set(), set()
         for node in walk_in_scope(fi.node):
             if isinstance(node, ast.Call):
                 nm = call_name(node)
@@ -412,18 +413,18 @@ class CallGraph:
                 if target is not None and target != fi:
                     calls.append((target, node))
             elif isinstance(node, ast.Attribute) and isinstance(
-                node.ctx, ast.Load
-            ):
-                if (
-                    isinstance(node.value, ast.Name)
-                    and node.value.id == "self"
-                ):
+                node.value, ast.Name
+            ) and node.value.id == "self":
+                if isinstance(node.ctx, ast.Load):
                     reads.add(node.attr)
+                else:  # Store (assign / augassign target) or Del
+                    writes.add(node.attr)
         out = Summary(
             collectives=collectives,
             blocking=blocking,
             acquires=acquires,
             self_reads=frozenset(reads),
+            self_writes=frozenset(writes),
             calls=calls,
         )
         self._summaries[fi] = out

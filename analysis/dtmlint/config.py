@@ -45,6 +45,10 @@ DETERMINISM_SCOPE = (
 
 METRIC_REGISTRY = f"{PACKAGE}/telemetry/registry.py"
 
+# Source of truth for mesh axis names (AxisNames) — the collective-order
+# rule checks hard-coded ``axis_name=`` literals against it.
+MESH_AXIS_MODULE = f"{PACKAGE}/core/mesh.py"
+
 DEFAULT_BASELINE = "analysis/baseline.json"
 
 _LINT_DIRS = (PACKAGE, "scripts", "analysis")
@@ -78,6 +82,7 @@ def repo_config(root: str) -> LintConfig:
         jax_free_roots=tuple(jax_free),
         determinism_scope=DETERMINISM_SCOPE,
         metric_registry=METRIC_REGISTRY,
+        mesh_axis_module=MESH_AXIS_MODULE,
         module_namespaces=("",),
     )
 

@@ -133,21 +133,43 @@ class LintConfig:
     forbidden_imports: tuple = ("jax", "jaxlib", "flax", "orbax")
     determinism_scope: tuple = ()  # rel paths under determinism-hazard
     metric_registry: Optional[str] = None  # rel path of key-constant module
+    mesh_axis_module: Optional[str] = None  # rel path declaring mesh axes
     module_namespaces: tuple = ("",)
 
 
 class Project:
-    """Parsed view of the configured tree, shared by every rule."""
+    """Parsed view of the configured tree, shared by every rule.
 
-    def __init__(self, config: LintConfig):
+    ``texts`` optionally preloads file contents (``{rel: text}``) so a
+    caller that already read the tree — the incremental cache hashes
+    every file before deciding what to re-analyze — doesn't pay a second
+    round of I/O; files absent from the mapping fall back to disk.
+
+    ``analysis_scope`` (set by the cache layer, None = everything)
+    names the files whose findings must be recomputed this run.  Rules
+    iterate :attr:`scoped_files` and so skip clean files, whose findings
+    replay from the cache — except the *global* rules (see
+    :mod:`analysis.dtmlint.cache`), whose findings in file A can change
+    when only file B does; those keep iterating :attr:`files`.
+    """
+
+    def __init__(
+        self,
+        config: LintConfig,
+        texts: Optional[dict] = None,
+    ):
         self.config = config
         self.files: list[SourceFile] = []
         self.parse_failures: list[Finding] = []
+        self.analysis_scope: Optional[set] = None
         for rel in config.files:
             abspath = os.path.join(config.root, rel)
             try:
-                with open(abspath, encoding="utf-8") as f:
-                    text = f.read()
+                if texts is not None and rel in texts:
+                    text = texts[rel]
+                else:
+                    with open(abspath, encoding="utf-8") as f:
+                        text = f.read()
                 self.files.append(SourceFile(abspath, rel, text))
             except (OSError, SyntaxError, ValueError) as e:
                 line = getattr(e, "lineno", None) or 1
@@ -176,6 +198,17 @@ class Project:
     def resolve_module(self, dotted: str) -> Optional[str]:
         """Rel path for a dotted module name, or None if external."""
         return self.module_map.get(dotted)
+
+    @property
+    def scoped_files(self) -> list[SourceFile]:
+        """Files whose findings must be (re)computed this run — the
+        whole tree unless the cache layer narrowed the scope.  File-
+        local and forward-interprocedural rules iterate this; the
+        full :attr:`files` list stays available for context (call
+        graph, declared axes, registries)."""
+        if self.analysis_scope is None:
+            return self.files
+        return [sf for sf in self.files if sf.rel in self.analysis_scope]
 
 
 # --------------------------------------------------------------------------
@@ -251,12 +284,16 @@ def apply_baseline(
 # --------------------------------------------------------------------------
 
 
+JSON_SCHEMA_VERSION = 2  # --json output shape (v2: schema_version + timings)
+
+
 @dataclasses.dataclass
 class LintResult:
     new: list  # findings that fail the run
     baselined: list  # grandfathered by the baseline file
     stale_baseline: list  # baseline entries matching nothing (shrink it)
     enabled: tuple  # rule ids that ran
+    timings: dict = dataclasses.field(default_factory=dict)  # rule -> seconds
 
     @property
     def ok(self) -> bool:
@@ -264,11 +301,15 @@ class LintResult:
 
     def to_json(self) -> dict:
         return {
+            "schema_version": JSON_SCHEMA_VERSION,
             "ok": self.ok,
             "rules": list(self.enabled),
             "findings": [f.to_json() for f in sorted(self.new)],
             "baselined": len(self.baselined),
             "stale_baseline": [f.to_json() for f in self.stale_baseline],
+            "timings": {
+                r: round(t, 6) for r, t in sorted(self.timings.items())
+            },
         }
 
 
@@ -279,6 +320,8 @@ def run(
     disable: Iterable[str] = (),
     baseline: Optional[Sequence[Finding]] = None,
     restrict_paths: Optional[Iterable[str]] = None,
+    scope: Optional[set] = None,
+    project: Optional[Project] = None,
 ) -> LintResult:
     """Lint the configured tree and return the filtered result.
 
@@ -288,7 +331,16 @@ def run(
     named files can report.  This is ``--changed-only``'s engine: a
     one-file change agrees with the full run for that file by
     construction.
+
+    ``scope`` (the cache layer's dirty set) narrows which files the
+    scoped rules re-analyze — unlike ``restrict_paths`` it changes what
+    *work* happens, not what is reported, and the caller is responsible
+    for merging cached findings for the out-of-scope files.  ``project``
+    reuses an already-parsed tree (the cache layer builds one from the
+    texts it hashed).
     """
+    import time
+
     from analysis.dtmlint import rules as rules_pkg
 
     all_rules = rules_pkg.ALL_RULES
@@ -301,11 +353,20 @@ def run(
             )
     enabled = requested - set(disable)
 
-    project = Project(config)
-    raw: list[Finding] = list(project.parse_failures)
+    if project is None:
+        project = Project(config)
+    project.analysis_scope = set(scope) if scope is not None else None
+    raw: list[Finding] = [
+        f
+        for f in project.parse_failures
+        if scope is None or f.path in scope
+    ]
+    timings: dict[str, float] = {}
     for rule_id, check in all_rules:
         if rule_id in enabled:
+            t0 = time.perf_counter()
             raw.extend(check(project))
+            timings[rule_id] = time.perf_counter() - t0
 
     kept: list[Finding] = []
     for f in raw:
@@ -317,7 +378,7 @@ def run(
         kept.append(f)
 
     if UNUSED_SUPPRESSION in enabled:
-        for sf in project.files:
+        for sf in project.scoped_files:
             for sup in sf.suppressions:
                 if sup.used:
                     continue
@@ -350,4 +411,5 @@ def run(
         baselined=sorted(old),
         stale_baseline=sorted(stale),
         enabled=tuple(sorted(enabled)),
+        timings=timings,
     )

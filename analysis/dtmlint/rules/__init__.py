@@ -2,12 +2,15 @@
 ``check(project) -> Iterable[Finding]``."""
 
 from analysis.dtmlint.rules import (
+    collective_order,
     determinism,
     donation,
     jaxfree,
+    lifecycle,
     locks,
     lockstep,
     metric_keys,
+    races,
     recompile,
     threads,
     wire,
@@ -23,4 +26,7 @@ ALL_RULES = [
     (recompile.RULE_ID, recompile.check),
     (donation.RULE_ID, donation.check),
     (locks.RULE_ID, locks.check),
+    (races.RULE_ID, races.check),
+    (collective_order.RULE_ID, collective_order.check),
+    (lifecycle.RULE_ID, lifecycle.check),
 ]

@@ -1,0 +1,334 @@
+"""collective-order — collective sequences must be identical per host.
+
+collective-lockstep catches collectives a host can *skip*; this rule
+catches collectives every host reaches but in a *different order or
+count* — the other way a fleet deadlocks (PAPER.md §2.4: collectives
+are matched by program order, not by tag).  Three divergent shapes,
+all interprocedural (a helper whose transitive summary performs a
+collective counts like a direct call):
+
+1. **unordered iteration** — a collective inside ``for _ in <dict/set>``:
+   set iteration order is hash-seed-randomized *per process*, and dict
+   insertion order is only as uniform as the per-host insertions that
+   built it.  Hosts agree on the elements yet disagree on the order, so
+   collective N on one host pairs with collective M on another.
+   ``sorted(...)`` the iterable.
+2. **except handler** — a collective inside an ``except`` body:
+   exceptions are per-host events (an IO error, a flaky socket), so
+   only the raising host issues the collective.  Capture the failure,
+   leave the handler, and agree on it with a collective *all* hosts
+   reach (the chief-decides pattern from PR 5).
+3. **post-continue divergence** — a per-host-conditioned ``continue`` /
+   ``break`` deep inside a loop that issues a collective later in the
+   body: hosts that skip the tail of iteration K re-join at iteration
+   K+1 one collective short.  (The flat form — the exit as the direct
+   branch body next to a later collective in the same statement list —
+   is collective-lockstep's early-exit shape and stays its finding;
+   this rule takes the nested forms lockstep cannot see.)
+
+Plus the mesh-axis literal check: ``axis_name=`` string literals on
+``psum`` / ``all_gather`` / ``ppermute`` (and friends) must name an
+axis declared by ``AxisNames`` in ``core/mesh.py`` (KNOBS.md) — a typo
+here compiles fine on a mesh that happens to define the axis and
+explodes on the composed mesh that doesn't.  Axis names passed as
+variables follow the axis-name discipline and are never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from analysis.dtmlint.astutil import (
+    COLLECTIVE_CALLS,
+    call_name,
+    identifiers,
+    terminates,
+    walk_in_scope,
+)
+from analysis.dtmlint.callgraph import CallGraph, Ctx, iter_functions
+from analysis.dtmlint.core import Finding, Project
+from analysis.dtmlint.rules.lockstep import PER_PROCESS
+
+RULE_ID = "collective-order"
+
+# jax.lax per-axis collectives and the position of their axis argument.
+_AXIS_OPS: Dict[str, int] = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "psum_scatter": 1,
+    "pbroadcast": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+
+_UNORDERED_METHODS = frozenset({"keys", "values", "items"})
+_UNORDERED_CTORS = frozenset({"set", "frozenset"})
+
+
+def _collective_here(cg: CallGraph, ctx: Ctx, node: ast.AST) -> List[Tuple]:
+    """``(call, label)`` for collectives reachable from ``node`` —
+    direct calls plus resolved helpers whose summary performs one."""
+    out: List[Tuple] = []
+    for n in walk_in_scope(node):
+        if not isinstance(n, ast.Call):
+            continue
+        nm = call_name(n)
+        if nm in COLLECTIVE_CALLS:
+            out.append((n, f"`{nm}`"))
+            continue
+        target = cg.resolve(n, ctx)
+        if target is None:
+            continue
+        chain = cg.collective_chain(target)
+        if chain:
+            hops = (target.name,) + chain[:-1]
+            via = " -> ".join(f"`{h}`" for h in hops)
+            out.append((n, f"`{chain[-1]}` (inside helper {via})"))
+    return out
+
+
+def _local_env(scope: ast.AST) -> Dict[str, ast.AST]:
+    """Simple-name assignments in this scope (last one wins is fine —
+    the question is only "could this name hold an unordered thing")."""
+    env: Dict[str, ast.AST] = {}
+    for n in walk_in_scope(scope):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(
+            n.targets[0], ast.Name
+        ):
+            env[n.targets[0].id] = n.value
+    return env
+
+
+def _unordered(expr: ast.AST, env: Dict[str, ast.AST], depth=0) -> Optional[str]:
+    """A human label when ``expr`` iterates in unordered / per-host
+    order, else None.  ``sorted(...)`` wrappers come out None."""
+    if depth > 3:
+        return None
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return "a set"
+    if isinstance(expr, (ast.Dict, ast.DictComp)):
+        return "a dict"
+    if isinstance(expr, ast.Call):
+        nm = call_name(expr)
+        if isinstance(expr.func, ast.Name) and nm in _UNORDERED_CTORS:
+            return f"`{nm}(...)`"
+        if (
+            isinstance(expr.func, ast.Attribute)
+            and nm in _UNORDERED_METHODS
+            and not expr.args
+        ):
+            return f"`.{nm}()` of a dict"
+    if isinstance(expr, ast.Name) and expr.id in env:
+        return _unordered(env[expr.id], env, depth + 1)
+    return None
+
+
+def _loops_with_exits(scope: ast.AST) -> Iterator[Tuple[ast.AST, ast.If]]:
+    """``(loop, per_process_if)`` pairs where the ``if`` body exits the
+    loop (continue/break) and the ``if`` belongs to that loop (not to a
+    nested one)."""
+
+    def visit(node, loop):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+            ):
+                continue
+            if isinstance(child, (ast.For, ast.While)):
+                yield from visit(child, child)
+                continue
+            if (
+                loop is not None
+                and isinstance(child, ast.If)
+                and (set(identifiers(child.test)) & PER_PROCESS)
+                and any(
+                    isinstance(s, (ast.Continue, ast.Break))
+                    for s in ast.walk(child)
+                )
+            ):
+                yield loop, child
+            yield from visit(child, loop)
+
+    yield from visit(scope, None)
+
+
+def _is_lockstep_shape(
+    cg: CallGraph, ctx: Ctx, loop: ast.AST, if_node: ast.If
+) -> bool:
+    """The flat early-exit form collective-lockstep already reports:
+    the branch body *ends* in the exit and a collective follows the
+    ``if`` in the same statement list.  Leave those to lockstep."""
+    if not terminates(if_node.body):
+        return False
+    for node in ast.walk(loop):
+        for attr in ("body", "orelse", "finalbody"):
+            seq = getattr(node, attr, None)
+            if isinstance(seq, list) and if_node in seq:
+                for later in seq[seq.index(if_node) + 1:]:
+                    if _collective_here(cg, ctx, later):
+                        return True
+    return False
+
+
+def _declared_axes(project: Project) -> Set[str]:
+    """Axis strings declared by ``AxisNames``-style classes (and
+    ``*_AXES`` module tuples) in the configured mesh module — or, when
+    none is configured (strict/fixture mode), anywhere in the tree."""
+    mesh_rel = project.config.mesh_axis_module
+    if mesh_rel is not None:
+        files = [sf for sf in project.files if sf.rel == mesh_rel]
+    else:
+        files = list(project.files)
+    axes: Set[str] = set()
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef) and "AxisNames" in node.name:
+                for stmt in node.body:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, str
+                        ):
+                            axes.add(sub.value)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and (
+                        "AXES" in t.id or "AXIS" in t.id
+                    ):
+                        for sub in ast.walk(node.value):
+                            if isinstance(sub, ast.Constant) and isinstance(
+                                sub.value, str
+                            ):
+                                axes.add(sub.value)
+    return axes
+
+
+def _axis_literals(call: ast.Call) -> Iterator[ast.Constant]:
+    nm = call_name(call)
+    pos = _AXIS_OPS.get(nm)
+    if pos is None:
+        return
+    value = None
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            value = kw.value
+            break
+    if value is None and len(call.args) > pos:
+        value = call.args[pos]
+    if value is None:
+        return
+    items = value.elts if isinstance(value, (ast.Tuple, ast.List)) else [value]
+    for item in items:
+        if isinstance(item, ast.Constant) and isinstance(item.value, str):
+            yield item
+
+
+def check(project: Project):
+    cg = CallGraph.of(project)
+    declared = _declared_axes(project)
+    for sf in project.scoped_files:
+        scopes = [(sf.tree, Ctx(sf.rel))]
+        for fi, fctx in iter_functions(sf):
+            scopes.append(
+                (
+                    fi.node,
+                    Ctx(
+                        rel=fctx.rel,
+                        cls=fctx.cls,
+                        func_stack=fctx.func_stack + (fi.node,),
+                    ),
+                )
+            )
+        for scope, ctx in scopes:
+            env = _local_env(scope)
+            for node in walk_in_scope(scope):
+                # (1) collective while iterating an unordered container
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    label = _unordered(node.iter, env)
+                    if label:
+                        for call, what in _collective_here(
+                            cg, ctx, _body_only(node)
+                        ):
+                            yield Finding(
+                                sf.rel,
+                                call.lineno,
+                                RULE_ID,
+                                f"collective {what} inside iteration "
+                                f"over {label} (loop at line "
+                                f"{node.lineno}): iteration order is "
+                                "per-host, so hosts pair mismatched "
+                                "collectives — iterate `sorted(...)`",
+                            )
+                # (2) collective inside an except handler
+                elif isinstance(node, ast.ExceptHandler):
+                    for call, what in _collective_here(cg, ctx, node):
+                        yield Finding(
+                            sf.rel,
+                            call.lineno,
+                            RULE_ID,
+                            f"collective {what} inside an `except` "
+                            f"handler (line {node.lineno}): exceptions "
+                            "are per-host events, so peers that don't "
+                            "raise never enter it — capture the "
+                            "failure and agree on it with a collective "
+                            "outside the handler",
+                        )
+            # (3) per-host continue/break deep in a loop with later
+            # collectives
+            for loop, if_node in _loops_with_exits(scope):
+                if _is_lockstep_shape(cg, ctx, loop, if_node):
+                    continue
+                later = [
+                    (call, what)
+                    for call, what in _collective_here(
+                        cg, ctx, _body_only(loop)
+                    )
+                    if call.lineno > if_node.lineno
+                ]
+                if later:
+                    markers = sorted(
+                        set(identifiers(if_node.test)) & PER_PROCESS
+                    )
+                    yield Finding(
+                        sf.rel,
+                        if_node.lineno,
+                        RULE_ID,
+                        "per-host early exit "
+                        f"({', '.join(markers)}) inside the loop at "
+                        f"line {loop.lineno} skips collective "
+                        f"{later[0][1]} at line {later[0][0].lineno} "
+                        "for this iteration only — hosts re-join the "
+                        "next iteration one collective out of step",
+                    )
+        # (4) axis_name literals vs the declared mesh axes
+        if declared:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                for lit in _axis_literals(node):
+                    if lit.value not in declared:
+                        known = ", ".join(sorted(declared))
+                        yield Finding(
+                            sf.rel,
+                            lit.lineno,
+                            RULE_ID,
+                            f"axis_name {lit.value!r} on "
+                            f"`{call_name(node)}` is not a declared "
+                            f"mesh axis ({known}); hard-coded axis "
+                            "literals drift from the mesh — import "
+                            "AxisNames (see KNOBS.md)",
+                        )
+
+
+def _body_only(loop: ast.AST) -> ast.Module:
+    """The loop body as a walkable pseudo-node (excludes the iterable
+    expression and the else clause)."""
+    mod = ast.Module(body=list(loop.body), type_ignores=[])
+    return mod

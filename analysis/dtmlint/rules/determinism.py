@@ -82,7 +82,7 @@ def _classify(call: ast.Call):
 
 def check(project: Project):
     scope = set(project.config.determinism_scope)
-    for sf in project.files:
+    for sf in project.scoped_files:
         if sf.rel not in scope:
             continue
         for node in ast.walk(sf.tree):

@@ -142,7 +142,7 @@ def _enclosing_loop(func_node, call_stmt) -> Optional[ast.stmt]:
 
 def check(project: Project):
     cg = CallGraph.of(project)
-    for sf in project.files:
+    for sf in project.scoped_files:
         bindings = _donated_bindings(sf.tree)
         if not bindings:
             continue

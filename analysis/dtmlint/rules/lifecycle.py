@@ -1,0 +1,337 @@
+"""resource-lifecycle — acquired resources released on *every* exit path.
+
+thread-discipline asks "is this thread ever joined"; this rule asks the
+harder question for resources that live and die inside one function: is
+the release reachable when the code between acquire and release
+*raises*?  PR 7's wakeup-fd restore and the launcher's heartbeat tmp
+dir both shipped with fall-through-only cleanup first — one exception
+and the fd (or the directory, or the thread) outlives the function.
+
+Tracked acquisitions, when bound to a **local** name that does not
+escape (stored on ``self``/a container, returned, yielded, or aliased
+away — someone else owns the lifecycle then):
+
+- files / sockets: ``open``, ``os.fdopen``, ``socket.socket``,
+  ``socket.create_connection``, ``tempfile.TemporaryFile`` /
+  ``NamedTemporaryFile`` → released by ``.close()``;
+- threads: ``threading.Thread(...)`` that is ``.start()``-ed here and
+  ``daemon=False`` → released by ``.join()`` (daemon helpers answer to
+  thread-discipline's module-level policy instead);
+- tmp dirs: ``tempfile.mkdtemp`` → ``shutil.rmtree(x)``;
+  ``tempfile.TemporaryDirectory`` → ``.cleanup()`` (or ``with``);
+- wakeup fd: a ``signal.set_wakeup_fd(...)`` install whose saved
+  previous fd stays local → restored by another ``set_wakeup_fd`` call.
+
+A resource is safe when acquired via ``with`` (never matched here), or
+when its release sits in a ``finally`` block, or under the
+teardown-guard idiom (released in an ``except`` handler that re-raises
+*and* on the fall-through path).  Otherwise:
+
+- release only on the fall-through path → flagged (the exception path
+  leaks it);
+- no release at all in the function → flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from analysis.dtmlint.astutil import call_name, dotted_name
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "resource-lifecycle"
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# ctor dotted-name tail -> (kind, release method names, release free fns)
+_FILE_CTORS = frozenset(
+    {
+        "open",
+        "os.fdopen",
+        "socket.socket",
+        "socket.create_connection",
+        "socket.create_server",
+        "tempfile.TemporaryFile",
+        "tempfile.NamedTemporaryFile",
+        "TemporaryFile",
+        "NamedTemporaryFile",
+    }
+)
+_ESCAPE_SINK_METHODS = frozenset(
+    {"append", "add", "insert", "register", "put", "put_nowait"}
+)
+
+
+def _walk_scope(node: ast.AST):
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _scopes(sf) -> Iterator[ast.AST]:
+    yield sf.tree
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class _Resource:
+    def __init__(self, name, kind, lineno, release_desc):
+        self.name = name
+        self.kind = kind
+        self.lineno = lineno
+        self.release_desc = release_desc
+
+
+def _classify(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """``(kind, how-to-release)`` when ``call`` acquires a resource."""
+    dn = dotted_name(call.func)
+    if dn in _FILE_CTORS:
+        return ("file/socket", "`.close()`")
+    if dn in ("tempfile.mkdtemp", "mkdtemp"):
+        return ("tmp dir", "`shutil.rmtree(...)`")
+    if dn in ("tempfile.TemporaryDirectory", "TemporaryDirectory"):
+        return ("tmp dir", "`.cleanup()`")
+    if dn in ("threading.Thread", "Thread"):
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                if kw.value.value is True:
+                    return None  # daemon: thread-discipline's problem
+        return ("thread", "`.join()`")
+    return None
+
+
+def _acquires(scope: ast.AST) -> List[_Resource]:
+    out: List[_Resource] = []
+    for node in _walk_scope(scope):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name) or not isinstance(
+            node.value, ast.Call
+        ):
+            continue
+        got = _classify(node.value)
+        if got is None:
+            continue
+        kind, how = got
+        out.append(_Resource(tgt.id, kind, node.lineno, how))
+    return out
+
+
+def _escapes(scope: ast.AST, res: _Resource) -> bool:
+    name = res.name
+    for node in _walk_scope(scope):
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            val = getattr(node, "value", None)
+            if val is not None and any(
+                isinstance(n, ast.Name) and n.id == name
+                for n in ast.walk(val)
+            ):
+                return True
+        elif isinstance(node, ast.Assign):
+            if not (
+                isinstance(node.value, ast.Name) and node.value.id == name
+            ):
+                continue
+            return True  # aliased or stored; the alias owns it now
+        elif isinstance(node, ast.Call):
+            nm = call_name(node)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and nm in _ESCAPE_SINK_METHODS
+                and any(
+                    isinstance(a, ast.Name) and a.id == name
+                    for a in node.args
+                )
+            ):
+                return True  # parked in a container that outlives us
+    return False
+
+
+def _is_release(node: ast.Call, res: _Resource) -> bool:
+    nm = call_name(node)
+    if res.kind == "file/socket" and nm == "close":
+        recv = dotted_name(node.func.value) if isinstance(
+            node.func, ast.Attribute
+        ) else None
+        return recv == res.name
+    if res.kind == "thread" and nm == "join":
+        recv = dotted_name(node.func.value) if isinstance(
+            node.func, ast.Attribute
+        ) else None
+        return recv == res.name
+    if res.kind == "tmp dir":
+        if nm == "cleanup" and isinstance(node.func, ast.Attribute):
+            return dotted_name(node.func.value) == res.name
+        if nm == "rmtree":
+            return any(
+                isinstance(a, ast.Name) and a.id == res.name
+                for n in [node]
+                for a in n.args
+            )
+    return False
+
+
+def _releases(scope: ast.AST, res: _Resource) -> List[ast.Call]:
+    return [
+        n
+        for n in _walk_scope(scope)
+        if isinstance(n, ast.Call) and _is_release(n, res)
+    ]
+
+
+def _in_finalbody(scope: ast.AST, call: ast.Call) -> bool:
+    for node in _walk_scope(scope):
+        if isinstance(node, ast.Try):
+            for fin in node.finalbody:
+                if any(sub is call for sub in ast.walk(fin)):
+                    return True
+    return False
+
+
+def _in_reraising_handler(scope: ast.AST, call: ast.Call) -> bool:
+    """Teardown-guard: release inside an except handler that re-raises."""
+    for node in _walk_scope(scope):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not any(sub is call for sub in ast.walk(node)):
+            continue
+        if any(
+            isinstance(s, ast.Raise) for s in ast.walk(node)
+        ):
+            return True
+    return False
+
+
+def _with_managed(scope: ast.AST, res: _Resource) -> bool:
+    """``with x:`` / ``with closing(x):`` / ``stack.enter_context(x)``
+    anywhere in the scope hands the lifecycle to a context manager."""
+    for node in _walk_scope(scope):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Name) and sub.id == res.name:
+                        return True
+        elif isinstance(node, ast.Call) and call_name(node) in (
+            "enter_context",
+            "callback",
+            "closing",
+        ):
+            if any(
+                isinstance(n, ast.Name) and n.id == res.name
+                for a in node.args
+                for n in ast.walk(a)
+            ):
+                return True
+    return False
+
+
+def _thread_started(scope: ast.AST, res: _Resource) -> bool:
+    for node in _walk_scope(scope):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "start"
+            and dotted_name(node.func.value) == res.name
+        ):
+            return True
+    return False
+
+
+def _wakeupfd_findings(sf, scope: ast.AST):
+    """First ``signal.set_wakeup_fd`` with a locally-kept (or dropped)
+    previous fd must be paired with a restoring call in a finally."""
+    calls = [
+        n
+        for n in _walk_scope(scope)
+        if isinstance(n, ast.Call)
+        and dotted_name(n.func) in ("signal.set_wakeup_fd", "set_wakeup_fd")
+    ]
+    if not calls:
+        return
+    calls.sort(key=lambda n: n.lineno)
+    # An *install* saves the previous fd into a local (`old = signal.
+    # set_wakeup_fd(fd)`); a call whose result is discarded or stored
+    # on self/a global is a restore (or a cross-method lifecycle like
+    # install()/stop() pairs) and is not this rule's business.
+    install = None
+    for node in _walk_scope(scope):
+        if isinstance(node, ast.Assign) and node.value is calls[0]:
+            if all(isinstance(t, ast.Name) for t in node.targets):
+                install = calls[0]
+    if install is None:
+        return
+    restores = [c for c in calls[1:]]
+    if not restores:
+        yield Finding(
+            sf.rel,
+            install.lineno,
+            RULE_ID,
+            "`signal.set_wakeup_fd` installed but never restored in "
+            "this function; the previous wakeup fd is lost on every "
+            "path — restore it in a finally",
+        )
+        return
+    if not any(
+        _in_finalbody(scope, c) or _in_reraising_handler(scope, c)
+        for c in restores
+    ):
+        yield Finding(
+            sf.rel,
+            install.lineno,
+            RULE_ID,
+            "`signal.set_wakeup_fd` restored only on the fall-through "
+            "path; an exception in between leaves the process wired to "
+            "a dead fd — restore it in a finally",
+        )
+
+
+def check(project: Project):
+    for sf in project.scoped_files:
+        for scope in _scopes(sf):
+            yield from _wakeupfd_findings(sf, scope)
+            for res in _acquires(scope):
+                if _escapes(scope, res):
+                    continue
+                if _with_managed(scope, res):
+                    continue
+                if res.kind == "thread" and not _thread_started(
+                    scope, res
+                ):
+                    continue  # never started: nothing to reap
+                rels = _releases(scope, res)
+                if not rels:
+                    if res.kind == "thread":
+                        # thread-discipline already reports never-joined
+                        # threads; re-reporting here would double up.
+                        continue
+                    yield Finding(
+                        sf.rel,
+                        res.lineno,
+                        RULE_ID,
+                        f"{res.kind} `{res.name}` acquired here is "
+                        f"never released in this function (expected "
+                        f"{res.release_desc}); every exit path leaks "
+                        "it — use `with` or try/finally",
+                    )
+                    continue
+                if not any(
+                    _in_finalbody(scope, c)
+                    or _in_reraising_handler(scope, c)
+                    for c in rels
+                ):
+                    yield Finding(
+                        sf.rel,
+                        res.lineno,
+                        RULE_ID,
+                        f"{res.kind} `{res.name}` is released only on "
+                        "the fall-through path (release at line "
+                        f"{rels[0].lineno}); an exception in between "
+                        "leaks it — move the release into a finally "
+                        "(or `with`)",
+                    )

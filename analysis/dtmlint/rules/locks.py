@@ -65,7 +65,7 @@ def _release_guarded(func_node: ast.AST, tail: str) -> bool:
 
 def check(project: Project):
     cg = CallGraph.of(project)
-    for sf in project.files:
+    for sf in project.scoped_files:
         idx = cg.by_rel.get(sf.rel)
         if idx is None:
             continue

@@ -125,7 +125,7 @@ def _collectives_after(
 
 def check(project: Project):
     cg = CallGraph.of(project)
-    for sf in project.files:
+    for sf in project.scoped_files:
         scopes = [(sf.tree, Ctx(sf.rel))]
         for fi, fctx in iter_functions(sf):
             scopes.append(

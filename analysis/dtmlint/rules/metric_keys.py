@@ -51,7 +51,7 @@ def _declared(project: Project) -> Dict[str, str]:
     # Strict/fixture mode: any UPPERCASE string constant anywhere in the
     # linted set counts as declared.
     out: Dict[str, str] = {}
-    for sf in project.files:
+    for sf in project.scoped_files:
         out.update(declared_keys_from_source(sf.text))
     return out
 

@@ -1,0 +1,511 @@
+"""shared-state-race — unguarded cross-thread access to shared state.
+
+The supervisor stack is full of long-lived helper threads — heartbeat
+writer, progress watchdog, flight watcher, pipeline stages, the serving
+worker — all of which communicate with the main thread through ``self.``
+attributes (and the odd module global).  The races that bit us were
+never exotic: a main-thread ``beat()`` writing a counter the helper
+thread reads, with nothing ordering the two.
+
+For every ``Thread(target=...)`` spawn this rule computes the *thread
+escape set*: the ``self.`` attributes (and ``global``-declared names)
+reachable from the target through the project call graph — same-class
+method calls, nested closures, and helpers that receive the object as
+an argument (so a racing write hiding one file away in
+``helper(self)`` still registers).  Every access is classified
+read/write per thread-role (each distinct target is a role; everything
+else on the class is the main thread), and a write/write or read/write
+pair across roles is a finding **unless** the pair is mediated by:
+
+- a type-matched Lock/Condition held at *both* sites (receiver typing
+  from constructor assignments, as in lock-discipline);
+- a Queue handoff (one side transitively puts, the other gets) or an
+  Event handoff (one side sets, the other waits) — the happens-before
+  edge the memory model actually gives you;
+- the single-assignment-before-``start()`` idiom (writes in
+  ``__init__`` or lexically before the spawn's ``.start()``);
+- post-``join()`` ordering (main-thread accesses lexically after a
+  plausible thread join in the same function).
+
+Attributes that *are* synchronisation objects (Lock/Event/Queue/
+Thread-typed receivers) are data-race-free by construction and exempt.
+Unknown callees stay benign, as everywhere in dtm-lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from analysis.dtmlint.astutil import call_name, dotted_name
+from analysis.dtmlint.callgraph import (
+    CallGraph,
+    Ctx,
+    FuncInfo,
+    iter_functions,
+)
+from analysis.dtmlint.core import Finding, Project
+
+RULE_ID = "shared-state-race"
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_QUEUE_PUTS = frozenset({"put", "put_nowait"})
+_QUEUE_GETS = frozenset({"get", "get_nowait"})
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    write: bool
+    lineno: int
+    rel: str  # file the access sits in (helpers may be cross-file)
+    func: FuncInfo  # function performing the access
+    locked: bool  # lexically inside `with <lock/condition>:`
+    role: str  # thread target name, or "main"
+
+
+def _thread_ctor(call: ast.Call) -> bool:
+    dn = dotted_name(call.func)
+    return dn in ("threading.Thread", "Thread", "threading.Timer", "Timer")
+
+
+def _target_kwarg(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return None
+
+
+def _join_lines(fi: FuncInfo) -> List[int]:
+    """Line numbers of plausible thread joins in ``fi`` (same filter as
+    thread-discipline: exclude ``os.path.join`` and ``"sep".join``)."""
+    out = []
+    for node in _walk_scope(fi.node):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+        ):
+            continue
+        recv = node.func.value
+        if isinstance(recv, ast.Constant):
+            continue
+        dn = dotted_name(recv)
+        if dn is not None and (dn == "os.path" or dn.endswith(".path")):
+            continue
+        out.append(node.lineno)
+    return out
+
+
+def _walk_scope(node: ast.AST):
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class _Analyzer:
+    """Per-project helper state shared across classes."""
+
+    def __init__(self, cg: CallGraph):
+        self.cg = cg
+        self._queue_ops: Dict[FuncInfo, Tuple[bool, bool]] = {}
+        self._event_ops: Dict[FuncInfo, Tuple[bool, bool]] = {}
+
+    # -- transitive queue / event usage -------------------------------
+
+    def _ops(self, fi: FuncInfo, memo, direct, _stack=None) -> Tuple:
+        got = memo.get(fi)
+        if got is not None:
+            return got
+        stack = _stack if _stack is not None else set()
+        if fi in stack:
+            return (False, False)
+        stack.add(fi)
+        try:
+            a, b = direct(fi)
+            for target, _ in self.cg.summary(fi).calls:
+                if a and b:
+                    break
+                sa, sb = self._ops(target, memo, direct, stack)
+                a, b = a or sa, b or sb
+            memo[fi] = (a, b)
+            return memo[fi]
+        finally:
+            stack.discard(fi)
+
+    def queue_ops(self, fi: FuncInfo) -> Tuple[bool, bool]:
+        """(puts, gets) on queue-typed receivers, transitively."""
+
+        def direct(f: FuncInfo) -> Tuple[bool, bool]:
+            idx = self.cg.by_rel.get(f.rel)
+            if idx is None:
+                return False, False
+            puts = gets = False
+            for node in _walk_scope(f.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                # Handing a queue-typed object to a helper is the
+                # handoff idiom too (`self._put_stop_aware(self._buffer,
+                # item)`) — count it as touching the queue both ways.
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if idx.kind_of(dotted_name(arg)) == "queue":
+                        puts = gets = True
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                nm = node.func.attr
+                if nm not in _QUEUE_PUTS and nm not in _QUEUE_GETS:
+                    continue
+                recv = dotted_name(node.func.value)
+                if idx.kind_of(recv) != "queue":
+                    continue
+                if nm in _QUEUE_PUTS:
+                    puts = True
+                else:
+                    gets = True
+            return puts, gets
+
+        return self._ops(fi, self._queue_ops, direct)
+
+    def event_ops(self, fi: FuncInfo) -> Tuple[bool, bool]:
+        """(sets, waits) on event-typed receivers, transitively."""
+
+        def direct(f: FuncInfo) -> Tuple[bool, bool]:
+            idx = self.cg.by_rel.get(f.rel)
+            sets = waits = False
+            for node in _walk_scope(f.node):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    continue
+                nm = node.func.attr
+                if nm not in ("set", "wait", "is_set"):
+                    continue
+                recv = dotted_name(node.func.value)
+                if idx is None or idx.kind_of(recv) != "event":
+                    continue
+                if nm == "set":
+                    sets = True
+                else:
+                    waits = True
+            return sets, waits
+
+        return self._ops(fi, self._event_ops, direct)
+
+    # -- thread-closure expansion -------------------------------------
+
+    def closure(self, entry: FuncInfo) -> List[Tuple[FuncInfo, str]]:
+        """``(function, base_name)`` pairs reachable from ``entry``
+        with the spawned object bound to ``base_name`` — same-class
+        ``self.m()`` calls, nested closures (which capture ``self``),
+        and helpers receiving the object as an argument."""
+        out: List[Tuple[FuncInfo, str]] = []
+        seen = set()
+        stack: List[Tuple[FuncInfo, str]] = [
+            (entry, "self" if entry.cls else "")
+        ]
+        while stack:
+            fi, base = stack.pop()
+            if (fi, base) in seen:
+                continue
+            seen.add((fi, base))
+            out.append((fi, base))
+            for target, call in self.cg.summary(fi).calls:
+                if target.cls is not None and target.cls == fi.cls and (
+                    isinstance(call.func, ast.Attribute)
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id == base
+                ):
+                    stack.append((target, "self"))
+                    continue
+                if (
+                    isinstance(call.func, ast.Name)
+                    and "<locals>" in target.qualname
+                    and target.rel == fi.rel
+                ):
+                    # Nested closure: sees the same enclosing bindings.
+                    stack.append((target, base))
+                    continue
+                if not base:
+                    continue
+                params = target.params()
+                for pos, arg in enumerate(call.args):
+                    if isinstance(arg, ast.Name) and arg.id == base and (
+                        pos < len(params)
+                    ):
+                        stack.append((target, params[pos]))
+                for kw in call.keywords:
+                    if (
+                        kw.arg
+                        and isinstance(kw.value, ast.Name)
+                        and kw.value.id == base
+                    ):
+                        stack.append((target, kw.arg))
+        return out
+
+    def accesses(
+        self, fi: FuncInfo, base: str, role: str, global_names=frozenset()
+    ) -> List[Access]:
+        """Attribute accesses on ``base`` and accesses to the given
+        module-global names in ``fi``, with lexical ``with <lock>:``
+        tracking.  A global name shadowed by a local binding (stored
+        without a ``global`` declaration) does not register."""
+        idx = self.cg.by_rel.get(fi.rel)
+        globals_declared = {
+            name
+            for node in _walk_scope(fi.node)
+            if isinstance(node, ast.Global)
+            for name in node.names
+        }
+        stored_names = {
+            n.id
+            for n in _walk_scope(fi.node)
+            if isinstance(n, ast.Name) and not isinstance(n.ctx, ast.Load)
+        }
+        out: List[Access] = []
+
+        def visit(node, locked):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _SCOPE_NODES):
+                    continue
+                l2 = locked
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        recv = dotted_name(item.context_expr)
+                        if idx is not None and idx.kind_of(recv) in (
+                            "lock",
+                            "condition",
+                        ):
+                            l2 = True
+                if (
+                    base
+                    and isinstance(child, ast.Attribute)
+                    and isinstance(child.value, ast.Name)
+                    and child.value.id == base
+                ):
+                    out.append(
+                        Access(
+                            attr=child.attr,
+                            write=not isinstance(child.ctx, ast.Load),
+                            lineno=child.lineno,
+                            rel=fi.rel,
+                            func=fi,
+                            locked=l2,
+                            role=role,
+                        )
+                    )
+                elif isinstance(child, ast.Name) and (
+                    child.id in globals_declared
+                    or (
+                        child.id in global_names
+                        and child.id not in stored_names
+                    )
+                ):
+                    out.append(
+                        Access(
+                            attr=f"global {child.id}",
+                            write=not isinstance(child.ctx, ast.Load),
+                            lineno=child.lineno,
+                            rel=fi.rel,
+                            func=fi,
+                            locked=l2,
+                            role=role,
+                        )
+                    )
+                visit(child, l2)
+
+        visit(fi.node, False)
+        return out
+
+    def mediated(self, a: Access, b: Access) -> bool:
+        """A happens-before edge between the two access sites."""
+        if a.locked and b.locked:
+            return True
+        ap, ag = self.queue_ops(a.func)
+        bp, bg = self.queue_ops(b.func)
+        if (ap and bg) or (bp and ag):
+            return True
+        es_a, ew_a = self.event_ops(a.func)
+        es_b, ew_b = self.event_ops(b.func)
+        if (es_a and ew_b) or (es_b and ew_a):
+            return True
+        return False
+
+
+def _role_desc(role: str) -> str:
+    return "the main thread" if role == "main" else f"thread `{role}`"
+
+
+def check(project: Project):
+    cg = CallGraph.of(project)
+    an = _Analyzer(cg)
+    for sf in project.scoped_files:
+        idx = cg.by_rel.get(sf.rel)
+        if idx is None:
+            continue
+        # -- discover spawns, grouped by enclosing class ---------------
+        spawns_by_cls: Dict[Optional[str], list] = {}
+        for fi, ctx in iter_functions(sf):
+            fctx = Ctx(
+                rel=ctx.rel, cls=ctx.cls,
+                func_stack=ctx.func_stack + (fi.node,),
+            )
+            for node in _walk_scope(fi.node):
+                if not (isinstance(node, ast.Call) and _thread_ctor(node)):
+                    continue
+                tgt = _target_kwarg(node)
+                entry = None
+                role = None
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                    and fi.cls
+                ):
+                    entry = idx.class_method(fi.cls, tgt.attr)
+                    role = tgt.attr
+                elif isinstance(tgt, ast.Name):
+                    entry = cg.resolve_target(tgt, fctx)
+                    role = tgt.id
+                if entry is None:
+                    continue
+                spawns_by_cls.setdefault(fi.cls, []).append(
+                    (entry, role, fi, node)
+                )
+
+        for cls, spawns in sorted(
+            spawns_by_cls.items(), key=lambda kv: kv[0] or ""
+        ):
+            yield from _check_group(project, cg, an, sf, idx, cls, spawns)
+
+
+def _check_group(project, cg, an, sf, idx, cls, spawns):
+    # -- thread roles: closure of each distinct target -----------------
+    roles: Dict[str, List[Tuple[FuncInfo, str]]] = {}
+    thread_funcs = set()
+    spawn_sites: Dict[FuncInfo, int] = {}  # spawner -> .start() line
+    for entry, role, spawner, ctor in spawns:
+        roles.setdefault(role, [])
+        for fi, base in an.closure(entry):
+            if (fi, base) not in roles[role]:
+                roles[role].append((fi, base))
+            thread_funcs.add(fi)
+        # The single-assignment-before-start() window: everything in
+        # the spawning function up to the first .start() at or after
+        # the ctor (or the ctor line when start is elsewhere).
+        start_line = ctor.lineno
+        for node in _walk_scope(spawner.node):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "start"
+                and node.lineno >= ctor.lineno
+            ):
+                start_line = max(start_line, node.lineno)
+        prev = spawn_sites.get(spawner, 0)
+        spawn_sites[spawner] = max(prev, start_line)
+
+    # -- main role: every class method not exclusively thread-side ----
+    main_funcs: List[Tuple[FuncInfo, str]] = []
+    if cls is not None:
+        for fi in idx.classes.get(cls, {}).values():
+            if fi not in thread_funcs:
+                main_funcs.append((fi, "self"))
+    else:
+        for fi in idx.functions.values():
+            if fi not in thread_funcs:
+                main_funcs.append((fi, ""))
+
+    # -- collect accesses per attr -------------------------------------
+    members = [
+        (fi, base, role)
+        for role, mm in sorted(roles.items())
+        for fi, base in mm
+    ] + [(fi, base, "main") for fi, base in main_funcs]
+    global_names = frozenset(
+        name
+        for fi, _, _ in members
+        for node in _walk_scope(fi.node)
+        if isinstance(node, ast.Global)
+        for name in node.names
+    )
+    by_attr: Dict[str, List[Access]] = {}
+
+    def add(fi, base, role):
+        joins = _join_lines(fi)
+        after_join = max(joins) if joins else None
+        for acc in an.accesses(fi, base, role, global_names):
+            plain = acc.attr.split(" ", 1)[-1]
+            if idx.kind_of(plain) is not None:
+                continue  # lock/event/queue/thread-typed: sync object
+            if fi.name == "__init__":
+                continue  # construction precedes any spawn
+            if fi in spawn_sites and acc.lineno <= spawn_sites[fi]:
+                continue  # single-assignment-before-start idiom
+            if (
+                role == "main"
+                and after_join is not None
+                and acc.lineno > after_join
+            ):
+                continue  # post-join: the thread is gone
+            by_attr.setdefault(acc.attr, []).append(acc)
+
+    for fi, base, role in members:
+        add(fi, base, role)
+
+    # -- conflicts ------------------------------------------------------
+    for attr in sorted(by_attr):
+        accs = by_attr[attr]
+        conflict = None
+        for a in accs:
+            if not a.write:
+                continue
+            for b in accs:
+                if b.role == a.role:
+                    continue
+                if an.mediated(a, b):
+                    continue
+                pair = (a, b)
+                if conflict is None or _pair_key(pair, sf.rel) < _pair_key(
+                    conflict, sf.rel
+                ):
+                    conflict = pair
+        if conflict is None:
+            continue
+        w, o = conflict
+        if w.rel == sf.rel:
+            line = w.lineno
+        elif o.rel == sf.rel:
+            line = o.lineno
+        else:
+            # Both sites live in helper files: anchor at the spawn that
+            # created the racing thread (always in this file).
+            line = min(c.lineno for _, _, _, c in spawns)
+        verb = "writes" if o.write else "reads"
+        owner = f"`{cls}.{attr}`" if cls else f"`{attr}`"
+        yield Finding(
+            sf.rel,
+            line,
+            RULE_ID,
+            f"unsynchronized cross-thread access to {owner}: "
+            f"{_role_desc(w.role)} writes it in `{w.func.name}` "
+            f"({w.rel}:{w.lineno}) while {_role_desc(o.role)} {verb} it "
+            f"in `{o.func.name}` ({o.rel}:{o.lineno}); no common lock, "
+            "queue/event handoff, or start/join ordering mediates the "
+            "pair — guard both sides or hand the value through a Queue",
+        )
+
+
+def _pair_key(pair, rel):
+    a, b = pair
+    # Deterministic pick: prefer pairs anchored in the class's own file,
+    # then lowest line numbers.
+    in_file = 0 if (a.rel == rel or b.rel == rel) else 1
+    return (in_file, min(a.lineno, b.lineno), max(a.lineno, b.lineno),
+            a.attr)

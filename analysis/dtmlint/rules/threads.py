@@ -91,7 +91,7 @@ def _main_thread_checked_before(scope: ast.AST, lineno: int) -> bool:
 
 
 def check(project: Project):
-    for sf in project.files:
+    for sf in project.scoped_files:
         joins = list(_join_receivers(sf.tree))
         join_names = {dn for dn, _ in joins}
         for node in ast.walk(sf.tree):

@@ -71,7 +71,7 @@ def _value_of(node: ast.AST, consts: Dict[str, int]) -> Optional[int]:
 
 
 def check(project: Project):
-    for sf in project.files:
+    for sf in project.scoped_files:
         scoped = _scoped_consts(sf.tree)
         for scope, consts in scoped.items():
             for node in walk_in_scope(scope):

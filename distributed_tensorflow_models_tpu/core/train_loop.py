@@ -23,6 +23,7 @@ from typing import Any, Callable, Mapping, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh
 
@@ -691,6 +692,29 @@ def make_eval_step(
     return jax.jit(eval_fn)
 
 
+def _collective_free_put(x, s):
+    """``device_put`` onto ``s`` without cross-process collectives.
+
+    ``jax.device_put`` onto a sharding that spans processes runs a
+    value-equality broadcast of the *whole tensor* per leaf
+    (``multihost_utils.assert_equal``), so laying out a model issues one
+    cross-host collective per parameter before training starts.  Besides
+    the startup cost, those broadcasts overlap in flight with the
+    placement transfers and can interleave on the wire.  Every caller
+    here holds the full global value on every process (same seed, same
+    init), so each process can contribute its local shards directly and
+    skip the wire entirely.
+    """
+    if s.is_fully_addressable:
+        return jax.device_put(x, s)
+    x = np.asarray(x)
+    arrs = [
+        jax.device_put(x[idx], d)
+        for d, idx in s.addressable_devices_indices_map(x.shape).items()
+    ]
+    return jax.make_array_from_single_device_arrays(x.shape, s, arrs)
+
+
 def place_state(
     state: TrainState,
     mesh: Mesh,
@@ -704,7 +728,9 @@ def place_state(
     parallelism); optimizer slots and EMA shadows follow their parameters'
     sharding automatically, the analogue of TF slot variables inheriting
     their primary's PS placement (TF optimizer.py:463,
-    device_setter.py:92-125).
+    device_setter.py:92-125).  Placement is collective-free: every
+    process holds the full initial state, so global arrays are assembled
+    from local shards (``_collective_free_put``) rather than broadcast.
     """
     param_sh = shardlib.tree_param_shardings(mesh, state.params, param_rules)
 
@@ -720,30 +746,32 @@ def place_state(
             name = shardlib._path_str(path)
             for pname, s in flat_params.items():
                 if name.endswith(pname) and leaf.ndim == len(s.spec):
-                    return jax.device_put(leaf, s)
-            return jax.device_put(leaf, shardlib.replicated(mesh))
+                    return _collective_free_put(leaf, s)
+            return _collective_free_put(leaf, shardlib.replicated(mesh))
 
         return jax.tree_util.tree_map_with_path(one, tree)
 
     return state.replace(
-        step=jax.device_put(state.step, shardlib.replicated(mesh)),
-        params=jax.tree.map(jax.device_put, state.params, param_sh),
+        step=_collective_free_put(state.step, shardlib.replicated(mesh)),
+        params=jax.tree.map(_collective_free_put, state.params, param_sh),
         batch_stats=jax.tree.map(
-            lambda x: jax.device_put(x, shardlib.replicated(mesh)),
+            lambda x: _collective_free_put(x, shardlib.replicated(mesh)),
             state.batch_stats,
         ),
         opt_state=follow(param_sh, state.opt_state),
         ema_params=(
             None
             if state.ema_params is None
-            else jax.tree.map(jax.device_put, state.ema_params, param_sh)
+            else jax.tree.map(
+                _collective_free_put, state.ema_params, param_sh
+            )
         ),
         # Recurrent carry is batch-major activation state: shard over data.
         carry=(
             None
             if state.carry is None
             else jax.tree.map(
-                lambda x: jax.device_put(
+                lambda x: _collective_free_put(
                     x, shardlib.batch_sharding(mesh, x.ndim)
                 ),
                 state.carry,

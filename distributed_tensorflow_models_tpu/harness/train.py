@@ -1119,6 +1119,12 @@ def fit(
                         cfg.rollback_budget,
                     )
                     raise
+                # _check_chunk_finite's verdict is fleet-agreed (one
+                # allgather per chunk): any host's non-finite loss makes
+                # EVERY host raise on the same chunk, so the fleet enters
+                # this handler together and the rollback's collectives
+                # stay matched.  Fleet-uniform by construction:
+                # dtmlint: disable=collective-order
                 if not _rollback(start, k):
                     raise
                 # Counted only when a rewind actually happened, so the

@@ -68,29 +68,40 @@ class HeartbeatWriter:
         self._interval = max(0.05, float(interval_s))
         self._step = -1  # -1 = process up, training not yet looping
         self._phase = "init"  # restore | compile | train | save | ...
+        # Guards _step/_phase: beat()/set_phase() run on the train loop
+        # while the writer thread snapshots both — without the lock a
+        # set_phase between the two reads can pair step N with the
+        # previous phase (and str/int attribute writes, though atomic in
+        # CPython, carry no cross-thread visibility contract).
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def beat(self, step: int) -> None:
-        self._step = int(step)
+        with self._lock:
+            self._step = int(step)
 
     def set_phase(self, phase: str) -> str:
-        """Record the lifecycle phase (a couple of attribute writes —
-        hot-path safe); returns the previous phase so a scoped setter
-        (the save path) can restore it."""
-        prev, self._phase = self._phase, str(phase)
+        """Record the lifecycle phase (an uncontended lock + attribute
+        write — hot-path safe); returns the previous phase so a scoped
+        setter (the save path) can restore it."""
+        with self._lock:
+            prev, self._phase = self._phase, str(phase)
         return prev
 
     @property
     def phase(self) -> str:
-        return self._phase
+        with self._lock:
+            return self._phase
 
     def _write(self) -> None:
+        with self._lock:
+            step, phase = self._step, self._phase
         payload = {
             "pid": os.getpid(),
             "time": time.time(),
-            "step": self._step,
-            "phase": self._phase,
+            "step": step,
+            "phase": phase,
         }
         path = _path(self.directory, self.process_index)
         tmp = f"{path}.tmp"

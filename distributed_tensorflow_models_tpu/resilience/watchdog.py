@@ -75,6 +75,11 @@ class ProgressWatchdog:
         self._abort = abort
         self._abort_fn = abort_fn or _thread.interrupt_main
         self._poll = poll_s if poll_s is not None else min(1.0, timeout_s / 4)
+        # Guards _last/_last_step/_fired/_beats: beat() runs on the
+        # train loop while _run polls them — snapshotting all four under
+        # one lock keeps "idle since" and "which step" consistent, and
+        # makes beat()'s _fired reset visible before _run re-arms it.
+        self._lock = threading.Lock()
         self._last = time.perf_counter()
         self._last_step: Optional[int] = None
         self._fired = 0  # timeout intervals elapsed in the current stall
@@ -89,11 +94,13 @@ class ProgressWatchdog:
         self._thread.start()
 
     def beat(self, step: Optional[int] = None) -> None:
-        """Record progress (one completed chunk).  Cheap: two writes."""
-        self._last = time.perf_counter()
-        self._last_step = step
-        self._fired = 0
-        self._beats += 1
+        """Record progress (one completed chunk).  Cheap: an
+        uncontended lock and four writes."""
+        with self._lock:
+            self._last = time.perf_counter()
+            self._last_step = step
+            self._fired = 0
+            self._beats += 1
         self._registry.gauge(telemetry.WATCHDOG_LAST_PROGRESS).set(0.0)
 
     def stop(self) -> None:
@@ -103,15 +110,20 @@ class ProgressWatchdog:
     def _run(self) -> None:
         gauge = self._registry.gauge(telemetry.WATCHDOG_LAST_PROGRESS)
         while not self._stop.wait(self._poll):
-            idle = time.perf_counter() - self._last
+            with self._lock:
+                idle = time.perf_counter() - self._last
+                last_step = self._last_step
+                beats = self._beats
+                intervals = int(idle // self._timeout)
+                stalled = intervals > self._fired
+                if stalled:
+                    self._fired = intervals
             gauge.set(idle)
-            intervals = int(idle // self._timeout)
-            if intervals <= self._fired:
+            if not stalled:
                 continue
-            self._fired = intervals
             at = (
-                f"after step {self._last_step}"
-                if self._last_step is not None
+                f"after step {last_step}"
+                if last_step is not None
                 else "before the first step"
             )
             log.error(
@@ -122,7 +134,7 @@ class ProgressWatchdog:
                 self._timeout,
                 at,
             )
-            if self._abort and intervals >= 2 and self._beats > 0:
+            if self._abort and intervals >= 2 and beats > 0:
                 log.error(
                     "watchdog: aborting stalled run (interval %d)", intervals
                 )

@@ -43,6 +43,7 @@ from analysis.dtmlint import (  # noqa: E402
     load_baseline,
     repo_config,
     run,
+    run_cached,
     strict_config,
     write_baseline,
 )
@@ -122,6 +123,16 @@ def main(argv=None) -> int:
         "interprocedural rules keep full context.  Falls back to the "
         "full tree when git is unavailable.",
     )
+    ap.add_argument(
+        "--no-cache", action="store_true",
+        help="bypass the incremental result cache (.dtmlint_cache/) "
+        "and re-analyze every file",
+    )
+    ap.add_argument(
+        "--stats", action="store_true",
+        help="report cache effectiveness and per-rule timings "
+        "(with --json: a 'stats' block in the output)",
+    )
     args = ap.parse_args(argv)
 
     only = _split(args.only) or None
@@ -158,10 +169,25 @@ def main(argv=None) -> int:
                 )
             else:
                 restrict = changed & set(config.files)
-        result = run(
-            config, only=only, disable=disable, baseline=baseline,
-            restrict_paths=restrict,
-        )
+        # The cache only understands full default-rule whole-tree runs:
+        # a stored finding list is meaningless under --only/--disable,
+        # and strict mode / --write-baseline want the direct engine.
+        stats = None
+        if (
+            not args.paths
+            and only is None
+            and not disable
+            and not args.write_baseline
+        ):
+            result, stats = run_cached(
+                config, baseline=baseline, restrict_paths=restrict,
+                use_cache=not args.no_cache,
+            )
+        else:
+            result = run(
+                config, only=only, disable=disable, baseline=baseline,
+                restrict_paths=restrict,
+            )
         if args.write_baseline:
             if args.paths:
                 raise LintError(
@@ -179,7 +205,10 @@ def main(argv=None) -> int:
         return 2
 
     if args.as_json:
-        print(json.dumps(result.to_json(), indent=2))
+        payload = result.to_json()
+        if args.stats and stats is not None:
+            payload["stats"] = stats.to_json()
+        print(json.dumps(payload, indent=2))
     else:
         for f in result.new:
             print(f.render())
@@ -204,6 +233,17 @@ def main(argv=None) -> int:
         if result.stale_baseline:
             summary += f", {len(result.stale_baseline)} stale baseline entries"
         print(summary)
+        if args.stats:
+            if stats is not None:
+                print(stats.render())
+            slow = sorted(
+                result.timings.items(), key=lambda kv: -kv[1]
+            )[:5]
+            if slow:
+                print(
+                    "rule timings: "
+                    + ", ".join(f"{r} {t:.3f}s" for r, t in slow)
+                )
     return 1 if result.new else 0
 
 

@@ -1,0 +1,20 @@
+"""Known-bad: order-divergent collective sequences (three shapes)."""
+
+
+def sync_shards(consensus, shards, is_chief):
+    for name in set(shards):
+        consensus.broadcast_int(len(name))
+    total = 0
+    for step, _shard in enumerate(shards):
+        if is_chief:
+            if step % 2:
+                continue
+        total += consensus.allgather_int(step)[0]
+    return total
+
+
+def report(consensus, value):
+    try:
+        return consensus.broadcast_int(value)
+    except OSError:
+        return consensus.broadcast_int(-1)

@@ -1,0 +1,33 @@
+"""Known-bad: resources that leak on the exception path (or always)."""
+import shutil
+import signal
+import tempfile
+import threading
+
+
+def stage_one(src):
+    f = open(src)
+    data = f.read()
+    return data
+
+
+def stage_two(transform, src, dst):
+    d = tempfile.mkdtemp()
+    shutil.copy(transform(src, d), dst)
+    shutil.rmtree(d)
+    return dst
+
+
+def stage_three(pump, fd):
+    if threading.current_thread() is not threading.main_thread():
+        raise RuntimeError("wakeup fd only works on the main thread")
+    old = signal.set_wakeup_fd(fd)
+    pump(fd)
+    signal.set_wakeup_fd(old)
+
+
+def stage_four(work):
+    t = threading.Thread(target=work, daemon=False)
+    t.start()
+    work()
+    t.join()

@@ -1,0 +1,19 @@
+"""Known-bad: main-thread write races the worker thread's read."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            print(self._count)
+
+    def beat(self):
+        self._count += 1
+
+    def stop(self):
+        self._thread.join()

@@ -1,0 +1,21 @@
+"""Good twin: sorted iteration, failure agreed on outside the handler."""
+
+
+def sync_shards(consensus, shards, is_chief):
+    for name in sorted(set(shards)):
+        consensus.broadcast_int(len(name))
+    total = 0
+    for step, _shard in enumerate(shards):
+        if is_chief and step % 2:
+            continue
+        total += step
+    consensus.allgather_int(total)
+    return total
+
+
+def report(consensus, value):
+    try:
+        ok = int(value)
+    except (TypeError, ValueError):
+        ok = -1
+    return consensus.broadcast_int(ok)

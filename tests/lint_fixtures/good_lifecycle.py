@@ -1,0 +1,38 @@
+"""Good twin: with-managed, finally-released, teardown-guarded."""
+import shutil
+import signal
+import tempfile
+import threading
+
+
+def stage_one(src):
+    with open(src) as f:
+        return f.read()
+
+
+def stage_two(transform, src, dst):
+    d = tempfile.mkdtemp()
+    try:
+        shutil.copy(transform(src, d), dst)
+        return dst
+    finally:
+        shutil.rmtree(d)
+
+
+def stage_three(pump, fd):
+    if threading.current_thread() is not threading.main_thread():
+        raise RuntimeError("wakeup fd only works on the main thread")
+    old = signal.set_wakeup_fd(fd)
+    try:
+        pump(fd)
+    finally:
+        signal.set_wakeup_fd(old)
+
+
+def stage_four(work):
+    t = threading.Thread(target=work, daemon=False)
+    t.start()
+    try:
+        work()
+    finally:
+        t.join()

@@ -1,0 +1,22 @@
+"""Good twin: the same shape with a lock held at both sites."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._count = 0
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                print(self._count)
+
+    def beat(self):
+        with self._lock:
+            self._count += 1
+
+    def stop(self):
+        self._thread.join()

@@ -1,0 +1,11 @@
+"""Known-bad: hard-coded axis literals that no AxisNames declares."""
+
+
+class AxisNamesLocal:
+    DATA = "data"
+    MODEL = "model"
+
+
+def reduce_all(lax, x):
+    y = lax.psum(x, axis_name="modle")
+    return lax.all_gather(y, "batch")

@@ -1,0 +1,11 @@
+"""Good twin: declared literals or the AxisNames constants themselves."""
+
+
+class AxisNamesLocal:
+    DATA = "data"
+    MODEL = "model"
+
+
+def reduce_all(lax, x):
+    y = lax.psum(x, axis_name=AxisNamesLocal.DATA)
+    return lax.all_gather(y, "model")

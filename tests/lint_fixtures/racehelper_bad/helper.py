@@ -1,0 +1,5 @@
+"""Helper that mutates the counter it is handed."""
+
+
+def bump(counter):
+    counter.total += 1

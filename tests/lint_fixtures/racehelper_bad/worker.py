@@ -1,0 +1,21 @@
+"""Known-bad: the racing write hides in a helper the thread calls."""
+import threading
+
+import helper
+
+
+class Counter:
+    def __init__(self):
+        self.total = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            helper.bump(self)
+
+    def read(self):
+        return self.total
+
+    def stop(self):
+        self._thread.join()

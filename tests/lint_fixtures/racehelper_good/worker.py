@@ -1,0 +1,23 @@
+"""Good twin: the thread's write is event-mediated; the helper reads."""
+import threading
+
+import helper
+
+
+class Counter:
+    def __init__(self):
+        self.total = 0
+        self._done = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self.total = helper.snapshot(self) + 41
+        self._done.set()
+
+    def read(self):
+        self._done.wait()
+        return self.total
+
+    def stop(self):
+        self._thread.join()

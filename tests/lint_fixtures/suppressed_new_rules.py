@@ -1,0 +1,5 @@
+"""Unused suppressions naming the v3 rules must be reported."""
+
+X = 1  # dtmlint: disable=shared-state-race
+Y = 2  # dtmlint: disable=collective-order
+Z = 3  # dtmlint: disable=resource-lifecycle

@@ -1,0 +1,19 @@
+"""A used suppression of a v3 rule silences the finding completely."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            print(self._count)
+
+    def beat(self):
+        self._count += 1  # dtmlint: disable=shared-state-race
+
+    def stop(self):
+        self._thread.join()

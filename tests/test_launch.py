@@ -5,6 +5,7 @@ SURVEY.md §4).
 """
 
 import os
+import socket
 import subprocess
 import sys
 import textwrap
@@ -12,6 +13,42 @@ import textwrap
 import pytest
 
 from distributed_tensorflow_models_tpu import launch
+
+
+def _free_port() -> int:
+    """An OS-assigned free port for the coordinator.  Fixed ports
+    crosstalk: a gloo store left in TIME_WAIT by one two-proc test (or
+    a concurrent pytest worker) makes the next bind flake.  Bind port
+    0, read what the kernel picked, release it — the window between
+    release and the launcher's re-bind is tiny and randomized, unlike
+    a constant shared by every run on the machine."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_two(argv, *, attempts=3, **kwargs):
+    """``launch_local(2, ...)`` on a fresh port, retried (bounded) when
+    the *whole fleet* dies by signal.  The dominant flake here was
+    in-flight gloo collectives interleaving on a shared pair during
+    startup placement (``op.preamble.length <= op.nbytes`` SIGABRT —
+    a small metadata broadcast colliding with a whole-tensor one);
+    that is fixed at the root by collective-free ``place_state``
+    (``core/train_loop._collective_free_put``).  The retry stays as
+    insurance against residual gloo data-plane races, which kill the
+    fleet before user code runs — every exit code negative.  A real
+    failure (worker assertion, Python exception) exits with a
+    *positive* code and is reported immediately, never retried."""
+    codes = []
+    for _ in range(attempts):
+        codes = launch.launch_local(
+            2, argv, port=_free_port(), **kwargs
+        )
+        if not all(c < 0 for c in codes):
+            return codes
+    return codes
+
 
 WORKER = textwrap.dedent(
     """
@@ -59,10 +96,8 @@ def test_two_process_localhost_cluster_psum(tmp_path):
     )
     script.write_text(WORKER.format(repo=repo, marker=marker))
 
-    codes = launch.launch_local(
-        2,
+    codes = _launch_two(
         [sys.executable, str(script)],
-        port=9753,
         cpu_devices_per_process=2,
         timeout=240,
     )
@@ -182,10 +217,8 @@ def test_two_process_fit_matches_single_process(tmp_path):
             repo=repo, workdir=str(tmp_path / "multi"), out=out
         )
     )
-    codes = launch.launch_local(
-        2,
+    codes = _launch_two(
         [sys.executable, str(script)],
-        port=9761,
         cpu_devices_per_process=2,
         timeout=300,
     )
@@ -310,10 +343,8 @@ def test_two_process_fit_on_file_sharded_tfrecords(tmp_path):
             out=out,
         )
     )
-    codes = launch.launch_local(
-        2,
+    codes = _launch_two(
         [sys.executable, str(script)],
-        port=9767,
         cpu_devices_per_process=2,
         timeout=600,
     )
