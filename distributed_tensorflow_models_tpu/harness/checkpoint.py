@@ -32,6 +32,21 @@ with cross-host visibility skew (object stores, replicated NFS) cannot
 put two processes into different collectives.  A follower whose local
 view disagrees obeys the chief, logs the skew, and counts it into
 ``fleet/consensus_overrides``.
+
+Elastic resize (cross-topology resume): every save stamps the writing
+fleet's process count into the orbax JSON item and each sidecar.  A
+restore whose live process count differs reshards the global arrays
+onto the live mesh (:func:`restore_abstract_tree` builds the abstract
+targets from the LIVE template's shardings) and re-splits the dataset
+cursor with the conservative fleet-minimum rule (``data/resplit.py``):
+every new process resumes from the smallest saved position — re-reading
+at most one in-flight chunk per host, never skipping an untrained
+batch.  The source pick is fleet-agreed via consensus *after* the walk
+settles on a candidate (see ``_finalize_resize`` — a broadcast inside
+the per-candidate restore would desync the collective order whenever a
+peer's restore throws), counted into ``checkpoint/resize_restores``,
+and audited by a chief-written ``resize_ledger.json`` next to the
+crossing step's sidecars.
 """
 
 from __future__ import annotations
@@ -47,6 +62,7 @@ import orbax.checkpoint as ocp
 
 from distributed_tensorflow_models_tpu import telemetry
 from distributed_tensorflow_models_tpu.core.train_state import TrainState
+from distributed_tensorflow_models_tpu.data import resplit as resplitlib
 from distributed_tensorflow_models_tpu.resilience import consensus as conslib
 from distributed_tensorflow_models_tpu.resilience import fsck as fscklib
 
@@ -59,6 +75,16 @@ _SAVE_PROCEED = 0
 _SAVE_SKIP_INFLIGHT = 1
 _SAVE_SKIP_EXISTS = 2
 _SAVE_REPLACE = 3
+
+# Reserved key stamped into the orbax JSON ``data`` item at save time so
+# a restore knows the writing fleet's topology even before it looks at
+# sidecars (and for single-process runs, which write none).  Stripped on
+# restore — the train harness never sees it.
+_FLEET_META_KEY = "__fleet__"
+
+# Name of the re-split audit artifact the chief writes next to the
+# crossing step's sidecars (see CheckpointManager._write_resize_ledger).
+RESIZE_LEDGER = "resize_ledger.json"
 
 
 class NoValidCheckpointError(FileNotFoundError):
@@ -77,6 +103,29 @@ def _array_tree(state: TrainState) -> dict:
         "ema_params": state.ema_params,
         "carry": state.carry,
     }
+
+
+def restore_abstract_tree(template: TrainState) -> dict:
+    """Abstract restore targets (shape/dtype/sharding) for ``template``.
+
+    The shardings come from the LIVE template — the state the caller
+    just built on *this* run's mesh — never from anything recorded in
+    the checkpoint.  Checkpointed shapes are global, so this is the
+    whole elastic-resize story on the array side: a checkpoint written
+    by an N-process fleet restores onto an M-process mesh because orbax
+    is told to materialise each global array under the new mesh's
+    sharding and reshards at read time.  Pulling shardings from the
+    *saved* topology instead would pin restore to the writing fleet's
+    device set — exactly the fixed-topology assumption this replaces.
+    """
+
+    def as_abstract(x):
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and hasattr(x, "shape"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+        return ocp.utils.to_shape_dtype_struct(x)
+
+    return jax.tree.map(as_abstract, _array_tree(template))
 
 
 class CheckpointManager:
@@ -137,10 +186,32 @@ class CheckpointManager:
         # fences" (the healthy reading) would be indistinguishable from
         # "fence not instrumented".
         self._registry.timer(telemetry.CKPT_FENCE)
+        # Same zero-vs-missing argument for the degraded-resume counters:
+        # both record only on warning paths, and zero is the healthy
+        # reading the schema-coverage gate must be able to see.
+        self._registry.counter(telemetry.CKPT_SIDECAR_FALLBACKS)
+        self._registry.counter(telemetry.CKPT_RESIZE_RESTORES)
+        # Cross-topology restore bookkeeping: _pending_resize is staged
+        # by _restore_step (local, deterministic) and resolved by
+        # _finalize_resize AFTER the walk has fleet-agreed on the
+        # candidate — the consensus broadcast must not live inside
+        # _restore_step, where one host may throw (torn/unrestorable)
+        # while peers proceed, desyncing the collective order.
+        self._pending_resize: Optional[dict] = None
+        self._last_resize: Optional[dict] = None
 
     @property
     def consensus(self) -> conslib.Consensus:
         return self._consensus
+
+    @property
+    def last_resize(self) -> Optional[dict]:
+        """Details of the cross-topology re-split the most recent
+        restore performed (``{"step", "from_nproc", "to_nproc",
+        "source_pid"}``), or None when the restore was same-shape.  The
+        train harness reads this to announce the crossing and drop a
+        flight record on every host."""
+        return self._last_resize
 
     def _visible_steps(self) -> list[int]:
         steps: Sequence[int] = sorted(self._mgr.all_steps())
@@ -261,12 +332,17 @@ class CheckpointManager:
         # still finishes in the background; wait()/close() (teardown,
         # emergency, rollback) remain the explicit durability points.
         self.fence()
+        # Topology stamp: restore reads this (and strips it) to detect a
+        # fleet coming back with a different process count — including
+        # single-process runs, which write no sidecars to stamp.
+        payload = dict(dataset_state or {})
+        payload[_FLEET_META_KEY] = {"nproc": self._nproc}
         with self._registry.span(telemetry.CKPT_SAVE):
             saved = self._mgr.save(
                 step,
                 args=ocp.args.Composite(
                     state=ocp.args.StandardSave(_array_tree(state)),
-                    data=ocp.args.JsonSave(dataset_state or {}),
+                    data=ocp.args.JsonSave(payload),
                 ),
                 force=force,
             )
@@ -343,7 +419,7 @@ class CheckpointManager:
         path adds the gate in :func:`restore_or_init`."""
         if step is None:
             return self.restore_newest_valid(template)
-        return self._restore_step(template, step)
+        return self._finalize_resize(self._restore_step(template, step))
 
     def restore_newest_valid(
         self,
@@ -423,7 +499,7 @@ class CheckpointManager:
                     "(newer candidates torn/unrestorable/rejected)",
                     step, candidates[0],
                 )
-            return out
+            return self._finalize_resize(out)
         raise NoValidCheckpointError(
             f"no valid checkpoint among steps {candidates} under "
             f"{self._dir}"
@@ -448,10 +524,15 @@ class CheckpointManager:
             for s in sorted(self._visible_steps(), reverse=True)
             if not fscklib.validate_step_dir(self._step_dir(s))
         ]
+        # A step whose sidecar set is complete for its *stamped* topology
+        # clears the same bar even when that topology differs from the
+        # live fleet: every writing process's cursor is on disk, so the
+        # cross-topology re-split can resume it without skipping a batch.
         complete = [
             s
             for s in structural
             if fscklib.fleet_sidecars_complete(self._dir, s, self._nproc)
+            or fscklib.stamped_topology(self._dir, s) is not None
         ]
         done = set(complete)
         return complete + [s for s in structural if s not in done]
@@ -527,14 +608,19 @@ class CheckpointManager:
                     "(newer candidates torn/unrestorable/rejected/"
                     "sidecar-incomplete)", step, newest,
                 )
-            return out
+            # Consensus point: every process reached the same accepted
+            # candidate (failure/rejection fleet-agreed above), so the
+            # re-split pick broadcast below is in lockstep.
+            return self._finalize_resize(out)
 
     def _restore_step(
         self, template: TrainState, step: int
     ) -> tuple[TrainState, dict]:
-        abstract = jax.tree.map(
-            ocp.utils.to_shape_dtype_struct, _array_tree(template)
-        )
+        # A previous walk candidate may have staged a re-split and then
+        # been discarded (peer restore failure); never let it leak into
+        # this candidate's finalize.
+        self._pending_resize = None
+        abstract = restore_abstract_tree(template)
         with self._registry.span(telemetry.CKPT_RESTORE):
             out = self._mgr.restore(
                 step,
@@ -553,7 +639,23 @@ class CheckpointManager:
             carry=tree["carry"],
         )
         data = dict(out.data or {})
-        if self._nproc > 1:
+        meta = data.pop(_FLEET_META_KEY, None)
+        saved_nproc: Optional[int] = None
+        if isinstance(meta, dict):
+            try:
+                saved_nproc = int(meta["nproc"])
+            except (KeyError, TypeError, ValueError):
+                saved_nproc = None
+        if saved_nproc is None:
+            # Pre-stamp checkpoint: fall back to the sidecar set's
+            # stamped topology (None again for a genuinely unstamped
+            # single-process or legacy layout).  The orbax meta is the
+            # authoritative detector — every host reads the same JSON,
+            # so crossing detection cannot skew across the fleet.
+            saved_nproc = fscklib.stamped_topology(self._dir, step)
+        if saved_nproc is not None and saved_nproc != self._nproc:
+            data = self._prepare_resize(step, saved_nproc, data)
+        elif self._nproc > 1:
             path = self._sidecar(step)
             wrapped = None
             missing_why = "no per-process dataset sidecar"
@@ -574,13 +676,25 @@ class CheckpointManager:
                     missing_why,
                     path,
                 )
+                self._registry.counter(
+                    telemetry.CKPT_SIDECAR_FALLBACKS
+                ).inc()
             elif "nproc" not in wrapped:
                 # Legacy bare-dict sidecar (pre-topology-stamp): same
-                # format, assume same topology.
+                # format, assume same topology — and stamp-and-rewrite
+                # the file so the unstamped format cannot survive into a
+                # later resize undetected (an unstamped sidecar is
+                # invisible to stamped_topology and would silently
+                # degrade a crossing to the primary's position).
                 data = wrapped
+                self._stamp_legacy_sidecar(path, wrapped)
             elif wrapped["nproc"] == self._nproc:
                 data = wrapped["state"]
             else:
+                # Stamp says a different topology than both the live
+                # fleet and the orbax meta (mixed/partial sidecar set):
+                # degrade like a missing sidecar rather than adopt a
+                # wrong-shard position.
                 log.warning(
                     "dataset sidecar at %s is from a %s-process run, not "
                     "%d; using the primary's position (approximate resume)",
@@ -588,7 +702,160 @@ class CheckpointManager:
                     wrapped["nproc"],
                     self._nproc,
                 )
+                self._registry.counter(
+                    telemetry.CKPT_SIDECAR_FALLBACKS
+                ).inc()
         return state, data
+
+    def _stamp_legacy_sidecar(self, path: str, bare_state: dict) -> None:
+        """Rewrite a legacy bare-dict sidecar in the stamped format
+        (atomic, best-effort — failing to upgrade an auxiliary file must
+        never fail the restore that read it fine)."""
+        try:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({"nproc": self._nproc, "state": bare_state}, f)
+            os.replace(tmp, path)
+            log.info(
+                "stamped legacy dataset sidecar %s with nproc=%d",
+                path, self._nproc,
+            )
+        except OSError as e:  # noqa: BLE001 — upgrade is advisory
+            log.warning("could not stamp legacy sidecar %s (%s)", path, e)
+
+    def _read_sidecar_state(self, step: int, pid: int) -> Optional[dict]:
+        """One saved process's dataset state at ``step`` (unwrapped;
+        handles both stamped and legacy shapes), or None."""
+        try:
+            with open(self._sidecar(step, pid)) as f:
+                wrapped = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(wrapped, dict):
+            return None
+        if "nproc" in wrapped:
+            state = wrapped.get("state")
+            return state if isinstance(state, dict) else None
+        return wrapped
+
+    def _prepare_resize(
+        self, step: int, saved_nproc: int, primary: dict
+    ) -> dict:
+        """Stage the cross-topology dataset re-split for this candidate.
+
+        Local and deterministic only: reads the writing fleet's sidecars
+        and computes the fleet-minimum pick (``data/resplit.py``).  The
+        consensus broadcast, counters, and ledger happen in
+        :meth:`_finalize_resize`, after the walk has agreed this
+        candidate is the one — a broadcast here would be reached by a
+        subset of hosts whenever a peer's restore throws.
+        """
+        states: dict = {}
+        for pid in range(saved_nproc):
+            state = self._read_sidecar_state(step, pid)
+            if state is not None:
+                states[pid] = state
+        local_pick = resplitlib.pick_source(states)
+        self._pending_resize = {
+            "step": step,
+            "from_nproc": saved_nproc,
+            "states": states,
+            "local_pick": local_pick,
+            "primary": primary,
+        }
+        return primary if local_pick < 0 else states[local_pick]
+
+    def _finalize_resize(
+        self, out: tuple[TrainState, dict]
+    ) -> tuple[TrainState, dict]:
+        """Resolve a staged cross-topology re-split on the accepted
+        candidate: fleet-agree the source pid (chief broadcasts, exact
+        no-op single-process), adopt that sidecar's cursor everywhere,
+        count + trace the crossing, and have the chief write the audit
+        ledger.  Identity for same-shape restores (nothing staged)."""
+        pend, self._pending_resize = self._pending_resize, None
+        self._last_resize = None
+        if pend is None:
+            return out
+        step = pend["step"]
+        pick = pend["local_pick"]
+        if self._consensus.active:
+            pick = self._agree_int(pick, f"resize-pick@{step}")
+        self._registry.counter(telemetry.CKPT_RESIZE_RESTORES).inc()
+        self._registry.trace.instant(
+            "checkpoint/resize_restore",
+            {
+                "step": step,
+                "from_nproc": pend["from_nproc"],
+                "to_nproc": self._nproc,
+                "source_pid": pick,
+            },
+        )
+        state = pend["states"].get(pick) if pick >= 0 else None
+        if state is None and pick >= 0:
+            # The chief picked a sidecar this host failed to read
+            # (visibility skew); the pick names a file, so retry the
+            # read rather than silently diverge from the fleet.
+            state = self._read_sidecar_state(step, pick)
+        if state is None:
+            log.warning(
+                "cross-topology restore at step %d (%d -> %d processes): "
+                "no usable dataset cursor among the saved sidecars; "
+                "using the primary's position (approximate resume)",
+                step, pend["from_nproc"], self._nproc,
+            )
+            self._registry.counter(telemetry.CKPT_SIDECAR_FALLBACKS).inc()
+            data = pend["primary"]
+        else:
+            log.warning(
+                "CROSS-TOPOLOGY RESTORE at step %d: checkpoint written "
+                "by %d process(es), restoring onto %d — dataset cursor "
+                "re-split to the fleet-minimum safe position (source "
+                "sidecar p%d); at most one in-flight chunk per host is "
+                "re-read and no untrained batch is skipped",
+                step, pend["from_nproc"], self._nproc, pick,
+            )
+            data = state
+        self._last_resize = {
+            "step": step,
+            "from_nproc": pend["from_nproc"],
+            "to_nproc": self._nproc,
+            "source_pid": pick,
+        }
+        if self._pid == 0:
+            self._write_resize_ledger(pend, pick)
+        return out[0], data
+
+    def _write_resize_ledger(self, pend: dict, pick: int) -> None:
+        """Audit artifact for the crossing (chief only, atomic,
+        best-effort): every saved pid's cursor position, the agreed
+        source, and the adopted position — the proof, checkable after
+        the fact, that the resume point was <= every saved position,
+        i.e. that no untrained batch was skipped."""
+        step = pend["step"]
+        base = os.path.join(self._dir, "dataset_states", str(step))
+        adopted = resplitlib.cursor_position(pend["states"].get(pick))
+        ledger = dict(resplitlib.describe_positions(pend["states"]))
+        ledger.update(
+            {
+                "step": step,
+                "from_nproc": pend["from_nproc"],
+                "to_nproc": self._nproc,
+                "source_pid": pick,
+                "adopted_position": (
+                    list(adopted) if adopted is not None else None
+                ),
+            }
+        )
+        try:
+            os.makedirs(base, exist_ok=True)
+            path = os.path.join(base, RESIZE_LEDGER)
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(ledger, f, indent=1)
+            os.replace(tmp, path)
+        except OSError as e:  # noqa: BLE001 — audit trail is advisory
+            log.warning("could not write resize ledger at %s (%s)", base, e)
 
     def is_saving(self) -> bool:
         """True while a previously dispatched async save is still being
@@ -677,5 +944,17 @@ def restore_or_init(
             "--repair to clear the torn steps", e,
         )
         return template, {}, False
+    resize = manager.last_resize
+    if resize is not None:
+        log.warning(
+            "RESUMING ACROSS A FLEET RESIZE: checkpoint at step %d was "
+            "written by %d process(es), this fleet has %d — arrays were "
+            "resharded onto the live mesh and the dataset cursor was "
+            "re-split (source sidecar p%d; see %s in the step's "
+            "dataset_states dir).  Same-shape guarantees do not apply: "
+            "the post-resize trajectory is equivalent, not bit-identical.",
+            resize["step"], resize["from_nproc"], resize["to_nproc"],
+            resize["source_pid"], RESIZE_LEDGER,
+        )
     log.info("restored checkpoint at step %d", int(state.step))
     return state, data, True

@@ -550,6 +550,13 @@ def fit(
         state, data_state, restored = ckptlib.restore_or_init(manager, state)
         if restored:
             state = _place(state)
+        if restored and manager.last_resize is not None:
+            # Crossing a fleet resize is incident-grade: drop a flight
+            # record on EVERY host so both sides of the crossing are
+            # reconstructable from the recorder alone, and put the
+            # resize facts on this host's timeline.
+            tracer.instant("fit/resize_restore", dict(manager.last_resize))
+            _dump_flight("resize_restore")
         # Startup restore wall (incl. the re-placement): one of the two
         # restart-MTTR terms the goodput report's "startup" section
         # carries.

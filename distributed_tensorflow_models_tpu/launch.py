@@ -390,6 +390,8 @@ def supervise_local(
     backoff_max_s: float = 60.0,
     seed: int = 0,
     port: int = DEFAULT_PORT,
+    resize_to: int | None = None,
+    auto_resize: bool = False,
     **launch_kwargs,
 ) -> int:
     """``launch_local`` under the fleet restart loop: a fleet torn down
@@ -412,16 +414,34 @@ def supervise_local(
     visible at the supervisor without opening the workdir; the precise
     per-process numbers are the ``startup`` section of each run's
     ``telemetry.json``.
+
+    Elastic resize: ``resize_to=M`` relaunches every restart at M
+    processes instead of N — the children's cross-topology restore
+    (``harness/checkpoint.py``) reshards the arrays onto the new mesh
+    and re-splits the dataset cursor, so a fleet that lost (or gained)
+    capacity keeps training instead of crash-looping at a process count
+    it can no longer field.  ``auto_resize=True`` shrinks the fleet by
+    the number of distinct failed processes on each relaunch (floor 1)
+    — the "capacity is not coming back" mode for preemptible hosts.
+    Both compose with the persistent XLA compile cache / AOT startup
+    path: the surviving hosts' caches hold the per-shard programs, so a
+    resized relaunch pays a reshard, not a cold compile, when the new
+    shapes were seen before.  The children must still satisfy the batch
+    contract (global batch divisible by the new process and device
+    counts) — pick M accordingly.
     """
     import time
 
     from distributed_tensorflow_models_tpu.resilience import backoff
 
+    if resize_to is not None and resize_to < 1:
+        raise ValueError(f"resize_to must be >= 1, got {resize_to}")
     attempt = 0
+    cur_procs = num_processes
     while True:
         stats: dict = {}
         codes = launch_local(
-            num_processes, argv, port=port + attempt,
+            cur_procs, argv, port=port + attempt,
             startup_stats=stats, **launch_kwargs
         )
         if stats:
@@ -459,11 +479,27 @@ def supervise_local(
         delay = backoff.restart_backoff(
             attempt, base_s=backoff_base_s, max_s=backoff_max_s, seed=seed
         )
+        next_procs = cur_procs
+        if resize_to is not None:
+            next_procs = resize_to
+        elif auto_resize:
+            # Treat each distinct failed process as capacity that is not
+            # coming back; the resized fleet resumes cross-topology.
+            next_procs = max(1, cur_procs - len(failed))
+        if next_procs != cur_procs:
+            sys.stderr.write(
+                f"--- fleet: RESIZING {cur_procs} -> {next_procs} "
+                "process(es) on relaunch; children resume across the "
+                "topology change (arrays resharded, dataset cursor "
+                "re-split to the fleet-minimum position) ---\n"
+            )
+            cur_procs = next_procs
         sys.stderr.write(
             f"--- fleet: process(es) {sorted(failed)} failed "
             f"(exit codes {failed}); relaunching the whole fleet in "
             f"{delay:.2f}s (restart {attempt}/{max_restarts}, "
-            f"coordinator port {port + attempt}) ---\n"
+            f"coordinator port {port + attempt}, {cur_procs} "
+            "process(es)) ---\n"
         )
         time.sleep(delay)
 
@@ -505,6 +541,21 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="localhost mode: relaunch the whole fleet (auto-resuming "
         "from checkpoints) up to N times after a real failure — the "
         "fleet-level recoverable_fit (0 = launch once)",
+    )
+    parser.add_argument(
+        "--resize-to",
+        type=int,
+        default=None,
+        help="localhost mode, with --max-restarts: relaunch at this "
+        "process count after a failure (elastic resize; children "
+        "resume across the topology change from the latest checkpoint)",
+    )
+    parser.add_argument(
+        "--auto-resize",
+        action="store_true",
+        help="localhost mode, with --max-restarts: shrink the fleet by "
+        "the number of failed processes on each relaunch (floor 1) — "
+        "assume lost capacity is not coming back",
     )
     parser.add_argument(
         "--heartbeat-timeout",
@@ -549,9 +600,16 @@ def main(argv: Sequence[str] | None = None) -> int:
                 command,
                 max_restarts=args.max_restarts,
                 port=int(port_str),
+                resize_to=args.resize_to,
+                auto_resize=args.auto_resize,
                 cpu_devices_per_process=args.cpu_devices_per_process,
                 heartbeat_timeout=args.heartbeat_timeout,
                 term_grace_s=args.term_grace,
+            )
+        if args.resize_to is not None or args.auto_resize:
+            parser.error(
+                "--resize-to/--auto-resize only apply to the restart "
+                "loop; add --max-restarts N"
             )
         codes = launch_local(
             args.num_processes,

@@ -110,11 +110,69 @@ def sidecar_issues(
     if process_count is not None:
         missing = [p for p in range(process_count) if p not in present]
         if missing:
-            issues.append(
-                "missing peer sidecar(s) for process(es) "
-                f"{missing} (step is not fleet-valid)"
-            )
+            stamped = stamped_topology(ckpt_dir, step)
+            if stamped is not None and stamped != process_count:
+                issues.append(
+                    f"sidecar set is complete for a {stamped}-process "
+                    f"topology, not {process_count} (cross-topology "
+                    "resume candidate: restore re-splits the dataset "
+                    "cursor)"
+                )
+            else:
+                issues.append(
+                    "missing peer sidecar(s) for process(es) "
+                    f"{missing} (step is not fleet-valid)"
+                )
     return issues
+
+
+def sidecar_stamps(ckpt_dir: str, step: int) -> dict:
+    """``{pid: topology stamp}`` for every *parseable* sidecar at
+    ``step``.  The stamp is the ``nproc`` the writing fleet recorded
+    (None for a legacy bare-dict sidecar that predates the stamp)."""
+    base = os.path.join(ckpt_dir, "dataset_states", str(step))
+    if not os.path.isdir(base):
+        return {}
+    stamps: dict = {}
+    for name in os.listdir(base):
+        if not (name.startswith("p") and name.endswith(".json")):
+            continue
+        try:
+            pid = int(name[1:-5])
+        except ValueError:
+            continue
+        try:
+            with open(os.path.join(base, name)) as f:
+                wrapped = json.load(f)
+        except (OSError, ValueError):
+            continue
+        stamps[pid] = (
+            wrapped.get("nproc") if isinstance(wrapped, dict) else None
+        )
+    return stamps
+
+
+def stamped_topology(ckpt_dir: str, step: int) -> Optional[int]:
+    """The process count N the step's sidecar set was written by, when
+    that is unambiguous: all parseable sidecars carry the same ``nproc``
+    stamp N and every pid in ``range(N)`` is present.  Returns None for
+    legacy/unstamped, mixed-stamp, or incomplete sets.
+
+    This is how an elastic resume picks restore candidates: a step whose
+    sidecar set is complete *for its stamped topology* has every old
+    process's cursor on disk, so the fleet-minimum re-split can map it
+    onto any new process count without skipping a batch — even though
+    the step is not fleet-valid for the live ``process_count``."""
+    stamps = sidecar_stamps(ckpt_dir, step)
+    values = set(stamps.values())
+    if len(values) != 1:
+        return None
+    (n,) = values
+    if not isinstance(n, int) or n < 1:
+        return None
+    if not all(p in stamps for p in range(n)):
+        return None
+    return n
 
 
 def sidecar_presence(ckpt_dir: str, step: int) -> list[int]:
@@ -159,7 +217,8 @@ def fsck_checkpoints(
     """Sweep every step under an orbax checkpoint root.
 
     Returns ``{"steps": [{"step", "valid", "issues", "sidecar_issues",
-    "sidecar_procs", "fleet_valid"}, ...] (ascending), "latest_step",
+    "sidecar_procs", "sidecar_nproc", "complete_for_nproc",
+    "fleet_valid"}, ...] (ascending), "latest_step",
     "newest_valid_step", "newest_fleet_valid_step"}`` —
     ``newest_valid_step`` is what a hardened single-process restore
     would pick (differs from ``latest_step`` exactly when the restore
@@ -167,7 +226,11 @@ def fsck_checkpoints(
     parseable dataset sidecar; ``fleet_valid`` (and the newest-such
     summary) additionally requires, when ``process_count`` is given,
     every peer's sidecar — the bar a multi-host chief-decides restore
-    prefers.
+    prefers.  ``sidecar_nproc`` maps each parseable sidecar pid to its
+    topology stamp (None = legacy unstamped) and ``complete_for_nproc``
+    is the stamped topology the set is complete for (None when
+    ambiguous) — a step complete for a *different* count than the live
+    fleet is a cross-topology resume candidate, not a torn one.
     """
     steps: list[int] = []
     if os.path.isdir(ckpt_dir):
@@ -186,6 +249,7 @@ def fsck_checkpoints(
         # One parse pass feeds both fields (remote checkpoint roots make
         # repeated sidecar reads the sweep's dominant cost).
         procs = sidecar_presence(ckpt_dir, step)
+        stamps = sidecar_stamps(ckpt_dir, step)
         fleet_valid = not issues and (
             process_count is None
             or all(p in procs for p in range(process_count))
@@ -197,6 +261,8 @@ def fsck_checkpoints(
                 "issues": issues,
                 "sidecar_issues": side,
                 "sidecar_procs": procs,
+                "sidecar_nproc": {str(p): stamps[p] for p in sorted(stamps)},
+                "complete_for_nproc": stamped_topology(ckpt_dir, step),
                 "fleet_valid": fleet_valid,
             }
         )

@@ -32,8 +32,10 @@ durations, ``harness/hooks.py::TelemetryHook`` snapshots everything into
 from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
     CHAOS_ARMED_UNFIRED,
     CKPT_FENCE,
+    CKPT_RESIZE_RESTORES,
     CKPT_RESTORE,
     CKPT_SAVE,
+    CKPT_SIDECAR_FALLBACKS,
     CKPT_WAIT,
     COMPILE,
     CONSENSUS_OVERRIDES,

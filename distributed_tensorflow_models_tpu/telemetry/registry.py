@@ -66,6 +66,17 @@ CKPT_WAIT = "checkpoint/wait"  # timer: explicit waits (teardown/emergency)
 # device→host snapshot + orbax dispatch (paid per save), fence = how
 # often the cadence outran the background writer (ideally ~0).
 CKPT_FENCE = "checkpoint/fence"  # timer
+# Degraded / cross-topology resume observability (checkpoint.py): a
+# sidecar fallback means this process resumed from the primary's dataset
+# position (approximate resume — its own sidecar was missing or
+# unreadable, or a re-split found no usable cursor); a resize restore
+# means the checkpoint was written by a different process count and the
+# dataset cursor was re-split onto the new fleet.  Both are silent-log
+# paths without these counters; fleet_report and the metrics-schema
+# coverage gate read them, and either being nonzero on a steady-state
+# fleet is a red flag.
+CKPT_SIDECAR_FALLBACKS = "checkpoint/sidecar_fallbacks"  # counter
+CKPT_RESIZE_RESTORES = "checkpoint/resize_restores"  # counter
 # Cold-start / restart-MTTR gauges (harness/startup.py + fit): wall time
 # of the startup restore walk, the background AOT train-step compile
 # (overlapped with the restore — only the non-overlapped remainder lands

@@ -26,6 +26,14 @@ contract from ISSUE 5's acceptance list:
   fleet-agreed divergence), complete with exactly 1 rollback and
   exactly 1 skipped batch each, and agree bit-identically on the end
   state.
+- ``resize``    — elastic fleet resize (ISSUE 14): train 2-process to
+  the crossing checkpoint, then resume the same workdir at 1 and at 4
+  processes.  The cross-topology restore must re-split the dataset
+  cursor to the fleet-minimum position (zero skipped batches, proven
+  from the chief's ``resize_ledger.json``), keep the loss trajectory
+  tolerance-equal to the unresized baseline, leave a
+  ``resize_restore`` flight record on every new host, and pass fsck's
+  stamped-topology checks at the crossing point.
 
 Every worker (both hosts, not just the chief) writes a
 ``result-p<i>.json`` with sha256 digests of its final params and
@@ -57,6 +65,9 @@ if _REPO not in sys.path:  # runnable as a script from anywhere
     sys.path.insert(0, _REPO)
 
 from distributed_tensorflow_models_tpu import launch  # noqa: E402
+from distributed_tensorflow_models_tpu.resilience import (  # noqa: E402
+    fsck as fscklib,  # jax-free: safe in the drill parent
+)
 
 
 _FLEET_REPORT = None
@@ -88,6 +99,7 @@ PORTS = {
     "kill": 9831,
     "straggler": 9851,
     "nan": 9861,
+    "resize": 9871,
 }
 
 STEPS = 6
@@ -194,12 +206,14 @@ def run_fleet(
     workdir: str,
     *,
     port: int,
+    nproc: int = 2,
     supervised: bool = False,
     max_restarts: int = 0,
     timeout: float = 420.0,
 ):
-    """One 2-process phase.  Returns ``(aggregate_code, results)`` where
-    results[i] is host i's result dict (None if it never finished)."""
+    """One ``nproc``-process phase (default 2).  Returns
+    ``(aggregate_code, results)`` where results[i] is host i's result
+    dict (None if it never finished)."""
     outdir = os.path.join(scratch, f"{name}-out")
     os.makedirs(outdir, exist_ok=True)
     script = os.path.join(scratch, f"{name}-worker.py")
@@ -221,15 +235,15 @@ def run_fleet(
     )
     if supervised:
         agg = launch.supervise_local(
-            2, argv, max_restarts=max_restarts, backoff_base_s=0.0,
+            nproc, argv, max_restarts=max_restarts, backoff_base_s=0.0,
             **kwargs,
         )
     else:
         agg = launch.aggregate_exit_codes(
-            launch.launch_local(2, argv, **kwargs)
+            launch.launch_local(nproc, argv, **kwargs)
         )
     results = []
-    for i in range(2):
+    for i in range(nproc):
         path = os.path.join(outdir, f"result-p{i}.json")
         results.append(json.load(open(path)) if os.path.exists(path) else None)
     return agg, results
@@ -250,10 +264,10 @@ def _check_host_agreement(results, errors: list[str]) -> None:
         return
     for key in ("step", "params_sha", "opt_sha", "rollbacks",
                 "skipped_batches"):
+        vals = [r[key] for r in results]
         _check(
-            results[0][key] == results[1][key],
-            f"hosts disagree on {key}: "
-            f"{results[0][key]!r} vs {results[1][key]!r}",
+            all(v == vals[0] for v in vals),
+            f"hosts disagree on {key}: {vals!r}",
             errors,
         )
 
@@ -426,7 +440,202 @@ def drill_nan(scratch: str, ref: dict) -> list[str]:
     return errors
 
 
-DRILLS = ("skew", "kill", "straggler", "nan")
+def _metric_losses(workdir: str) -> dict:
+    """{step: loss} from a run's ``metrics.jsonl`` (chief-written)."""
+    path = os.path.join(workdir, "metrics.jsonl")
+    rows: dict = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if "loss" in row and "step" in row:
+                    rows[int(row["step"])] = float(row["loss"])
+    return rows
+
+
+# Post-resize losses are trajectory-equivalent, not bit-identical: the
+# global batch sequence is unchanged (global dataset cursor), but the
+# gradient all-reduce runs over a different device count, so summation
+# order — and nothing else — differs.  Tolerance, not equality.
+RESIZE_LOSS_RTOL = 5e-3
+
+
+def drill_resize(scratch: str, ref: dict) -> list[str]:
+    """Elastic resize: train 2-process to the crossing, resume the SAME
+    workdir at 1 and at 4 processes, and hold the resumed run to the
+    unresized baseline: same final step, loss trajectory within
+    RESIZE_LOSS_RTOL, zero skipped batches proven from the chief's
+    resize ledger, a ``resize_restore`` flight record on every new host,
+    trace exports archived from both sides of the crossing, and fsck
+    naming the crossing step a cross-topology candidate."""
+    errors: list[str] = []
+    base_losses = _metric_losses(os.path.join(scratch, "baseline-wd"))
+    port = PORTS["resize"]
+    for target in (1, 4):
+        tag = f"resize{target}"
+        workdir = os.path.join(scratch, f"{tag}-wd")
+        ckpt_dir = os.path.join(workdir, "checkpoints")
+        agg, _ = run_fleet(
+            scratch, f"{tag}-phase1", _base_overrides(train_steps=3),
+            workdir, port=port,
+        )
+        port += 1
+        _check(agg == 0, f"{tag} phase-1 fleet exit {agg}", errors)
+
+        # fsck at the crossing point: the step phase 2 will restore must
+        # be fleet-valid for the WRITING topology (2 proc) and stamped
+        # as such — that stamp is what makes it a resize candidate.
+        report = fscklib.fsck_checkpoints(ckpt_dir, process_count=2)
+        crossing = report["newest_fleet_valid_step"]
+        _check(
+            crossing is not None and crossing == report["latest_step"],
+            f"{tag}: crossing step is not fleet-valid for the writing "
+            f"topology (fleet-valid {crossing}, latest "
+            f"{report['latest_step']})",
+            errors,
+        )
+        by_step = {e["step"]: e for e in report["steps"]}
+        _check(
+            crossing in by_step
+            and by_step[crossing]["complete_for_nproc"] == 2,
+            f"{tag}: crossing step {crossing} is not stamped complete "
+            f"for 2 processes: "
+            f"{by_step.get(crossing, {}).get('complete_for_nproc')!r}",
+            errors,
+        )
+        if crossing is None:
+            continue  # nothing to resume across
+
+        # Phase 2 overwrites trace_p<i>.json in the shared workdir;
+        # archive phase 1's so the drill keeps timelines from BOTH
+        # sides of the crossing.
+        archive = os.path.join(scratch, f"{tag}-phase1-traces")
+        os.makedirs(archive, exist_ok=True)
+        archived = []
+        for name in os.listdir(workdir):
+            if name.startswith("trace_p") and name.endswith(".json"):
+                shutil.copy2(
+                    os.path.join(workdir, name), os.path.join(archive, name)
+                )
+                archived.append(name)
+        _check(
+            sorted(archived) == ["trace_p0.json", "trace_p1.json"],
+            f"{tag}: pre-crossing trace exports missing: {archived}",
+            errors,
+        )
+
+        agg, results = run_fleet(
+            scratch, f"{tag}-phase2", _base_overrides(),
+            workdir, port=port, nproc=target,
+        )
+        port += 1
+        _check(agg == 0, f"{tag} phase-2 fleet exit {agg}", errors)
+        _check_host_agreement(results, errors)
+        if all(r is not None for r in results):
+            _check(
+                results[0]["step"] == STEPS,
+                f"{tag}: resumed fleet ended at step {results[0]['step']}",
+                errors,
+            )
+            for i, r in enumerate(results):
+                _check(
+                    r["skipped_batches"] == 0,
+                    f"{tag}: host {i} skipped {r['skipped_batches']} "
+                    "batch(es) across the resize",
+                    errors,
+                )
+            # Loss-trajectory agreement with the unresized baseline on
+            # every post-crossing logged step, plus the final loss.
+            losses = _metric_losses(workdir)
+            for step, base in sorted(base_losses.items()):
+                if step <= crossing:
+                    continue
+                got = losses.get(step)
+                _check(
+                    got is not None
+                    and abs(got - base) <= RESIZE_LOSS_RTOL * abs(base),
+                    f"{tag}: loss at step {step} diverged from baseline: "
+                    f"{got!r} vs {base!r}",
+                    errors,
+                )
+            _check(
+                abs(results[0]["loss"] - ref.get("loss", float("nan")))
+                <= RESIZE_LOSS_RTOL * abs(ref.get("loss", 1.0)),
+                f"{tag}: final loss {results[0]['loss']!r} diverged from "
+                f"baseline {ref.get('loss')!r}",
+                errors,
+            )
+
+        # The chief's resize ledger is the zero-skip proof: the adopted
+        # cursor position must be <= every saved position.
+        ledger_path = os.path.join(
+            ckpt_dir, "dataset_states", str(crossing), "resize_ledger.json"
+        )
+        _check(
+            os.path.exists(ledger_path),
+            f"{tag}: no resize ledger at {ledger_path}",
+            errors,
+        )
+        if os.path.exists(ledger_path):
+            ledger = json.load(open(ledger_path))
+            _check(
+                ledger.get("from_nproc") == 2
+                and ledger.get("to_nproc") == target,
+                f"{tag}: ledger topology wrong: {ledger}",
+                errors,
+            )
+            adopted = ledger.get("adopted_position")
+            positions = [
+                p for p in ledger.get("positions", {}).values()
+                if p is not None
+            ]
+            _check(
+                adopted is not None
+                and bool(positions)
+                and all(adopted <= p for p in positions),
+                f"{tag}: adopted position {adopted} is not the fleet "
+                f"minimum of {positions} — batches may have been skipped",
+                errors,
+            )
+
+        # Every post-crossing host dumps a resize_restore flight record
+        # (train.py marks the crossing incident-grade).
+        records = _flight_records(workdir)
+        for proc in range(target):
+            rec = records.get(proc)
+            _check(
+                rec is not None and rec.get("reason") == "resize_restore",
+                f"{tag}: host {proc}: expected a 'resize_restore' flight "
+                f"record, got "
+                f"{None if rec is None else rec.get('reason')!r}",
+                errors,
+            )
+
+        # And the resumed fleet must leave a restorable tail at ITS
+        # topology: the newest step valid (fleet-valid when sidecars
+        # exist, i.e. target > 1) for the new process count.
+        post = fscklib.fsck_checkpoints(
+            ckpt_dir, process_count=target if target > 1 else None
+        )
+        post_best = (
+            post["newest_fleet_valid_step"]
+            if target > 1
+            else post["newest_valid_step"]
+        )
+        _check(
+            post_best == STEPS,
+            f"{tag}: post-resize newest restorable step is {post_best}, "
+            f"expected {STEPS}",
+            errors,
+        )
+        _print_evidence(tag, workdir)
+    return errors
+
+
+DRILLS = ("skew", "kill", "straggler", "nan", "resize")
 
 
 def main(argv=None) -> int:
@@ -491,6 +700,7 @@ def main(argv=None) -> int:
                 "kill": drill_kill,
                 "straggler": drill_straggler,
                 "nan": drill_nan,
+                "resize": drill_resize,
             }[name]
             errors = fn(scratch, ref)
             _report(name, errors)

@@ -11,8 +11,12 @@ topology stamps that disagree with ``--process-count`` when given, and
 — with ``--process-count`` — per-process sidecar *completeness* (a step
 missing any peer's sidecar is not fleet-valid: the multi-host
 chief-decided restore prefers the newest step where every process can
-resume exactly; the report/JSON carry per-step ``sidecar_procs`` and
-``fleet_valid``).
+resume exactly; the report/JSON carry per-step ``sidecar_procs``,
+``sidecar_nproc`` topology stamps, ``complete_for_nproc``, and
+``fleet_valid``).  A step whose sidecar set is complete for a
+*different* stamped process count is reported as a cross-topology
+resume (resize) candidate rather than merely "missing peers" — the
+elastic restore path picks candidates by that stamp.
 
 Output: one line per step (``OK`` / ``TORN`` / ``DEGRADED``) and a
 summary naming the step a hardened restore would actually use.  Exit 0
@@ -97,14 +101,19 @@ def main(argv=None) -> int:
             else:
                 status = "OK"
             procs = entry["sidecar_procs"]
+            stamped = entry.get("complete_for_nproc")
             detail = ""
             if args.process_count is not None:
                 detail = (
                     f"  sidecars {len(procs)}/{args.process_count}"
                     f"{'' if entry['fleet_valid'] else '  NOT FLEET-VALID'}"
                 )
+                if stamped is not None and stamped != args.process_count:
+                    detail += f"  COMPLETE FOR {stamped}-PROC (resize candidate)"
             elif procs:
                 detail = f"  sidecars {procs}"
+                if stamped is not None:
+                    detail += f"  stamped nproc={stamped}"
             print(f"step {entry['step']:>10d}  {status}{detail}")
             for issue in entry["issues"]:
                 print(f"    {issue}")

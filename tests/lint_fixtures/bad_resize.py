@@ -1,0 +1,13 @@
+"""Known-bad elastic-resize protocol: the re-split pick decided per-host."""
+
+
+def adopt_pick_chief_only(consensus, is_chief, local_pick):
+    if is_chief:
+        return consensus.broadcast_int(local_pick)
+    return local_pick
+
+
+def announce_positions(consensus, states):
+    for pid in set(states):
+        consensus.broadcast_int(pid)
+    return len(states)

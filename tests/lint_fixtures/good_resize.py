@@ -1,0 +1,20 @@
+"""Good twin: deterministic local pick, one uniform lockstep broadcast."""
+
+
+def agree_pick(consensus, nproc, positions):
+    best = -1
+    for pid in sorted(positions):
+        if best < 0 or positions[pid] < positions[best]:
+            best = pid
+    if nproc == 1:
+        return best
+    return consensus.broadcast_int(best)
+
+
+def ledger_after_agreement(consensus, is_chief, local_pick):
+    # The collective runs before the chief-only side effect — every
+    # host enters it, only the bookkeeping differs.
+    agreed = consensus.broadcast_int(local_pick)
+    if is_chief:
+        return ("ledger", agreed)
+    return ("noop", agreed)

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import pytest
 
 from distributed_tensorflow_models_tpu import launch, telemetry
+from distributed_tensorflow_models_tpu.data import resplit as resplitlib
 from distributed_tensorflow_models_tpu.harness import (
     checkpoint as ckptlib,
     hooks as hooklib,
@@ -639,3 +640,368 @@ def test_schema_rejects_partial_or_negative_fleet_keys():
     assert any("is negative" in e for e in errors)
     errors, _, _ = schema.check_lines([_row(**{"chaos/armed_unfired": -2})])
     assert any("chaos key" in e for e in errors)
+
+
+# --- elastic resize: cursor re-split + cross-topology restore -------------
+
+
+def test_resplit_fleet_minimum_is_deterministic():
+    """The pick is a pure function of the sidecar set: same answer under
+    any read order, ties to the lowest pid — every host that sees the
+    same files computes the same source before consensus even runs."""
+    states = {
+        0: {"epoch": 1, "batch_idx": 4},
+        1: {"dataset": {"epoch": 1, "batch_idx": 2}},  # harness wrapper
+        2: {"epoch": 0, "batch_idx": 9},
+    }
+    assert resplitlib.pick_source(states) == 2  # epoch orders first
+    shuffled = {k: states[k] for k in (1, 2, 0)}
+    assert resplitlib.pick_source(shuffled) == 2
+    tie = {3: {"epoch": 0, "pos": 5}, 1: {"epoch": 0, "pos": 5}}
+    assert resplitlib.pick_source(tie) == 1
+
+
+def test_resplit_is_conservative_never_skips():
+    """N=3 -> M=4: every new process adopts a position <= every saved
+    position (re-read at most one chunk; skip nothing), and every new
+    pid gets a cursor."""
+    states = {i: {"records": ["r"], "count": 10 + i} for i in range(3)}
+    src, mapped = resplitlib.resplit_states(states, 4)
+    assert src == 0  # the minimum count
+    assert set(mapped) == {0, 1, 2, 3}
+    saved_min = min(resplitlib.cursor_position(s) for s in states.values())
+    for state in mapped.values():
+        assert resplitlib.cursor_position(state) <= saved_min
+
+
+def test_resplit_one_to_one_is_identity():
+    st = {"epoch": 2, "batch_idx": 0}
+    src, mapped = resplitlib.resplit_states({0: st}, 1)
+    assert src == 0
+    assert mapped[0] is st  # bit-identical same-shape resume
+
+
+def test_resplit_unknown_cursor_falls_back_loudly():
+    assert resplitlib.cursor_position({"weird": 1}) is None
+    assert resplitlib.cursor_position(None) is None
+    assert resplitlib.pick_source({0: {"weird": 1}}) == resplitlib.NO_SOURCE
+    with pytest.raises(ValueError):
+        resplitlib.resplit_states({0: {"weird": 1}}, 2)
+    # (0, 0) is a real position, not a missing one
+    desc = resplitlib.describe_positions({0: {"epoch": 0, "batch_idx": 0}})
+    assert desc["positions"]["0"] == [0, 0]
+    assert desc["source_pid"] == 0
+
+
+def _write_sidecar(tmp_path, step, pid, payload):
+    base = os.path.join(
+        str(tmp_path), "checkpoints", "dataset_states", str(step)
+    )
+    os.makedirs(base, exist_ok=True)
+    path = os.path.join(base, f"p{pid}.json")
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def test_same_shape_restore_is_not_a_resize(tmp_path):
+    """N -> N stays on the exact pre-resize path: own sidecar adopted,
+    neither resize nor fallback counters move, no ledger appears."""
+    registry = telemetry.MetricsRegistry()
+    chief, bus = _chief_manager(tmp_path, registry=registry)
+    assert chief.save(_tiny_state(2), {"pos": 2}, force=True)
+    chief.wait()
+    restored, data = chief.restore(_tiny_state())
+    assert int(restored.step) == 2
+    assert data == {"pos": 2}
+    snap = registry.snapshot()
+    assert snap[telemetry.CKPT_RESIZE_RESTORES] == 0
+    assert snap[telemetry.CKPT_SIDECAR_FALLBACKS] == 0
+    assert chief.last_resize is None
+    assert not os.path.exists(
+        os.path.join(
+            str(tmp_path), "checkpoints", "dataset_states", "2",
+            ckptlib.RESIZE_LEDGER,
+        )
+    )
+    chief.close()
+
+
+def test_legacy_bare_sidecar_adopted_and_stamped(tmp_path):
+    """Pre-stamp bare-dict sidecar: same format implies same topology —
+    adopt it AND rewrite the file stamped, so the unstamped shape cannot
+    survive into a later resize undetected."""
+    chief, bus = _chief_manager(tmp_path)
+    assert chief.save(_tiny_state(2), {"pos": 2}, force=True)
+    chief.wait()
+    chief.close()
+    legacy = {"epoch": 0, "batch_idx": 7}
+    path = _write_sidecar(tmp_path, 2, 0, legacy)
+
+    chief2, _ = _chief_manager(tmp_path)
+    restored, data = chief2.restore(_tiny_state())
+    assert data == legacy
+    with open(path) as f:
+        assert json.load(f) == {"nproc": 2, "state": legacy}
+    chief2.close()
+
+
+def test_mismatched_or_missing_sidecar_bumps_fallback_counter(tmp_path):
+    """Same-shape fleet, wrong/absent own sidecar: degrade to the
+    primary's position and count it under checkpoint/sidecar_fallbacks."""
+    chief, bus = _chief_manager(tmp_path)
+    assert chief.save(_tiny_state(2), {"pos": 2}, force=True)
+    chief.wait()
+    chief.close()
+
+    path = _write_sidecar(tmp_path, 2, 0, {"nproc": 3, "state": {"pos": 9}})
+    registry = telemetry.MetricsRegistry()
+    chief2, _ = _chief_manager(tmp_path, registry=registry)
+    _, data = chief2.restore(_tiny_state())
+    assert data == {"pos": 2}  # primary, not the wrong-shard cursor
+    assert registry.snapshot()[telemetry.CKPT_SIDECAR_FALLBACKS] == 1
+    chief2.close()
+
+    os.remove(path)
+    registry2 = telemetry.MetricsRegistry()
+    chief3, _ = _chief_manager(tmp_path, registry=registry2)
+    _, data = chief3.restore(_tiny_state())
+    assert data == {"pos": 2}
+    assert registry2.snapshot()[telemetry.CKPT_SIDECAR_FALLBACKS] == 1
+    chief3.close()
+
+
+def test_resize_restore_2_to_1_no_collectives(tmp_path):
+    """A 2-process checkpoint restored by a 1-process fleet: crossing
+    detected from the topology stamp, the fleet-minimum cursor adopted,
+    the ledger written — and the consensus backend NEVER touched
+    (nproc=1 must stay collective-free)."""
+    chief, bus = _chief_manager(tmp_path)
+    assert chief.save(
+        _tiny_state(3), {"dataset": {"epoch": 0, "batch_idx": 8}},
+        force=True,
+    )
+    chief.wait()
+    chief.close()
+    _write_sidecar(
+        tmp_path, 3, 1,
+        {"nproc": 2, "state": {"dataset": {"epoch": 0, "batch_idx": 6}}},
+    )
+
+    registry = telemetry.MetricsRegistry()
+    mgr = ckptlib.CheckpointManager(
+        str(tmp_path),
+        registry=registry,
+        consensus=conslib.Consensus(0, 1, backend=_Exploding()),
+    )
+    restored, data = mgr.restore(_tiny_state())
+    assert int(restored.step) == 3
+    assert data == {"dataset": {"epoch": 0, "batch_idx": 6}}  # p1: the min
+    snap = registry.snapshot()
+    assert snap[telemetry.CKPT_RESIZE_RESTORES] == 1
+    assert snap[telemetry.CKPT_SIDECAR_FALLBACKS] == 0
+    assert mgr.last_resize == {
+        "step": 3, "from_nproc": 2, "to_nproc": 1, "source_pid": 1,
+    }
+    with open(
+        os.path.join(
+            str(tmp_path), "checkpoints", "dataset_states", "3",
+            ckptlib.RESIZE_LEDGER,
+        )
+    ) as f:
+        ledger = json.load(f)
+    assert ledger["source_pid"] == 1
+    assert ledger["from_nproc"] == 2 and ledger["to_nproc"] == 1
+    assert ledger["adopted_position"] == [0, 6]
+    assert ledger["positions"] == {"0": [0, 8], "1": [0, 6]}
+    mgr.close()
+
+
+def test_resize_restore_2_to_4_broadcasts_agreed_pick(tmp_path):
+    """Grown fleet (2 -> 4): a new pid with no sidecar of its own still
+    detects the crossing from the stamp, and the source pick rides the
+    scripted consensus bus as one extra lockstep broadcast after the
+    walk's agreements."""
+    chief, bus = _chief_manager(tmp_path)
+    assert chief.save(
+        _tiny_state(3), {"dataset": {"epoch": 1, "batch_idx": 5}},
+        force=True,
+    )
+    chief.wait()
+    chief.close()
+    _write_sidecar(
+        tmp_path, 3, 1,
+        {"nproc": 2, "state": {"dataset": {"epoch": 1, "batch_idx": 2}}},
+    )
+
+    registry = telemetry.MetricsRegistry()
+    # restore-pick, restore-failed flag, restore-rejected flag, resize-pick
+    bus4 = _FixedBus([[3] * 4, [0] * 4, [0] * 4, [1] * 4])
+    mgr = ckptlib.CheckpointManager(
+        str(tmp_path),
+        process_index=2,  # a pid that did not exist in the saved fleet
+        process_count=4,
+        registry=registry,
+        consensus=conslib.Consensus(2, 4, backend=bus4),
+    )
+    restored, data = mgr.restore(_tiny_state())
+    assert int(restored.step) == 3
+    assert data == {"dataset": {"epoch": 1, "batch_idx": 2}}
+    assert registry.snapshot()[telemetry.CKPT_RESIZE_RESTORES] == 1
+    assert mgr.last_resize == {
+        "step": 3, "from_nproc": 2, "to_nproc": 4, "source_pid": 1,
+    }
+    assert bus4.calls[-1] == 1  # the re-split pick went over the wire
+    assert bus4.rows == []  # ...and exactly the scripted sequence ran
+    mgr.close()
+
+
+def test_restore_reshards_arrays_onto_live_mesh(tmp_path):
+    """Abstract restore targets come from the LIVE template's mesh, not
+    the checkpoint: a state saved on the full 8-device mesh restores
+    onto a 2-device mesh, arrays land on the new device set, values
+    intact."""
+    import numpy as np
+
+    from jax.sharding import NamedSharding, PartitionSpec
+    from distributed_tensorflow_models_tpu.core import mesh as meshlib
+
+    def place(tree, mesh):
+        sh = NamedSharding(mesh, PartitionSpec())
+        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+    full = meshlib.create_mesh(meshlib.MeshSpec())
+    saved = place(_tiny_state(5), full)
+    mgr = ckptlib.CheckpointManager(str(tmp_path))
+    assert mgr.save(saved, {"pos": 5}, force=True)
+    mgr.close()
+
+    subset = set(jax.devices()[:2])
+    live = meshlib.create_mesh(meshlib.MeshSpec(), jax.devices()[:2])
+    template = place(_tiny_state(), live)
+    for leaf in jax.tree.leaves(ckptlib.restore_abstract_tree(template)):
+        assert leaf.sharding.device_set == subset
+
+    mgr2 = ckptlib.CheckpointManager(str(tmp_path))
+    restored, data = mgr2.restore(template)
+    assert data == {"pos": 5}
+    assert int(restored.step) == 5
+    for leaf in jax.tree.leaves(restored.params):
+        assert leaf.sharding.device_set == subset
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(restored.params)[0]),
+        np.asarray(jax.tree.leaves(saved.params)[0]),
+    )
+    mgr2.close()
+
+
+def test_fsck_stamped_topology_detection(tmp_path):
+    ckpt = str(tmp_path)
+    _fake_step(ckpt, 1, sidecar_pids=(0, 1), nproc=2)
+    _fake_step(ckpt, 2, sidecar_pids=(0, 1, 2), nproc=3)
+    _fake_step(ckpt, 3, sidecar_pids=(0,), nproc=2)  # incomplete for stamp
+    assert fscklib.stamped_topology(ckpt, 1) == 2
+    assert fscklib.stamped_topology(ckpt, 2) == 3
+    assert fscklib.stamped_topology(ckpt, 3) is None
+    assert fscklib.sidecar_stamps(ckpt, 2) == {0: 3, 1: 3, 2: 3}
+    # a legacy unstamped sidecar makes the set ambiguous
+    base = os.path.join(ckpt, "dataset_states", "1")
+    with open(os.path.join(base, "p1.json"), "w") as f:
+        json.dump({"pos": 1}, f)
+    assert fscklib.sidecar_stamps(ckpt, 1) == {0: 2, 1: None}
+    assert fscklib.stamped_topology(ckpt, 1) is None
+
+
+def test_fsck_reports_cross_topology_candidates(tmp_path):
+    """A step complete for a DIFFERENT process count is reported as a
+    resize candidate, not as a torn/missing-peer step."""
+    ckpt = str(tmp_path)
+    _fake_step(ckpt, 1, sidecar_pids=(0, 1), nproc=2)
+    issues = fscklib.sidecar_issues(ckpt, 1, process_count=4)
+    assert any("cross-topology resume candidate" in i for i in issues)
+    assert not any("not fleet-valid" in i for i in issues)
+
+    report = fscklib.fsck_checkpoints(ckpt, process_count=4)
+    entry = report["steps"][0]
+    assert entry["complete_for_nproc"] == 2
+    assert entry["sidecar_nproc"] == {"0": 2, "1": 2}
+    assert not entry["fleet_valid"]  # candidate, but still needs re-split
+
+
+def test_fsck_script_surfaces_topology_stamps(tmp_path, capsys):
+    ckpt = str(tmp_path / "checkpoints")
+    _fake_step(ckpt, 1, sidecar_pids=(0, 1), nproc=2)
+    fsck_script = _load_script("fsck_checkpoints")
+
+    rc = fsck_script.main([str(tmp_path), "--process-count", "4"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "COMPLETE FOR 2-PROC (resize candidate)" in out
+
+    rc = fsck_script.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "stamped nproc=2" in out
+
+
+def test_supervise_local_resize_to_on_relaunch(tmp_path, capfd):
+    """--resize-to M: the relaunched fleet comes back with M processes
+    and stderr says so; children see the new DTM_NUM_PROCESSES."""
+    marker = tmp_path / "attempted"
+    seen = tmp_path / "seen"
+    argv = _child(
+        tmp_path,
+        f"""
+        import os, sys
+        n = os.environ["DTM_NUM_PROCESSES"]
+        pid = os.environ["DTM_PROCESS_ID"]
+        open({str(seen)!r} + f"-{{n}}-{{pid}}", "w").close()
+        marker = {str(marker)!r}
+        if not os.path.exists(marker):
+            if pid == "0":
+                open(marker, "w").close()
+            sys.exit(9)
+        sys.exit(0)
+        """,
+    )
+    rc = launch.supervise_local(
+        2, argv, max_restarts=2, backoff_base_s=0.0, port=9906,
+        term_grace_s=3, resize_to=1,
+    )
+    assert rc == 0
+    err = capfd.readouterr().err
+    assert "RESIZING 2 -> 1" in err
+    assert (tmp_path / "seen-2-0").exists()
+    assert (tmp_path / "seen-1-0").exists()  # relaunch ran at 1 process
+
+
+def test_supervise_local_auto_resize_drops_failed_hosts(tmp_path, capfd):
+    """--auto-resize: relaunch capacity shrinks by the number of failed
+    processes (floor 1) instead of retrying a doomed topology forever."""
+    seen = tmp_path / "seen"
+    argv = _child(
+        tmp_path,
+        f"""
+        import os, sys
+        n = os.environ["DTM_NUM_PROCESSES"]
+        pid = os.environ["DTM_PROCESS_ID"]
+        open({str(seen)!r} + f"-{{n}}-{{pid}}", "w").close()
+        sys.exit(9 if pid == "1" else 0)
+        """,
+    )
+    rc = launch.supervise_local(
+        2, argv, max_restarts=2, backoff_base_s=0.0, port=9907,
+        term_grace_s=3, auto_resize=True,
+    )
+    assert rc == 0
+    err = capfd.readouterr().err
+    assert "RESIZING 2 -> 1" in err
+    assert (tmp_path / "seen-1-0").exists()
+
+
+def test_supervise_local_rejects_bad_resize_target(tmp_path):
+    with pytest.raises(ValueError):
+        launch.supervise_local(
+            2, [sys.executable, "-c", "pass"], max_restarts=1,
+            resize_to=0,
+        )

@@ -75,6 +75,8 @@ BAD_EXPECT = {
                                 ("collective-order", 20)},
     "meshaxes_bad.py": {("collective-order", 10),
                         ("collective-order", 11)},
+    "bad_resize.py": {("collective-lockstep", 6),
+                      ("collective-order", 12)},
     "bad_lifecycle.py": {("resource-lifecycle", 9),
                          ("resource-lifecycle", 15),
                          ("resource-lifecycle", 24),
@@ -93,6 +95,7 @@ GOOD_FILES = [
     "good_paged_arena.py",
     "good_race.py",
     "good_collective_order.py",
+    "good_resize.py",
     "meshaxes_good.py",
     "good_lifecycle.py",
 ]

@@ -10,7 +10,11 @@ engine; it is also runnable standalone outside pytest).
   bit-identically;
 - one-host NaN under ``nan_policy=rollback`` → both hosts roll back
   together with the exact-skip ledger intact (1 rollback, 1 skipped
-  batch, agreeing end state).
+  batch, agreeing end state);
+- elastic resize (ISSUE 14): a 2-process checkpoint resumed at 1 and
+  at 4 processes — dataset cursor re-split to the fleet minimum (zero
+  skipped batches, ledger-proven), loss trajectory tolerance-equal to
+  the unresized baseline, flight records across the crossing.
 
 Named ``test_zz_*`` ON PURPOSE: pytest runs files alphabetically and
 this box's CI window sometimes truncates the tail under load — these
@@ -76,4 +80,10 @@ def test_killed_host_recovers_bit_identical_under_supervisor(drill):
 def test_one_host_nan_rolls_back_fleet_together(drill):
     mod, scratch, ref = drill
     errors = mod.drill_nan(scratch, ref)
+    assert not errors, errors
+
+
+def test_elastic_resize_2_to_1_and_2_to_4(drill):
+    mod, scratch, ref = drill
+    errors = mod.drill_resize(scratch, ref)
     assert not errors, errors
