@@ -1152,7 +1152,7 @@ ORDER = [
 # comparison, not a hardware kernel number.
 CHILD_MODES = sorted(BUILDERS) + [
     "flash_check", "decode", "transformer_parts", "restart_mttr",
-    "serving",
+    "serving", "speculation",
 ]
 
 
@@ -1887,6 +1887,213 @@ def run_serving(args):
     }
 
 
+def run_speculation(args):
+    """Speculative decoding A/B (ISSUE 15): the same request mixes
+    served with ``spec_tokens=0`` (per-token decode) and with the
+    n-gram self-drafter on, byte-identical streams asserted every
+    timed pass.
+
+    Two mixes, both at concurrency 8 with ``decode_burst=1`` on BOTH
+    arms — speculation and burst-scan are alternative amortizations of
+    the same per-step cost (a verify dispatch cannot chain scan steps:
+    each scanned token would need a draft it hasn't seen), so the A/B
+    isolates what speculation itself buys over one-token-at-a-time
+    decode; burst-scan's own win over sequential is r08's headline.
+
+    - **repetitive**: constant-token prompts chosen (offline, from a
+      one-off sweep of all 256 single-token prompts against this
+      checkpoint) to land in the model's short-cycle greedy attractors
+      — the high-acceptance regime prompt-lookup drafting exists for
+      (templated/boilerplate traffic).  Headline: decode tokens/sec
+      on vs off.
+    - **adversarial**: uniform-random prompts at temperature 1.0 —
+      near-incompressible streams where the drafter should propose
+      almost nothing (``spec_min_match=2`` keeps 1-gram noise matches
+      from flooding the verify path on this small vocab) and the
+      engine falls back to plain burst dispatches.  The target is
+      bounded overhead, not a win: on-arm within 0.9x of off.
+
+    The probe model is deliberately small (cache-resident weights):
+    verify-width compute must be cheap relative to fixed per-dispatch
+    cost for speculation to pay, which is the production regime
+    (weight streaming dwarfs a K-wide matmul) — on CPU the d640
+    serving probe is FLOP-bound at width 8 and caps any drafter at
+    ~1x, which would measure the host, not the design.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_models_tpu.models import get_model
+    from distributed_tensorflow_models_tpu.serving.engine import (
+        InferenceEngine,
+    )
+    from distributed_tensorflow_models_tpu.serving.scheduler import (
+        ContinuousBatchingScheduler,
+        Request,
+    )
+    from distributed_tensorflow_models_tpu.telemetry import (
+        registry as reglib,
+    )
+
+    smoke = os.environ.get("DTM_SERVE_SMOKE") == "1"
+    if smoke:
+        dims = dict(vocab_size=64, num_layers=2, num_heads=2,
+                    d_model=32, d_ff=64)
+        n_requests, plen, max_new, repeats = 4, 8, 6, 1
+        spec_tokens, max_slots = 3, 4
+        # Any tokens work for the smoke: it validates the path
+        # (bit-identity, compile pin, telemetry), not the speedup.
+        rep_toks = (7, 11, 23, 42)
+    else:
+        dims = dict(vocab_size=256, num_layers=2, num_heads=4,
+                    d_model=256, d_ff=1024)
+        n_requests, plen, max_new, repeats = 16, 32, 64, 3
+        spec_tokens, max_slots = 7, 8
+        # Greedy attractor tokens for THIS init (seed 42): constant
+        # prompts whose streams settle into runs/short cycles, from an
+        # offline sweep of all 256 constant-token prompts (top 16 by
+        # accepted tokens per dispatch, 6.4-8.0 of a possible 8).
+        rep_toks = (180, 73, 69, 238, 234, 226, 224, 222,
+                    221, 214, 209, 206, 204, 202, 197, 194)
+    spec_min_match, spec_ngram_order = 2, 3
+
+    model = get_model(
+        "transformer_lm", **dims, max_len=plen + max_new + spec_tokens + 1,
+        dropout_rate=0.0, dtype=jnp.float32,
+    )
+    rng0 = jax.random.key(42)
+    params = model.init(rng0, jnp.zeros((1, plen), jnp.int32))["params"]
+
+    def rep_requests():
+        return [
+            Request(
+                request_id=i,
+                prompt=np.full((plen,), rep_toks[i % len(rep_toks)],
+                               np.int32),
+                max_new_tokens=max_new,
+            )
+            for i in range(n_requests)
+        ]
+
+    def adv_requests():
+        out = []
+        for i in range(n_requests):
+            prompt = np.asarray(
+                jax.random.randint(
+                    jax.random.fold_in(rng0, 500 + i), (plen,), 0,
+                    dims["vocab_size"],
+                ),
+                np.int32,
+            )
+            out.append(Request(
+                request_id=i, prompt=prompt, max_new_tokens=max_new,
+                temperature=1.0, rng=jax.random.fold_in(rng0, 900 + i),
+            ))
+        return out
+
+    def build_engine(spec):
+        return InferenceEngine(
+            model, params, max_slots=max_slots, prefill_chunk=plen,
+            decode_burst=1, spec_tokens=spec,
+            spec_ngram_order=spec_ngram_order,
+            spec_min_match=spec_min_match,
+            registry=reglib.MetricsRegistry(),
+        )
+
+    def pass_once(engine, mk_requests):
+        sched = ContinuousBatchingScheduler(
+            engine, registry=engine.registry
+        )
+        for r in mk_requests():
+            sched.submit(r)
+        t0 = time.perf_counter()
+        done = sched.run_until_idle()
+        wall = time.perf_counter() - t0
+        engine.fsck()
+        return wall, {c.request_id: list(c.tokens) for c in done}
+
+    total_tokens = n_requests * max_new
+
+    def run_mix(label, mk_requests):
+        engines = {"off": build_engine(0), "on": build_engine(spec_tokens)}
+        for eng in engines.values():
+            pass_once(eng, mk_requests)  # untimed: compile everything
+        best = {"off": None, "on": None}
+        streams = {}
+        for _ in range(repeats):
+            # Interleaved on/off so machine noise hits both arms alike.
+            for arm, eng in engines.items():
+                wall, toks = pass_once(eng, mk_requests)
+                streams[arm] = toks
+                if best[arm] is None or wall < best[arm]:
+                    best[arm] = wall
+        if streams["on"] != streams["off"]:
+            raise AssertionError(
+                f"speculation {label}: on/off streams diverge"
+            )
+        # Compile pin: spec-off is the (1,1) engine; spec-on holds one
+        # decode entry per program actually exercised (verify, and
+        # burst when a dispatch had no proposals) — never more.
+        if engines["off"].compile_counts() != (1, 1):
+            raise AssertionError(
+                f"spec-off compile counts "
+                f"{engines['off'].compile_counts()} != (1, 1)"
+            )
+        on_counts = engines["on"].compile_counts()
+        if on_counts[0] != 1 or on_counts[1] > 2:
+            raise AssertionError(
+                f"spec-on compile counts {on_counts} exceed (1, 2)"
+            )
+        snap = engines["on"].registry.snapshot()
+        drafted = int(snap.get(reglib.SERVE_SPEC_DRAFTED, 0))
+        accepted = int(snap.get(reglib.SERVE_SPEC_ACCEPTED, 0))
+        out = {
+            "off_tokens_per_sec": round(total_tokens / best["off"], 1),
+            "on_tokens_per_sec": round(total_tokens / best["on"], 1),
+            "speedup": round(best["off"] / best["on"], 2),
+            "off_wall_s": round(best["off"], 3),
+            "on_wall_s": round(best["on"], 3),
+            "drafted": drafted,
+            "accepted": accepted,
+            "acceptance_rate": (
+                round(accepted / drafted, 3) if drafted else None
+            ),
+        }
+        log(f"speculation {label}: {json.dumps(out)}")
+        return out
+
+    repetitive = run_mix("repetitive", rep_requests)
+    adversarial = run_mix("adversarial", adv_requests)
+
+    return {
+        "metric": "speculative_decoding",
+        # Headline: decode tokens/sec with the drafter on vs off on the
+        # repetitive mix at concurrency 8, SAME token streams.
+        "value": repetitive["speedup"],
+        "unit": "x_vs_spec_off_c8",
+        "bit_identical": True,  # asserted above, both mixes
+        "repetitive": repetitive,
+        "adversarial": adversarial,
+        "spec_tokens": spec_tokens,
+        "spec_ngram_order": spec_ngram_order,
+        "spec_min_match": spec_min_match,
+        "decode_burst": 1,
+        "concurrency": max_slots,
+        "requests": n_requests,
+        "prompt_len": plen,
+        "new_tokens": max_new,
+        "probe_config": (
+            f"transformer_lm d{dims['d_model']} L{dims['num_layers']} "
+            f"h{dims['num_heads']} ff{dims['d_ff']} "
+            f"v{dims['vocab_size']}, {n_requests} requests x "
+            f"{max_new} new tokens"
+        ),
+    }
+
+
 def run_mode(name, args):
     """Single dispatch point for both the child process and the
     --in-process path: train-loop configs go through run_one; standalone
@@ -1899,6 +2106,8 @@ def run_mode(name, args):
         return run_restart_mttr(args)
     if name == "serving":
         return run_serving(args)
+    if name == "speculation":
+        return run_speculation(args)
     if name == "transformer_parts":
         return run_transformer_parts(args)
     if getattr(args, "compile_only", False):

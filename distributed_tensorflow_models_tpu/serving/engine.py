@@ -176,11 +176,32 @@ class InferenceEngine:
         kv_pool_blocks: Optional[int] = None,
         prefix_cache: bool = True,
         prefix_cache_blocks: Optional[int] = None,
+        spec_tokens: int = 0,
+        spec_ngram_order: int = 3,
+        spec_min_match: int = 1,
         registry: Optional[reglib.MetricsRegistry] = None,
     ):
         if decode_burst < 1:
             raise ValueError(
                 f"decode_burst must be >= 1, got {decode_burst}"
+            )
+        if spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0, got {spec_tokens}"
+            )
+        if spec_tokens + 1 > model.max_len:
+            raise ValueError(
+                f"spec_tokens {spec_tokens} leaves no room for real "
+                f"tokens in max_len {model.max_len}"
+            )
+        if spec_tokens and spec_min_match < 1:
+            raise ValueError(
+                f"spec_min_match must be >= 1, got {spec_min_match}"
+            )
+        if spec_tokens and spec_ngram_order < spec_min_match:
+            raise ValueError(
+                f"spec_ngram_order {spec_ngram_order} must be >= "
+                f"spec_min_match {spec_min_match}"
             )
         if prefill_chunk < 1:
             raise ValueError(
@@ -201,6 +222,14 @@ class InferenceEngine:
         self.prefill_chunk = int(prefill_chunk)
         self.decode_burst = int(decode_burst)
         self.prefill_lanes = int(prefill_lanes)
+        # Speculative decoding (off at 0): spec_tokens is a
+        # CONSTRUCTION-TIME constant exactly like decode_burst — the
+        # verify window width (spec_tokens + 1) is baked into the one
+        # decode entry point's second traced instance, never derived
+        # from traffic (see _decode_fn and compile_counts).
+        self.spec_tokens = int(spec_tokens)
+        self.spec_ngram_order = int(spec_ngram_order)
+        self.spec_min_match = int(spec_min_match)
         self.max_len = int(model.max_len)
         if kv_page_tokens is None:
             # Largest page that both divides max_len (tables must tile
@@ -227,6 +256,7 @@ class InferenceEngine:
             )
         self.num_blocks = int(kv_pool_blocks)
         self.registry = registry if registry is not None else reglib.get_registry()
+        self._ensure_spec_metrics()
         self.slots = kv_slots.SlotManager(max_slots)
         self.blocks = kv_slots.BlockPool(self.num_blocks)
         self.prefix_cache = (
@@ -266,6 +296,20 @@ class InferenceEngine:
 
     # -- request bookkeeping helpers --------------------------------------
 
+    def _ensure_spec_metrics(self) -> None:
+        """Pre-create the speculation metrics so zero is observable (a
+        spec-on engine that never verified still reports the full
+        ``serve/spec_*`` set); a spec-off engine creates NONE of them,
+        leaving the spec-off registry byte-for-byte unchanged.
+        Idempotent — the server re-invokes it after adopting the engine
+        into its own registry."""
+        if not self.spec_tokens:
+            return
+        self.registry.counter(reglib.SERVE_SPEC_DRAFTED)
+        self.registry.counter(reglib.SERVE_SPEC_ACCEPTED)
+        self.registry.timer(reglib.SERVE_SPEC_ACCEPTANCE_RATE)
+        self.registry.timer(reglib.SERVE_SPEC_TOKENS_PER_DISPATCH)
+
     def padded_len(self, prompt_len: int) -> int:
         """Positions a cold prompt occupies after right-padded chunking."""
         c = self.prefill_chunk
@@ -290,6 +334,18 @@ class InferenceEngine:
         if total > self.max_len:
             raise ValueError(
                 f"prompt {prompt_len} + new {max_new_tokens} exceeds "
+                f"max_len {self.max_len}"
+            )
+        # With speculation on, the verify window (spec_tokens + 1 wide,
+        # static) can start as late as position total - 1, so the table
+        # needs spec_tokens positions of headroom past the real tokens
+        # — otherwise the window's clamped dynamic_update_slice write
+        # would slide back over real positions (same hazard as the
+        # padded final prefill chunk below).
+        if self.spec_tokens and total + self.spec_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {prompt_len} + new {max_new_tokens} + "
+                f"spec_tokens {self.spec_tokens} headroom exceeds "
                 f"max_len {self.max_len}"
             )
         if self.padded_len(prompt_len) > self.max_len:
@@ -495,26 +551,51 @@ class InferenceEngine:
         return pool, toks
 
     def _decode_fn(self, params, views, pool, refresh, tables, lengths,
-                   tokens, keydata, temperature, top_k, top_p):
+                   tokens, drafts, keydata, temperature, top_k, top_p):
         """One batched decode dispatch over the persistent decode
         working set (``views``, donated in and out): lanes the host
         flagged in ``refresh`` first re-adopt their view from the pool
         — a gather through their block table, paid once per admission,
         not per dispatch (ONE ``lax.cond`` over the whole working set,
         so dispatches with no refresh execute the identity branch and
-        copy nothing) — then
-        the unmodified B=1 single-token apply (vmapped over lanes)
-        advances every view ``decode_burst`` tokens by ``lax.scan``,
-        each lane's sample feeding back as its next input token:
-        exactly ``generate()``'s recurrence, and exactly the slotted
-        engine's decode program over the same bytes, so paging, burst
-        length, and adoption timing cannot move a bit.  The pool is
-        READ-ONLY here; generated K/V lives only in the views (nothing
-        ever reads a suffix page from the pool — the prefix cache
-        shares prompt pages, which prefill wrote).  ``keydata`` is
-        ``[S, K, *key]``; returns the ``[K, S]`` token matrix.  Overrun
-        lanes clamp their writes inside their own view and the caller
-        discards their samples; free slots ride along as inert lanes."""
+        copy nothing) — then one of two bodies, selected by the STATIC
+        width of ``drafts`` (``[S, D]`` int32; D is 0 or the engine's
+        construction-time ``spec_tokens``, so the selection is a shape
+        fact, never traffic):
+
+        **D == 0 (burst decode)** — the unmodified B=1 single-token
+        apply (vmapped over lanes) advances every view ``decode_burst``
+        tokens by ``lax.scan``, each lane's sample feeding back as its
+        next input token: exactly ``generate()``'s recurrence, and
+        exactly the slotted engine's decode program over the same
+        bytes, so paging, burst length, and adoption timing cannot
+        move a bit.  ``keydata`` is ``[S, K, *key]``; returns the
+        ``[K, S]`` token matrix.  Overrun lanes clamp their writes
+        inside their own view and the caller discards their samples;
+        free slots ride along as inert lanes.
+
+        **D > 0 (speculative verify)** — the scan's carried next-input
+        token is replaced by the drafted window: each lane applies the
+        model ONCE over ``[last_token, d_1 .. d_D]`` (width
+        ``W = D + 1`` — the multi-token decode apply prefill already
+        uses), computing target logits at every drafted position in a
+        single forward pass, and samples every position with its own
+        ``key_schedule`` key via the same :func:`sample_dynamic`.  Row
+        ``i`` is the token solo decoding would emit next IF the first
+        ``i`` drafts matched; the host accepts the matched prefix and
+        rolls the rest back (:meth:`decode_step`), so byte-identity is
+        definitional at any acceptance rate.  Returns the ``[S, W]``
+        candidate matrix.  Draft padding (-1 = no proposal) clamps to
+        token 0 for the embedding gather; those positions' samples are
+        never accepted host-side and their K/V writes land past the
+        rolled-back length, overwritten by the next window before any
+        query row can attend to them.
+
+        The pool is READ-ONLY in both bodies; generated K/V lives only
+        in the views (nothing ever reads a suffix page from the pool —
+        the prefix cache shares prompt pages, which prefill wrote), so
+        rejected drafts can never corrupt a shared or copy-on-write
+        prefix page."""
         views = lax.cond(
             jnp.any(refresh),
             lambda v: kv_slots.adopt_lanes(v, pool, tables, refresh),
@@ -522,6 +603,32 @@ class InferenceEngine:
             views,
         )
         caches = kv_slots.set_counters(views, lengths)
+
+        if drafts.shape[1] > 0:
+            def one_verify(cache, tok, dr, kd, t, k, p):
+                window = jnp.concatenate(
+                    [tok[None], jnp.maximum(dr, 0)]
+                )[None]  # [1, W]
+                (logits, _), mutated = self._decode_model.apply(
+                    {"params": params, "cache": cache}, window,
+                    train=False, mutable=["cache"],
+                )
+                rows = logits[0].astype(jnp.float32)  # [W, V]
+                # Unrolled per-position sampling (W is static and
+                # small): each row goes through the exact
+                # sample_dynamic computation the burst scan runs, so
+                # the sampled bits match solo decoding's per position.
+                cand = jnp.stack([
+                    sample_dynamic(rows[i], kd[i], t, k, p, jnp.int32)
+                    for i in range(rows.shape[0])
+                ])
+                return mutated["cache"], cand
+
+            caches, out = jax.vmap(one_verify)(
+                caches, tokens, drafts, keydata, temperature, top_k,
+                top_p,
+            )
+            return kv_slots.placeholder_counters(views, caches), out
 
         def burst_step(carry, kd_t):
             caches_t, toks = carry
@@ -635,34 +742,61 @@ class InferenceEngine:
         return out
 
     def decode_step(self, lanes: dict) -> dict:
-        """One batched decode dispatch (``decode_burst`` tokens).
-        ``lanes`` maps slot -> ``(last_token, keydata_rows, temperature,
-        top_k, top_p)`` for every ACTIVE slot, where ``keydata_rows`` is
-        ``[r, *key]`` with ``1 <= r <= decode_burst`` (a lane with fewer
-        than ``decode_burst`` tokens left passes only its remaining key
-        schedule; the zero-padded tail samples garbage the caller must
-        discard — such a lane finishes inside this burst, so its slot is
-        retired and the overrun never reaches a live request).  Returns
-        ``{slot: [token, ...]}`` (``decode_burst`` tokens per lane) for
-        the same slots.  Inactive slots run as inert sentinel lanes —
-        the program shape never depends on how many requests are live."""
+        """One batched decode dispatch.  ``lanes`` maps slot ->
+        ``(last_token, keydata_rows, temperature, top_k, top_p)`` — or,
+        with speculation on, the same plus a sixth ``draft_row``
+        element (``[spec_tokens]`` int32, -1 = no proposal; see
+        :mod:`.drafter`) — for every ACTIVE slot.  ``keydata_rows`` is
+        ``[r, *key]``, the lane's remaining key schedule up to the
+        dispatch width (a lane with fewer tokens left passes only what
+        remains; the zero-padded tail samples garbage the caller must
+        discard — such a lane finishes inside this dispatch, so its
+        slot is retired and the overrun never reaches a live request).
+
+        Routing is host-side and data-driven: when ``spec_tokens > 0``
+        AND at least one lane proposed a draft token, the dispatch is a
+        speculative VERIFY (one width-``spec_tokens+1`` apply per lane;
+        each lane emits its accepted draft prefix plus the target's own
+        correction token — between 1 and ``spec_tokens + 1`` tokens —
+        and its length counter rolls back over the rejected tail via
+        :func:`~.kv_slots.rollback_length`); otherwise it is the plain
+        ``decode_burst``-token burst, byte-for-byte the PR 12 dispatch
+        — so zero-match traffic pays the drafter's host lookups and
+        nothing else.  Returns ``{slot: [token, ...]}``.  Inactive
+        slots run as inert sentinel lanes — the program shape never
+        depends on how many requests are live."""
+        verify = False
+        if self.spec_tokens:
+            for lane in lanes.values():
+                if len(lane) > 5 and lane[5] is not None and (
+                    np.asarray(lane[5]) >= 0
+                ).any():
+                    verify = True
+                    break
+        if verify:
+            return self._verify_dispatch(lanes)
+        return self._burst_dispatch(lanes)
+
+    def _burst_dispatch(self, lanes: dict) -> dict:
         s, k = self.max_slots, self.decode_burst
         tables = np.zeros((s, self._bps), np.int32)
         lengths = np.zeros((s,), np.int32)
         tokens = np.zeros((s,), np.int32)
+        drafts = np.zeros((s, 0), np.int32)  # static width 0: burst body
         keydata = np.zeros((s, k) + self._key_shape, self._key_dtype)
         temperature = np.zeros((s,), np.float32)
         top_k = np.zeros((s,), np.int32)
         top_p = np.ones((s,), np.float32)
         refresh = np.zeros((s,), bool)
-        for slot, (tok, kd, t, tk, p) in lanes.items():
+        for slot, lane in lanes.items():
+            tok, kd, t, tk, p = lane[:5]
             tables[slot] = self._tables[slot]
             lengths[slot] = self._lengths[slot]
             tokens[slot] = tok
             kd = np.asarray(kd, self._key_dtype).reshape(
                 (-1,) + self._key_shape
-            )
-            keydata[slot, : kd.shape[0]] = kd[:k]
+            )[:k]
+            keydata[slot, : kd.shape[0]] = kd
             temperature[slot] = t
             top_k[slot] = tk
             top_p[slot] = p
@@ -676,8 +810,9 @@ class InferenceEngine:
                 self.params, self._views, self.pool,
                 jnp.asarray(refresh), jnp.asarray(tables),
                 jnp.asarray(lengths), jnp.asarray(tokens),
-                jnp.asarray(keydata), jnp.asarray(temperature),
-                jnp.asarray(top_k), jnp.asarray(top_p),
+                jnp.asarray(drafts), jnp.asarray(keydata),
+                jnp.asarray(temperature), jnp.asarray(top_k),
+                jnp.asarray(top_p),
             )
             nxt = np.asarray(nxt)  # [K, S]
         self._views_fresh[refresh] = False
@@ -687,9 +822,110 @@ class InferenceEngine:
             slot: [int(nxt[i, slot]) for i in range(k)] for slot in lanes
         }
 
+    def _verify_dispatch(self, lanes: dict) -> dict:
+        """Speculative verify: one width-``spec_tokens+1`` apply per
+        lane through the one decode entry point, then host-side
+        accepted-prefix truncation + length rollback.  A lane's
+        emitted tokens are ALL target samples (the accepted candidates
+        equal the matched drafts by the accept rule; the final token is
+        the target's correction) — drafts steer which positions get
+        verified, never what is emitted, which is why byte-identity to
+        solo ``generate()`` holds at any acceptance rate."""
+        s, spec = self.max_slots, self.spec_tokens
+        w = spec + 1
+        tables = np.zeros((s, self._bps), np.int32)
+        lengths = np.zeros((s,), np.int32)
+        tokens = np.zeros((s,), np.int32)
+        drafts = np.full((s, spec), -1, np.int32)
+        keydata = np.zeros((s, w) + self._key_shape, self._key_dtype)
+        temperature = np.zeros((s,), np.float32)
+        top_k = np.zeros((s,), np.int32)
+        top_p = np.ones((s,), np.float32)
+        refresh = np.zeros((s,), bool)
+        for slot, lane in lanes.items():
+            tok, kd, t, tk, p = lane[:5]
+            tables[slot] = self._tables[slot]
+            lengths[slot] = self._lengths[slot]
+            tokens[slot] = tok
+            kd = np.asarray(kd, self._key_dtype).reshape(
+                (-1,) + self._key_shape
+            )[:w]
+            keydata[slot, : kd.shape[0]] = kd
+            temperature[slot] = t
+            top_k[slot] = tk
+            top_p[slot] = p
+            if len(lane) > 5 and lane[5] is not None:
+                dr = np.asarray(lane[5], np.int32).reshape(-1)[:spec]
+                drafts[slot, : dr.shape[0]] = dr
+            if self._views_fresh[slot]:
+                refresh[slot] = True
+        with self.registry.span(reglib.SERVE_DECODE):
+            self._views, cand = self._decode_j(
+                self.params, self._views, self.pool,
+                jnp.asarray(refresh), jnp.asarray(tables),
+                jnp.asarray(lengths), jnp.asarray(tokens),
+                jnp.asarray(drafts), jnp.asarray(keydata),
+                jnp.asarray(temperature), jnp.asarray(top_k),
+                jnp.asarray(top_p),
+            )
+            cand = np.asarray(cand)  # [S, W]
+        self._views_fresh[refresh] = False
+        out: dict = {}
+        drafted = accepted = emitted = 0
+        for slot in lanes:
+            row = cand[slot]
+            dvec = drafts[slot]
+            # Accept rule: draft i is accepted iff the target's own
+            # sample at its position equals it; emit the accepted
+            # prefix plus the first mismatch's target sample.
+            m = 1
+            while m <= spec and dvec[m - 1] >= 0 and (
+                int(dvec[m - 1]) == int(row[m - 1])
+            ):
+                m += 1
+            drafted += int((dvec >= 0).sum())
+            accepted += m - 1
+            emitted += m
+            self._lengths[slot] = kv_slots.rollback_length(
+                int(self._lengths[slot]), w, m
+            )
+            out[slot] = [int(row[i]) for i in range(m)]
+        self.registry.counter(reglib.SERVE_SPEC_DRAFTED).inc(drafted)
+        self.registry.counter(reglib.SERVE_SPEC_ACCEPTED).inc(accepted)
+        if drafted:
+            self.registry.timer(reglib.SERVE_SPEC_ACCEPTANCE_RATE).record(
+                accepted / drafted
+            )
+        self.registry.timer(
+            reglib.SERVE_SPEC_TOKENS_PER_DISPATCH
+        ).record(emitted / len(lanes))
+        return out
+
+    def fsck(self) -> list:
+        """Fsck-style arena audit (:func:`~.kv_slots.check_arena`)
+        over the live slot tables, rolled-back lengths, block
+        ownership, and the prefix trie's residency ledger; returns
+        violation strings (empty = consistent).  Cheap enough to run
+        after every scheduler iteration in tests."""
+        return kv_slots.check_arena(
+            self.blocks, self._tables, self._lengths, self._slot_blocks,
+            self._page,
+            resident_blocks=(
+                self.prefix_cache.resident_blocks()
+                if self.prefix_cache is not None else ()
+            ),
+        )
+
     def compile_counts(self) -> tuple[int, int]:
-        """(prefill, decode) compiled-program counts — the shape-stability
-        invariant tests pin to ``(1, 1)`` after a mixed workload."""
+        """(prefill, decode) compiled-program counts — the
+        shape-stability invariant tests pin.  With ``spec_tokens == 0``
+        the pin is ``(1, 1)`` exactly as in PR 12.  With speculation on
+        the decode entry point traces a SECOND instance — the
+        width-``spec_tokens+1`` verify body, selected by the static
+        draft-operand width — so a spec-on engine steady-states at
+        ``(1, 2)``: a deliberate, documented pin update (one extra
+        program per engine lifetime, fixed at construction like
+        ``decode_burst``), never a per-traffic recompile."""
         return (
             int(self._prefill_j._cache_size()),
             int(self._decode_j._cache_size()),

@@ -239,6 +239,109 @@ def placeholder_counters(views, caches):
     return walk(views, caches)
 
 
+def rollback_length(length: int, written: int, kept: int) -> int:
+    """New true length for a slot after a speculative verify dispatch:
+    the dispatch physically wrote ``written`` view positions starting at
+    ``length`` (the carried last token plus the drafted window), but
+    only the first ``kept`` of them hold real tokens (the carry plus
+    the accepted draft prefix) — the rejected tail is rolled back by
+    simply not counting it.
+
+    This is the whole rollback, by construction of the arena: decode
+    writes K/V only into the slot's PRIVATE donated view, never the
+    pool, so rejected-position bytes can never reach a shared or
+    copy-on-write prefix-trie page; device counters are rebuilt from
+    host lengths on every dispatch (:func:`set_counters`), so the
+    advanced in-cache counters die with :func:`placeholder_counters`;
+    and the next dispatch's window starts AT the rolled-back length, so
+    every rejected position is overwritten by real K/V before any
+    query row can attend to it (the same just-in-time-overwrite
+    argument that makes right-padded prefill sound).  Block tables are
+    untouched: the request's whole-page reservation was taken at
+    admission for ``prompt + max_new``, which bounds the true length
+    from above no matter how speculation interleaves, so a rollback
+    never vacates a page the request won't re-fill — there is nothing
+    to release or re-point (:func:`check_arena` asserts the
+    reservation-covers-length invariant either way)."""
+    if not 1 <= kept <= written:
+        raise ValueError(
+            f"kept {kept} must be in [1, written={written}]"
+        )
+    if length < 0:
+        raise ValueError(f"length must be >= 0, got {length}")
+    return int(length) + int(kept)
+
+
+def check_arena(pool, tables, lengths, slot_blocks, page_tokens: int,
+                resident_blocks=()) -> list:
+    """Fsck-style invariant sweep over the paged arena's host
+    bookkeeping; returns a list of violation strings (empty = clean).
+
+    Checked invariants (the ones speculation's length rollback could
+    corrupt if it ever touched block state):
+
+    - the sentinel (block 0) is never allocated and never owned;
+    - every block a slot owns is allocated, and its table row is
+      exactly its owned blocks followed by sentinel padding;
+    - each slot's whole-page reservation covers its live length
+      (``ceil(length / page_tokens)`` pages) — a rolled-back length may
+      strictly undershoot its reservation, never overshoot it;
+    - refcount conservation: every allocated block's count equals the
+      number of slot owners listing it plus its prefix-cache residency
+      (``resident_blocks``), and allocated + free = pool capacity.
+    """
+    problems: list = []
+    page = int(page_tokens)
+    if pool.refcount(0) != 0:
+        problems.append(f"sentinel block 0 has refcount {pool.refcount(0)}")
+    holders: dict = {}
+    for slot, blocks in slot_blocks.items():
+        if 0 in blocks:
+            problems.append(f"slot {slot} owns the sentinel block")
+        for b in blocks:
+            holders[b] = holders.get(b, 0) + 1
+            if b != 0 and pool.refcount(b) < 1:
+                problems.append(
+                    f"slot {slot} owns unallocated block {b}"
+                )
+        row = [int(x) for x in tables[slot]]
+        if row[: len(blocks)] != [int(b) for b in blocks]:
+            problems.append(
+                f"slot {slot} table row {row[:len(blocks)]} != owned "
+                f"blocks {blocks}"
+            )
+        if any(x != 0 for x in row[len(blocks):]):
+            problems.append(
+                f"slot {slot} table padding is not all-sentinel: "
+                f"{row[len(blocks):]}"
+            )
+        need = -(-int(lengths[slot]) // page)
+        if need > len(blocks):
+            problems.append(
+                f"slot {slot} length {int(lengths[slot])} needs {need} "
+                f"pages but owns only {len(blocks)}"
+            )
+    for b in resident_blocks:
+        holders[b] = holders.get(b, 0) + 1
+    for b, n in sorted(holders.items()):
+        if b != 0 and pool.refcount(b) != n:
+            problems.append(
+                f"block {b} refcount {pool.refcount(b)} != {n} holders"
+            )
+    for b in range(1, pool.num_blocks):
+        if pool.refcount(b) > 0 and b not in holders:
+            problems.append(
+                f"block {b} allocated (refcount {pool.refcount(b)}) "
+                f"but no slot or cache holds it — leaked"
+            )
+    if pool.free_count + pool.used_count != pool.num_blocks - 1:
+        problems.append(
+            f"free {pool.free_count} + used {pool.used_count} != "
+            f"capacity {pool.num_blocks - 1}"
+        )
+    return problems
+
+
 def scatter_pages(pool, pages, indices):
     """Write ``pages`` (leaves ``[n, page, H, Dh]``) into the pool at
     physical block ``indices`` (``[n]`` int32, traced ok).  Duplicate

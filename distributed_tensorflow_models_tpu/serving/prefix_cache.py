@@ -81,6 +81,18 @@ class RadixPrefixCache:
         """Blocks currently referenced by the trie."""
         return self._resident
 
+    def resident_blocks(self) -> list:
+        """Every block id the trie currently holds a pool reference on
+        (one per node) — the cache's side of the refcount-conservation
+        ledger :func:`~.kv_slots.check_arena` audits."""
+        out = []
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            out.append(node.block)
+            stack.extend(node.children.values())
+        return out
+
     def match(self, pages: list) -> list:
         """Longest resident prefix of ``pages``; returns its block ids
         (possibly empty) and freshens the matched path's LRU stamps.

@@ -26,7 +26,13 @@ belong at TOKEN granularity, not request granularity.  Each
    the price of burst-granular admission, vLLM's multi-step
    scheduling).  Tokens a lane generates past its own finish line
    (EOS or ``max_new_tokens``) inside a burst are discarded here and
-   never emitted.
+   never emitted.  With speculation on (``engine.spec_tokens > 0``)
+   each lane also carries its drafter's proposal and the dispatch may
+   be a verify instead of a burst — the lane then returns a VARIABLE
+   number of tokens (1 to ``spec_tokens + 1``); the same discard loop
+   covers overrun past EOS mid-acceptance, and proposals are clipped
+   to the lane's remaining ``max_new`` budget before dispatch so
+   acceptance alone can never overrun it.
 3. **Retires** — sequences that emitted ``eos_id`` or reached
    ``max_new_tokens`` release their slot and block references
    (``engine.release``; pages the prefix cache adopted stay resident
@@ -61,6 +67,8 @@ from typing import Optional
 import numpy as np
 
 from distributed_tensorflow_models_tpu.telemetry import registry as reglib
+
+from .drafter import NO_DRAFT, NgramDrafter
 
 
 @dataclasses.dataclass
@@ -99,7 +107,7 @@ class _InFlight:
 
     __slots__ = (
         "req", "slot", "keydata", "tokens", "pos", "t_submit", "ttft_s",
-        "t_last",
+        "t_last", "drafter",
     )
 
     def __init__(self, req, slot, keydata, t_submit):
@@ -111,6 +119,7 @@ class _InFlight:
         self.t_submit = t_submit
         self.ttft_s = 0.0
         self.t_last = 0.0
+        self.drafter = None  # set at admission when speculation is on
 
 
 class ContinuousBatchingScheduler:
@@ -128,8 +137,18 @@ class ContinuousBatchingScheduler:
         *,
         max_prefill_tokens: Optional[int] = None,
         registry: Optional[reglib.MetricsRegistry] = None,
+        drafter_factory=None,
     ):
         self.engine = engine
+        # Speculation: when the engine was built with spec_tokens > 0,
+        # every admitted request gets a drafter (default: the n-gram
+        # self-drafter seeded with its prompt).  drafter_factory(req)
+        # overrides construction — tests inject oracle/adversarial
+        # drafters to pin the acceptance extremes.  Byte-identity of
+        # the output stream never depends on the drafter (the engine's
+        # verify rule owns correctness), so the factory is a pure
+        # throughput knob.
+        self._drafter_factory = drafter_factory
         # Default budget: half the arena's slots' worth of one chunk
         # each — enough to keep slots full under bursty arrivals without
         # ever spending more than ~half an iteration on prefill.
@@ -196,6 +215,8 @@ class ContinuousBatchingScheduler:
         """Record one generated token; True when the request is done."""
         inflight.tokens.append(token)
         inflight.pos += 1
+        if inflight.drafter is not None:
+            inflight.drafter.append(token)
         self.registry.counter(reglib.SERVE_TOKENS).inc()
         if inflight.pos == 1:
             inflight.ttft_s = now - inflight.t_submit
@@ -257,6 +278,16 @@ class ContinuousBatchingScheduler:
             slot, cached_len = admitted
             inflight = self._waiting.popleft()
             inflight.slot = slot
+            if self.engine.spec_tokens:
+                if self._drafter_factory is not None:
+                    inflight.drafter = self._drafter_factory(req)
+                else:
+                    inflight.drafter = NgramDrafter(
+                        req.prompt,
+                        spec_tokens=self.engine.spec_tokens,
+                        ngram_order=self.engine.spec_ngram_order,
+                        min_match=self.engine.spec_min_match,
+                    )
             spent += self.engine.padded_suffix(
                 len(req.prompt), cached_len
             )
@@ -279,16 +310,34 @@ class ContinuousBatchingScheduler:
         # the loop below discards the overrun.
         if self._active:
             burst = self.engine.decode_burst
+            spec = self.engine.spec_tokens
+            # A verify dispatch samples spec + 1 positions per lane; a
+            # burst dispatch samples decode_burst.  The engine slices
+            # the rows it needs for whichever dispatch it routes to.
+            width = max(burst, spec + 1) if spec else burst
             lanes = {}
             for slot, inflight in self._active.items():
                 req = inflight.req
-                lanes[slot] = (
+                lane = (
                     inflight.tokens[-1],
                     inflight.keydata[
-                        inflight.pos: inflight.pos + burst
+                        inflight.pos: inflight.pos + width
                     ],
                     req.temperature, req.top_k, req.top_p,
                 )
+                if spec:
+                    draft = inflight.drafter.propose()
+                    # Cap in-flight drafted tokens against the lane's
+                    # remaining max_new budget: full acceptance emits
+                    # accepted + 1 tokens, so at most rem - 1 drafts may
+                    # stand — the rest become NO_DRAFT and can't be
+                    # accepted (overrun past EOS is still possible and
+                    # is discarded below, same as a burst overrun).
+                    rem = req.max_new_tokens - inflight.pos
+                    if rem - 1 < spec:
+                        draft[max(0, rem - 1):] = NO_DRAFT
+                    lane = lane + (draft,)
+                lanes[slot] = lane
             next_tokens = self.engine.decode_step(lanes)
             now = time.perf_counter()
             # 3. retire finished sequences (their slots are refillable

@@ -275,6 +275,17 @@ class LMServer:
         snap[reglib.SERVE_PREFIX_CACHE_HIT_RATE] = (
             hits / (hits + misses) if hits + misses > 0 else 0.0
         )
+        # Speculation keys exist only when the engine runs spec-on (the
+        # full-set-or-absent contract --serving-report validates), so
+        # the p99 expansions are conditional on presence — the timer()
+        # accessor would CREATE the key on a spec-off server.
+        for name in (
+            reglib.SERVE_SPEC_ACCEPTANCE_RATE,
+            reglib.SERVE_SPEC_TOKENS_PER_DISPATCH,
+        ):
+            if f"{name}/count" in snap:
+                (p99,) = self.registry.timer(name).percentiles(0.99)
+                snap[f"{name}/p99_s"] = p99
         return {
             "version": 1,
             "process_index": self.process_index,
@@ -345,6 +356,11 @@ class LMServer:
             # drain artifacts would miss them.
             if engine.registry is reglib.get_registry():
                 engine.registry = self.registry
+                # The ctor pre-created any speculation metrics in the
+                # registry we just swapped out; re-create them here so
+                # an idle spec-on server still reports the full
+                # serve/spec_* set (and a spec-off one reports none).
+                engine._ensure_spec_metrics()
             from distributed_tensorflow_models_tpu.serving.scheduler import (
                 ContinuousBatchingScheduler,
             )
@@ -478,6 +494,9 @@ def _drill_engine_factory(args):
             kv_pool_blocks=args.kv_pool_blocks,
             prefix_cache=args.prefix_cache == "on",
             prefix_cache_blocks=args.prefix_cache_blocks,
+            spec_tokens=args.spec_tokens,
+            spec_ngram_order=args.spec_ngram_order,
+            spec_min_match=args.spec_min_match,
         )
 
     return build
@@ -678,6 +697,19 @@ def main(argv=None) -> int:
         "--prefix-cache-blocks", type=int, default=None,
         help="bound on cache-resident blocks (default: unbounded; "
         "eviction is LRU either way)",
+    )
+    p.add_argument(
+        "--spec-tokens", type=int, default=0,
+        help="speculative decoding: draft tokens verified per dispatch "
+        "(0 = off; on costs one extra compiled decode instance)",
+    )
+    p.add_argument(
+        "--spec-ngram-order", type=int, default=3,
+        help="longest suffix n-gram the self-drafter matches",
+    )
+    p.add_argument(
+        "--spec-min-match", type=int, default=1,
+        help="shortest suffix match worth proposing a draft for",
     )
     p.add_argument("--max-prefill-tokens", type=int, default=None)
     p.add_argument("--drain-grace-s", type=float, default=30.0)

@@ -158,6 +158,22 @@ SERVE_PREFIX_CACHE_HIT_RATE = "serve/prefix_cache_hit_rate"  # report-only
 SERVE_BLOCKS_FREE = "serve/blocks_free"  # gauge
 SERVE_BLOCKS_RESIDENT = "serve/blocks_resident"  # gauge
 SERVE_BLOCK_FRAGMENTATION = "serve/block_fragmentation"  # gauge (0-1)
+# Speculative decoding (PR 15; engine spec_tokens > 0 — the keys exist
+# only when speculation is on, so a spec-off registry stays byte-for-
+# byte the PR 12 registry).  DRAFTED counts n-gram draft tokens fed to
+# verify dispatches, ACCEPTED the ones whose target sample matched
+# (acceptance can only cost throughput, never change a token — the
+# verify rule is byte-equality with solo sampling).  ACCEPTANCE_RATE is
+# a per-verify-dispatch sample (accepted/drafted, 0-1) recorded into a
+# timer for the p50/p99 surface; TOKENS_PER_DISPATCH the mean tokens a
+# verify dispatch emitted per active lane (1 = speculation paying
+# nothing, spec_tokens+1 = full acceptance).  Tune spec_tokens off
+# these: raise it while acceptance holds, drop it (or raise
+# spec_min_match) when the rate sits near zero.
+SERVE_SPEC_DRAFTED = "serve/spec_drafted"  # counter (draft tokens)
+SERVE_SPEC_ACCEPTED = "serve/spec_accepted"  # counter (accepted drafts)
+SERVE_SPEC_ACCEPTANCE_RATE = "serve/spec_acceptance_rate"  # timer (0-1)
+SERVE_SPEC_TOKENS_PER_DISPATCH = "serve/spec_tokens_per_dispatch"  # timer
 
 
 class Counter:

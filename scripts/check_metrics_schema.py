@@ -282,6 +282,15 @@ SERVING_P99 = (
     "serve/ttft_s", "serve/tpot_s", "serve/queue_depth",
     "serve/slot_occupancy",
 )
+# Speculative decoding keys: present ONLY when the engine ran spec-on
+# (spec_tokens > 0 pre-creates all of them; spec-off creates none), so
+# the contract is full-set-or-absent — a partial set means a writer
+# regression, never light load.
+SERVING_SPEC_COUNTERS = ("serve/spec_drafted", "serve/spec_accepted")
+SERVING_SPEC_TIMERS = (
+    "serve/spec_acceptance_rate", "serve/spec_tokens_per_dispatch",
+)
+SERVING_SPEC_P99 = SERVING_SPEC_TIMERS
 
 
 def check_serving_report(report) -> list[str]:
@@ -327,7 +336,42 @@ def check_serving_report(report) -> list[str]:
     for key in SERVING_P99:
         if f"{key}/p99_s" not in snap:
             errors.append(f"serving p99 expansion {key!r}/p99_s missing")
+    # Speculation section: any serve/spec_* key present implies the
+    # whole set (counters, timers, p99 expansions); values already
+    # passed the non-negativity sweep above via the serve/ prefix.
+    if any(k.startswith("serve/spec_") for k in snap):
+        for key in SERVING_SPEC_COUNTERS:
+            if key not in snap:
+                errors.append(f"speculation counter {key!r} missing")
+        for key in SERVING_SPEC_TIMERS:
+            if f"{key}/count" not in snap:
+                errors.append(
+                    f"speculation timer {key!r} missing (no /count)"
+                )
+        for key in SERVING_SPEC_P99:
+            if f"{key}/p99_s" not in snap:
+                errors.append(
+                    f"speculation p99 expansion {key!r}/p99_s missing"
+                )
     return errors
+
+
+def speculation_summary(snap: dict) -> str:
+    """One-line speculation section for the --serving-report output:
+    acceptance p50/p99 and mean tokens-per-dispatch, or the spec-off
+    marker when the engine never ran with spec_tokens > 0."""
+    if not any(k.startswith("serve/spec_") for k in snap):
+        return "speculation off"
+    drafted = int(snap.get("serve/spec_drafted", 0))
+    accepted = int(snap.get("serve/spec_accepted", 0))
+    return (
+        f"speculation: {drafted} drafted, {accepted} accepted, "
+        f"acceptance p50 "
+        f"{snap.get('serve/spec_acceptance_rate/p50_s', 0.0):.3f} "
+        f"p99 {snap.get('serve/spec_acceptance_rate/p99_s', 0.0):.3f}, "
+        f"tokens/dispatch mean "
+        f"{snap.get('serve/spec_tokens_per_dispatch/mean_s', 0.0):.2f}"
+    )
 
 
 # --------------------------------------------------------------------------
@@ -573,7 +617,8 @@ def main(argv=None) -> int:
         print(
             f"{args.path}: OK ({int(m['serve/requests'])} requests, "
             f"{int(m['serve/tokens'])} tokens, "
-            f"ttft p99 {m['serve/ttft_s/p99_s']:.4f}s)"
+            f"ttft p99 {m['serve/ttft_s/p99_s']:.4f}s; "
+            f"{speculation_summary(m)})"
         )
         return 0
     if args.flight_recorder:

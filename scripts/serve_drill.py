@@ -25,6 +25,11 @@ the serving drain contract:
   (both validated by ``scripts/check_metrics_schema.py``), and the
   victim actually served traffic before dying.
 
+A second arm repeats the drill with speculative decoding on
+(``--spec-tokens``, default 3): same checks, plus every request's token
+stream must be byte-equal to the spec-off arm's — speculation is a
+throughput knob, never a token knob, even under drain and failover.
+
 The parent process never imports jax (safe on a login host); all device
 work happens in the spawned replicas.  Exit 0 when every check passes.
 
@@ -99,7 +104,8 @@ def _schema_check(path: str, flag: str, errors: list[str]) -> None:
         errors.append(f"{flag} lint failed for {path}: {proc.stderr}")
 
 
-def run_drill(scratch: str, n_requests: int) -> list[str]:
+def run_drill(scratch: str, n_requests: int, *, spec_tokens: int = 0,
+              port: int = PORT) -> tuple[list[str], dict[int, dict]]:
     errors: list[str] = []
     queue_dir = os.path.join(scratch, "queue")
     workdir = os.path.join(scratch, "wd")
@@ -121,8 +127,10 @@ def run_drill(scratch: str, n_requests: int) -> list[str]:
         "--sigterm-replica", str(VICTIM),
         "--timeout", "240",
     ]
+    if spec_tokens:
+        argv += ["--spec-tokens", str(spec_tokens)]
     codes = launch.launch_local(
-        2, argv, port=PORT, timeout=420.0,
+        2, argv, port=port, timeout=420.0,
         extra_env={
             "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
             "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
@@ -237,7 +245,19 @@ def run_drill(scratch: str, n_requests: int) -> list[str]:
                 f"ttft p99 {snap['serve/ttft_s/p99_s'] * 1e3:.1f}ms, "
                 f"tpot p99 {snap['serve/tpot_s/p99_s'] * 1e3:.1f}ms"
             )
-    return errors
+            has_spec = any(k.startswith("serve/spec_") for k in snap)
+            if spec_tokens and not has_spec:
+                errors.append(
+                    f"p{proc_index}: spec-on stats carry no "
+                    "serve/spec_* keys"
+                )
+            if not spec_tokens and has_spec:
+                errors.append(
+                    f"p{proc_index}: spec-off stats leak serve/spec_* "
+                    f"keys: "
+                    f"{sorted(k for k in snap if k.startswith('serve/spec_'))}"
+                )
+    return errors, responses
 
 
 def main(argv=None) -> int:
@@ -256,6 +276,10 @@ def main(argv=None) -> int:
         help="skip the dtm-lint pre-drill gate (debugging only: a tree "
         "with recompile-hazard or lock-discipline findings can hang or "
         "thrash the very serving path this drill certifies)",
+    )
+    p.add_argument(
+        "--spec-tokens", type=int, default=3,
+        help="draft depth of the speculative arm (0 skips that arm)",
     )
     args = p.parse_args(argv)
 
@@ -288,7 +312,32 @@ def main(argv=None) -> int:
         print(f"serve drill in {scratch}: {args.requests} requests, "
               f"2 replicas, SIGTERM replica {VICTIM} after "
               f"{SIGTERM_AFTER} responses")
-        errors = run_drill(scratch, args.requests)
+        errors = []
+        base_errors, base_resp = run_drill(
+            os.path.join(scratch, "base"), args.requests
+        )
+        errors += base_errors
+        if args.spec_tokens:
+            # Speculative arm: identical request mix through a spec-on
+            # fleet.  Exactly-once and drain checks run inside
+            # run_drill; on top, every request's stream (all modes are
+            # per-request-seeded, hence deterministic) must be
+            # byte-equal to the spec-off arm's — speculation is a
+            # throughput knob, never a token knob, even across drains
+            # and failovers.
+            print(f"  speculative arm: spec_tokens={args.spec_tokens}")
+            spec_errors, spec_resp = run_drill(
+                os.path.join(scratch, "spec"), args.requests,
+                spec_tokens=args.spec_tokens, port=PORT + 10,
+            )
+            errors += spec_errors
+            for rid in sorted(set(base_resp) & set(spec_resp)):
+                if base_resp[rid]["tokens"] != spec_resp[rid]["tokens"]:
+                    errors.append(
+                        f"request {rid}: spec-on stream diverged from "
+                        f"spec-off: {spec_resp[rid]['tokens']} vs "
+                        f"{base_resp[rid]['tokens']}"
+                    )
         failed = bool(errors)
         if errors:
             print("DRILL serve: FAIL", file=sys.stderr)

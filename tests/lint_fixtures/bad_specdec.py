@@ -1,0 +1,33 @@
+"""Known-bad: speculative-verify hazards — the host-side ACCEPTED
+count (data that changes with every dispatch's acceptance outcome)
+used as the verify window's SHAPE (one compiled program per outcome),
+and the donated verify working set read after the dispatch consumed
+its buffer.
+
+No module-level jax import on purpose (fixtures are linted as jax-free
+roots in strict mode); nothing here is ever executed.
+"""
+
+
+def verify_window(tokens, drafts, accepted):
+    window = tokens.reshape(1, accepted + 1)
+    return window
+
+
+class SpecEngine:
+    def __init__(self, fn):
+        self._verify = jax.jit(fn, donate_argnums=(1,))
+
+    def step(self, params, views, drafts):
+        out = self._verify(params, views, drafts)
+        stale = views.sum()
+        return out, stale
+
+    def rounds(self, params, views, waves):
+        out = None
+        for wave in waves:
+            out = self._verify(params, views, wave)
+        return out
+
+
+verify_j = jax.jit(verify_window)

@@ -66,6 +66,9 @@ BAD_EXPECT = {
     "bad_paged_arena.py": {("recompile-hazard", 12),
                            ("donation-safety", 22),
                            ("donation-safety", 28)},
+    "bad_specdec.py": {("recompile-hazard", 13),
+                       ("donation-safety", 23),
+                       ("donation-safety", 29)},
     "bad_lockdisc.py": {("lock-discipline", 13),
                         ("lock-discipline", 20),
                         ("lock-discipline", 24)},
@@ -93,6 +96,7 @@ GOOD_FILES = [
     "good_donation.py",
     "good_lockdisc.py",
     "good_paged_arena.py",
+    "good_specdec.py",
     "good_race.py",
     "good_collective_order.py",
     "good_resize.py",

@@ -37,6 +37,10 @@ from distributed_tensorflow_models_tpu.serving.prefix_cache import (
     RadixPrefixCache,
     prompt_pages,
 )
+from distributed_tensorflow_models_tpu.serving.drafter import (
+    NO_DRAFT,
+    NgramDrafter,
+)
 from distributed_tensorflow_models_tpu.serving.scheduler import (
     ContinuousBatchingScheduler,
     Request,
@@ -570,14 +574,404 @@ def test_prefix_cache_eviction_under_block_bound(small_lm):
     assert eng.compile_counts() == (1, 1)
 
 
+# -- speculative decoding ---------------------------------------------------
+
+
+class _ScriptedDrafter:
+    """Test drafter: proposes a fixed token script (the solo stream for
+    the oracle, its complement for the adversary), shifted by how many
+    tokens have been emitted.  Byte-identity must hold for BOTH — the
+    drafter steers throughput only."""
+
+    def __init__(self, script, spec_tokens):
+        self._script = [int(t) for t in script]
+        self._n = 0
+        self.spec_tokens = int(spec_tokens)
+
+    def append(self, token):
+        self._n += 1
+
+    def propose(self):
+        out = np.full((self.spec_tokens,), NO_DRAFT, np.int32)
+        cont = self._script[self._n: self._n + self.spec_tokens]
+        out[: len(cont)] = cont
+        return out
+
+
+def _solo_streams(model, params, reqs, rng0):
+    outs = {}
+    for i, r in enumerate(reqs):
+        t, k, p = r.temperature, r.top_k, r.top_p
+        rng = jax.random.fold_in(rng0, i) if t > 0 else None
+        solo = generate(
+            model, params, jnp.asarray(r.prompt)[None], r.max_new_tokens,
+            temperature=t, top_k=k, top_p=p, rng=rng,
+        )
+        outs[i] = np.asarray(solo)[0, len(r.prompt):].tolist()
+    return outs
+
+
+def test_spec_decode_bit_identical_all_modes(small_lm):
+    """The tentpole contract: the real n-gram self-drafter at
+    spec_tokens=3 over the full mixed-mode workload (greedy beside
+    temperature beside top-k beside nucleus, mid-flight admission into
+    recycled slots) — every stream byte-equal to solo ``generate()``
+    at whatever acceptance the drafter happens to get, the arena fsck
+    is clean, and the decode entry point holds at its documented TWO
+    instances (burst + verify; see ``compile_counts``)."""
+    model, params = small_lm
+    eng = InferenceEngine(
+        model, params, max_slots=4, prefill_chunk=8, spec_tokens=3,
+        registry=reglib.MetricsRegistry(),
+    )
+    sched = ContinuousBatchingScheduler(
+        eng, max_prefill_tokens=64, registry=eng.registry
+    )
+    rng0 = jax.random.key(7)
+    reqs = _mk_requests(rng0)
+    for r in reqs[:5]:
+        sched.submit(r)
+    done = []
+    done.extend(sched.step())
+    assert eng.fsck() == []
+    done.extend(sched.step())
+    sched.submit(reqs[5])  # late arrival into a half-advanced batch
+    while sched.has_work:
+        done.extend(sched.step())
+        assert eng.fsck() == []
+    comps = {c.request_id: c for c in done}
+    assert sorted(comps) == list(range(6))
+    solo = _solo_streams(model, params, reqs, rng0)
+    for i in range(6):
+        assert comps[i].tokens == solo[i], (
+            f"request {i} mode {CONFIGS[i]}: speculative stream "
+            f"diverged from solo generate"
+        )
+    snap = eng.registry.snapshot()
+    assert snap[reglib.SERVE_SPEC_DRAFTED] >= 0
+    assert (
+        snap[reglib.SERVE_SPEC_ACCEPTED] <= snap[reglib.SERVE_SPEC_DRAFTED]
+    )
+    # The deliberate pin update: ONE prefill program, TWO instances of
+    # the one decode entry point (the D=0 burst body + the D=spec
+    # verify body, selected by the static draft-operand width — fixed
+    # at construction, never a per-traffic recompile).
+    assert eng.compile_counts() == (1, 2)
+
+
+def test_spec_oracle_full_acceptance_and_dispatch_savings(small_lm):
+    """Acceptance ≈ 100%: an oracle drafter (fed the solo stream) has
+    every draft accepted, so each verify emits spec+1 tokens and the
+    number of decode dispatches collapses by ~that factor — while the
+    emitted stream stays byte-equal, because accepted candidates ARE
+    the target's own samples."""
+    model, params = small_lm
+    spec = 3
+    eng = InferenceEngine(
+        model, params, max_slots=2, prefill_chunk=8, spec_tokens=spec,
+        registry=reglib.MetricsRegistry(),
+    )
+    rng0 = jax.random.key(21)
+    reqs = []
+    for i in range(3):
+        prompt = np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng0, 300 + i), (5 + i,), 0, 50
+            ),
+            np.int32,
+        )
+        reqs.append(Request(request_id=i, prompt=prompt, max_new_tokens=12))
+    solo = _solo_streams(model, params, reqs, rng0)
+    sched = ContinuousBatchingScheduler(
+        eng, max_prefill_tokens=64, registry=eng.registry,
+        drafter_factory=lambda req: _ScriptedDrafter(
+            solo[req.request_id], spec
+        ),
+    )
+    for r in reqs:
+        sched.submit(r)
+    comps = {c.request_id: c for c in sched.run_until_idle()}
+    for i in range(3):
+        assert comps[i].tokens == solo[i], f"oracle stream {i} diverged"
+    snap = eng.registry.snapshot()
+    drafted = snap[reglib.SERVE_SPEC_DRAFTED]
+    assert drafted > 0
+    assert snap[reglib.SERVE_SPEC_ACCEPTED] == drafted  # every one
+    # 12 tokens at spec+1 per dispatch: ceil(11/4) = 3 verify
+    # dispatches per wave (first token comes from prefill), two waves
+    # (3 requests through 2 slots) — not the 11+ burst steps per wave
+    # a spec-off engine would pay.
+    dispatches = snap[f"{reglib.SERVE_DECODE}/count"]
+    assert dispatches <= 6
+    assert eng.fsck() == []
+
+
+def test_spec_adversarial_zero_acceptance_still_identical(small_lm):
+    """Acceptance ≈ 0: an adversarial drafter proposing the COMPLEMENT
+    of the true stream never gets a draft accepted — every verify
+    emits exactly one token (the target's correction), the stream is
+    still byte-equal solo, and the accounting shows zero accepted."""
+    model, params = small_lm
+    spec = 3
+    eng = InferenceEngine(
+        model, params, max_slots=2, prefill_chunk=8, spec_tokens=spec,
+        registry=reglib.MetricsRegistry(),
+    )
+    rng0 = jax.random.key(22)
+    reqs = []
+    for i in range(2):
+        prompt = np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng0, 400 + i), (6,), 0, 50
+            ),
+            np.int32,
+        )
+        rng = jax.random.fold_in(rng0, i) if i else None
+        reqs.append(
+            Request(
+                request_id=i, prompt=prompt, max_new_tokens=8,
+                temperature=0.9 if i else 0.0, top_k=7 if i else 0,
+                rng=rng,
+            )
+        )
+    solo = _solo_streams(model, params, reqs, rng0)
+    sched = ContinuousBatchingScheduler(
+        eng, max_prefill_tokens=64, registry=eng.registry,
+        drafter_factory=lambda req: _ScriptedDrafter(
+            [(t + 1) % 50 for t in solo[req.request_id]], spec
+        ),
+    )
+    for r in reqs:
+        sched.submit(r)
+    comps = {c.request_id: c for c in sched.run_until_idle()}
+    for i in range(2):
+        assert comps[i].tokens == solo[i], (
+            f"adversarial stream {i} diverged"
+        )
+    snap = eng.registry.snapshot()
+    assert snap[reglib.SERVE_SPEC_DRAFTED] > 0
+    assert snap[reglib.SERVE_SPEC_ACCEPTED] == 0
+    assert eng.fsck() == []
+
+
+def test_spec_mixed_lanes_oracle_beside_adversary(small_lm):
+    """One verify dispatch carrying BOTH extremes: an oracle lane
+    accepting everything beside an adversarial lane rejecting
+    everything (per-lane variable emission in the same dispatch) —
+    both streams byte-equal solo."""
+    model, params = small_lm
+    spec = 3
+    eng = InferenceEngine(
+        model, params, max_slots=2, prefill_chunk=8, spec_tokens=spec,
+        registry=reglib.MetricsRegistry(),
+    )
+    rng0 = jax.random.key(23)
+    reqs = []
+    for i in range(2):
+        prompt = np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng0, 500 + i), (7,), 0, 50
+            ),
+            np.int32,
+        )
+        reqs.append(Request(request_id=i, prompt=prompt, max_new_tokens=10))
+    solo = _solo_streams(model, params, reqs, rng0)
+
+    def factory(req):
+        if req.request_id == 0:
+            return _ScriptedDrafter(solo[0], spec)  # oracle
+        return _ScriptedDrafter([(t + 1) % 50 for t in solo[1]], spec)
+
+    sched = ContinuousBatchingScheduler(
+        eng, max_prefill_tokens=64, registry=eng.registry,
+        drafter_factory=factory,
+    )
+    for r in reqs:
+        sched.submit(r)
+    comps = {c.request_id: c for c in sched.run_until_idle()}
+    assert comps[0].tokens == solo[0], "oracle lane diverged"
+    assert comps[1].tokens == solo[1], "adversarial lane diverged"
+    snap = eng.registry.snapshot()
+    assert 0 < snap[reglib.SERVE_SPEC_ACCEPTED] < (
+        snap[reglib.SERVE_SPEC_DRAFTED]
+    )
+
+
+def test_spec_rollback_arena_consistency(small_lm):
+    """Rejected-position rollback never touches shared state: after the
+    prefill wave, the POOL bytes are bit-frozen through every verify
+    dispatch (rejected K/V lands only in per-lane private views), the
+    fsck sweep (refcounts, table rows, reservations, residency,
+    conservation) stays clean at every iteration, and retirement
+    returns every non-resident block."""
+    model, params = small_lm
+    eng = InferenceEngine(
+        model, params, max_slots=2, prefill_chunk=8, spec_tokens=3,
+        registry=reglib.MetricsRegistry(),
+    )
+    sched = ContinuousBatchingScheduler(
+        eng, max_prefill_tokens=64, registry=eng.registry
+    )
+    rng0 = jax.random.key(31)
+    for i in range(2):
+        prompt = np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng0, 600 + i), (9,), 0, 50
+            ),
+            np.int32,
+        )
+        sched.submit(Request(request_id=i, prompt=prompt, max_new_tokens=10))
+    sched.step()  # admission + prefill wave + first decode? no waiters left
+    pool0 = [np.asarray(x) for x in jax.tree_util.tree_leaves(eng.pool)]
+    while sched.has_work:
+        sched.step()
+        assert eng.fsck() == []
+        pool1 = [
+            np.asarray(x) for x in jax.tree_util.tree_leaves(eng.pool)
+        ]
+        for a, b in zip(pool0, pool1):
+            np.testing.assert_array_equal(
+                a, b, err_msg="decode dispatch wrote the shared pool"
+            )
+    assert eng.slots.active_count == 0
+    assert eng.blocks.used_count == eng.blocks_resident
+    assert eng.fsck() == []
+
+
+def test_spec_budget_and_eos_overrun_discard(small_lm):
+    """The budget/overrun edges of variable-length emission:
+
+    - full acceptance against a small ``max_new_tokens`` stops exactly
+      at the budget (proposals are clipped to the remaining budget
+      before dispatch, so acceptance can never overrun it);
+    - an EOS landing mid-acceptance retires the stream AT the EOS,
+      discarding the accepted overrun past it — the rejection-path
+      extension of the burst mid-EOS discard."""
+    model, params = small_lm
+    spec = 3
+    eng = InferenceEngine(
+        model, params, max_slots=2, prefill_chunk=8, spec_tokens=spec,
+        registry=reglib.MetricsRegistry(),
+    )
+    prompt = np.asarray([1, 2, 3], np.int32)
+    solo = np.asarray(
+        generate(model, params, jnp.asarray(prompt)[None], 8)
+    )[0, len(prompt):].tolist()
+
+    # Budget edge: max_new_tokens=5 with a perfect oracle.
+    sched = ContinuousBatchingScheduler(
+        eng, registry=eng.registry,
+        drafter_factory=lambda req: _ScriptedDrafter(solo, spec),
+    )
+    sched.submit(Request(request_id=0, prompt=prompt, max_new_tokens=5))
+    (comp,) = sched.run_until_idle()
+    assert comp.tokens == solo[:5]
+    assert comp.finish_reason == "length"
+
+    # EOS edge: pick the 3rd generated token as EOS; the oracle keeps
+    # proposing past it, so the EOS is accepted mid-verify with more
+    # accepted tokens behind it — all discarded.
+    eos = solo[2]
+    sched.submit(
+        Request(
+            request_id=1, prompt=prompt, max_new_tokens=8, eos_id=eos
+        )
+    )
+    (comp,) = sched.run_until_idle()
+    assert comp.finish_reason == "eos"
+    assert comp.tokens == solo[: solo.index(eos) + 1]
+    assert eng.fsck() == []
+
+
+def test_spec_off_has_no_spec_surface(small_lm):
+    """``spec_tokens=0`` is the PR 12 engine: no drafters, no
+    ``serve/spec_*`` keys in the snapshot (the full-set-or-absent
+    contract), and the compile pin stays exactly (1, 1)."""
+    model, params = small_lm
+    eng = InferenceEngine(
+        model, params, max_slots=2, prefill_chunk=8,
+        registry=reglib.MetricsRegistry(),
+    )
+    sched = ContinuousBatchingScheduler(eng, registry=eng.registry)
+    sched.submit(
+        Request(
+            request_id=0, prompt=np.asarray([1, 2, 3], np.int32),
+            max_new_tokens=6,
+        )
+    )
+    (comp,) = sched.run_until_idle()
+    assert len(comp.tokens) == 6
+    snap = eng.registry.snapshot()
+    assert not [k for k in snap if k.startswith("serve/spec_")]
+    assert eng.compile_counts() == (1, 1)
+
+
+def test_spec_constructor_validation(small_lm):
+    model, params = small_lm
+    with pytest.raises(ValueError, match="spec_tokens"):
+        InferenceEngine(
+            model, params, max_slots=2, spec_tokens=-1,
+            registry=reglib.MetricsRegistry(),
+        )
+    with pytest.raises(ValueError, match="spec_min_match"):
+        InferenceEngine(
+            model, params, max_slots=2, spec_tokens=2, spec_min_match=0,
+            registry=reglib.MetricsRegistry(),
+        )
+    with pytest.raises(ValueError, match="spec_ngram_order"):
+        InferenceEngine(
+            model, params, max_slots=2, spec_tokens=2,
+            spec_ngram_order=1, spec_min_match=2,
+            registry=reglib.MetricsRegistry(),
+        )
+    # The headroom rule: a request needs spec_tokens of slack past its
+    # total so a verify window can never slide over real positions.
+    eng = InferenceEngine(
+        model, params, max_slots=2, prefill_chunk=8, spec_tokens=4,
+        registry=reglib.MetricsRegistry(),
+    )
+    with pytest.raises(ValueError, match="headroom"):
+        eng.check_fits(40, eng.max_len - 40)  # fits solo, not spec-on
+
+
+def test_ngram_drafter_tables():
+    """The drafter itself: longest-match-first, most-recent-occurrence
+    wins, NO_DRAFT padding, and incremental append == from-scratch."""
+    d = NgramDrafter([5, 6, 7, 5, 6], spec_tokens=3, ngram_order=2)
+    # Suffix [5, 6] occurred before at positions 0-1; continuation: 7.
+    # It is followed by 7, 5 — only 3 history tokens follow, so the
+    # proposal carries them and pads nothing (7, 5, 6 minus overlap).
+    out = d.propose().tolist()
+    assert out[0] == 7
+    d2 = NgramDrafter([9], spec_tokens=2, min_match=2, ngram_order=3)
+    assert d2.propose().tolist() == [NO_DRAFT, NO_DRAFT]  # nothing yet
+    for t in [1, 2, 3, 1, 2]:
+        d2.append(t)
+    assert d2.propose().tolist() == [3, 1]  # [1,2] recurs, cont 3,1
+    # Constant runs / short cycles: the latest previous occurrence is
+    # one period behind the suffix, so the continuation is extended
+    # periodically instead of truncated at end-of-history.
+    d3 = NgramDrafter([4, 4, 4, 4], spec_tokens=5, ngram_order=3)
+    assert d3.propose().tolist() == [4, 4, 4, 4, 4]
+    d4 = NgramDrafter([1, 2, 1, 2, 1, 2], spec_tokens=4, ngram_order=3)
+    assert d4.propose().tolist() == [1, 2, 1, 2]
+    with pytest.raises(ValueError):
+        NgramDrafter([1], spec_tokens=0)
+    with pytest.raises(ValueError):
+        NgramDrafter([1], spec_tokens=2, min_match=0)
+    with pytest.raises(ValueError):
+        NgramDrafter([1], spec_tokens=2, ngram_order=1, min_match=2)
+
+
 # -- server front half -----------------------------------------------------
 
 
-def _factory(max_slots=4, prefill_chunk=8):
+def _factory(max_slots=4, prefill_chunk=8, spec_tokens=0):
     def build():
         model, params = _small_lm()
         return InferenceEngine(
-            model, params, max_slots=max_slots, prefill_chunk=prefill_chunk
+            model, params, max_slots=max_slots,
+            prefill_chunk=prefill_chunk, spec_tokens=spec_tokens,
         )
 
     return build
@@ -587,8 +981,13 @@ def _factory(max_slots=4, prefill_chunk=8):
 def test_server_lifecycle_and_drain_artifacts(tmp_path):
     """Submit → results → stats → drain: post-drain submits are
     rejected, and the exit leaves a schema-clean serving stats report
-    and flight record (validated by the SAME lint an operator runs)."""
-    srv = LMServer(_factory(), workdir=str(tmp_path), process_index=0)
+    and flight record (validated by the SAME lint an operator runs).
+    Runs spec-on: the declared-coverage check below requires every
+    SERVE_* constant in the report, and the serve/spec_* keys exist
+    only on a spec-on server (full-set-or-absent contract)."""
+    srv = LMServer(
+        _factory(spec_tokens=2), workdir=str(tmp_path), process_index=0
+    )
     with pytest.raises(RuntimeError):
         srv.submit([1, 2], 2)  # not started
     srv.start()
