@@ -26,6 +26,8 @@ JAX_FREE_ROOTS = (
     f"{PACKAGE}/resilience/backoff.py",
     f"{PACKAGE}/resilience/heartbeat.py",
     f"{PACKAGE}/serving/server.py",
+    f"{PACKAGE}/telemetry/slo.py",
+    f"{PACKAGE}/telemetry/timeseries.py",
 )
 
 # Modules whose behaviour feeds checkpointed state, dataset cursors, or
@@ -41,6 +43,12 @@ DETERMINISM_SCOPE = (
     f"{PACKAGE}/parallel/async_ps.py",
     f"{PACKAGE}/parallel/backup.py",
     f"{PACKAGE}/harness/generate.py",
+    # Serving replay surface (ISSUE 16): the scheduler's admission /
+    # wave ordering must replay bit-identically, and SLO windows feed
+    # breach forensics — wall-clock reads belong in timeseries.py
+    # (deliberately NOT scoped: its rows carry ts_wall by design).
+    f"{PACKAGE}/serving/scheduler.py",
+    f"{PACKAGE}/telemetry/slo.py",
 )
 
 METRIC_REGISTRY = f"{PACKAGE}/telemetry/registry.py"

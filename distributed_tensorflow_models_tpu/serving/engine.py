@@ -82,6 +82,7 @@ regardless of what it was batched with.
 from __future__ import annotations
 
 import math
+import time
 from typing import Optional
 
 import jax
@@ -805,16 +806,26 @@ class InferenceEngine:
             # fresh slot not decoded yet keeps its flag for later).
             if self._views_fresh[slot]:
                 refresh[slot] = True
-        with self.registry.span(reglib.SERVE_DECODE):
-            self._views, nxt = self._decode_j(
-                self.params, self._views, self.pool,
-                jnp.asarray(refresh), jnp.asarray(tables),
-                jnp.asarray(lengths), jnp.asarray(tokens),
-                jnp.asarray(drafts), jnp.asarray(keydata),
-                jnp.asarray(temperature), jnp.asarray(top_k),
-                jnp.asarray(top_p),
+        # Explicit timing, not registry.span: the dispatch loop stays
+        # free of contextmanager enters/exits, and the trace event gets
+        # dispatch-kind args the generic span can't carry.
+        t0 = time.perf_counter()
+        self._views, nxt = self._decode_j(
+            self.params, self._views, self.pool,
+            jnp.asarray(refresh), jnp.asarray(tables),
+            jnp.asarray(lengths), jnp.asarray(tokens),
+            jnp.asarray(drafts), jnp.asarray(keydata),
+            jnp.asarray(temperature), jnp.asarray(top_k),
+            jnp.asarray(top_p),
+        )
+        nxt = np.asarray(nxt)  # [K, S]
+        dt = time.perf_counter() - t0
+        self.registry.timer(reglib.SERVE_DECODE).record(dt)
+        if self.registry.trace.enabled:
+            self.registry.trace.complete(
+                reglib.SERVE_DECODE, dt, ts_mono=t0,
+                args={"kind": "burst", "lanes": len(lanes), "width": k},
             )
-            nxt = np.asarray(nxt)  # [K, S]
         self._views_fresh[refresh] = False
         for slot in lanes:
             self._lengths[slot] += k
@@ -859,16 +870,23 @@ class InferenceEngine:
                 drafts[slot, : dr.shape[0]] = dr
             if self._views_fresh[slot]:
                 refresh[slot] = True
-        with self.registry.span(reglib.SERVE_DECODE):
-            self._views, cand = self._decode_j(
-                self.params, self._views, self.pool,
-                jnp.asarray(refresh), jnp.asarray(tables),
-                jnp.asarray(lengths), jnp.asarray(tokens),
-                jnp.asarray(drafts), jnp.asarray(keydata),
-                jnp.asarray(temperature), jnp.asarray(top_k),
-                jnp.asarray(top_p),
+        t0 = time.perf_counter()
+        self._views, cand = self._decode_j(
+            self.params, self._views, self.pool,
+            jnp.asarray(refresh), jnp.asarray(tables),
+            jnp.asarray(lengths), jnp.asarray(tokens),
+            jnp.asarray(drafts), jnp.asarray(keydata),
+            jnp.asarray(temperature), jnp.asarray(top_k),
+            jnp.asarray(top_p),
+        )
+        cand = np.asarray(cand)  # [S, W]
+        dt = time.perf_counter() - t0
+        self.registry.timer(reglib.SERVE_DECODE).record(dt)
+        if self.registry.trace.enabled:
+            self.registry.trace.complete(
+                reglib.SERVE_DECODE, dt, ts_mono=t0,
+                args={"kind": "verify", "lanes": len(lanes), "width": w},
             )
-            cand = np.asarray(cand)  # [S, W]
         self._views_fresh[refresh] = False
         out: dict = {}
         drafted = accepted = emitted = 0

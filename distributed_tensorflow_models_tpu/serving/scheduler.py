@@ -48,7 +48,23 @@ queue-depth and slot-occupancy sampled once per iteration into timers
 (``serve/blocks_free``, ``serve/blocks_resident``,
 ``serve/block_fragmentation``) refreshed once per iteration, plus the
 engine's own ``serve/prefill`` / ``serve/decode`` device spans and
-prefix-cache hit/miss/eviction counters.  With
+prefix-cache hit/miss/eviction counters.  With a live tracer attached
+(``registry.trace.enabled``) the scheduler additionally emits the
+PER-REQUEST lifecycle into the event ring — ``serve/req/queue``
+(enqueue → admission wave, shed reason in args when the request was
+backpressured), ``serve/req/prefill`` (prefix-cache hit length +
+padded uncached suffix in args), ``serve/req/decode`` (one per decode
+dispatch per lane, tokens emitted in args), ``serve/req/shed``
+instants on admission backpressure, and a ``serve/req/done`` instant
+at retirement — every event carrying ``rid`` so
+``scripts/serving_report.py`` can rebuild a per-request waterfall
+whose queue + prefill spans sum to the measured TTFT.  Emission is
+plain ``Tracer.complete``/``instant`` calls (no contextmanager enters
+in the dispatch loop), gated on ``trace.enabled`` so the tracing-off
+hot path pays one attribute check.  An attached
+:class:`~..telemetry.slo.SLOMonitor` (``slo_monitor=``) is fed TTFT /
+TPOT / queue-depth samples inline and evaluated once per iteration
+(rate-limited internally).  With
 ``decode_burst > 1`` a burst's tokens become host-visible together, so
 TPOT turns bimodal (≈0 intra-burst, the full dispatch gap at burst
 boundaries) — the p50/p99 spread IS the burst tradeoff; the mean stays
@@ -69,6 +85,14 @@ import numpy as np
 from distributed_tensorflow_models_tpu.telemetry import registry as reglib
 
 from .drafter import NO_DRAFT, NgramDrafter
+
+# Per-request lifecycle trace event names (Tracer ring events, not
+# registry metric keys — serving_report.py groups them by args["rid"]).
+REQ_QUEUE = "serve/req/queue"
+REQ_PREFILL = "serve/req/prefill"
+REQ_DECODE = "serve/req/decode"
+REQ_SHED = "serve/req/shed"
+REQ_DONE = "serve/req/done"
 
 
 @dataclasses.dataclass
@@ -107,7 +131,7 @@ class _InFlight:
 
     __slots__ = (
         "req", "slot", "keydata", "tokens", "pos", "t_submit", "ttft_s",
-        "t_last", "drafter",
+        "t_last", "drafter", "cached_len", "sheds", "shed_reason",
     )
 
     def __init__(self, req, slot, keydata, t_submit):
@@ -120,6 +144,9 @@ class _InFlight:
         self.ttft_s = 0.0
         self.t_last = 0.0
         self.drafter = None  # set at admission when speculation is on
+        self.cached_len = 0  # prefix-cache hit length, set at admission
+        self.sheds = 0  # backpressure events suffered while head-of-line
+        self.shed_reason = ""  # last shed reason ("no_slot" | "no_blocks")
 
 
 class ContinuousBatchingScheduler:
@@ -138,8 +165,13 @@ class ContinuousBatchingScheduler:
         max_prefill_tokens: Optional[int] = None,
         registry: Optional[reglib.MetricsRegistry] = None,
         drafter_factory=None,
+        slo_monitor=None,
     ):
         self.engine = engine
+        # Optional telemetry/slo.py monitor: _emit feeds it TTFT/TPOT
+        # samples, step's tail feeds queue depth and evaluates (the
+        # monitor rate-limits itself).  None costs one is-None check.
+        self.slo = slo_monitor
         # Speculation: when the engine was built with spec_tokens > 0,
         # every admitted request gets a drafter (default: the n-gram
         # self-drafter seeded with its prompt).  drafter_factory(req)
@@ -167,6 +199,10 @@ class ContinuousBatchingScheduler:
         )
         self._waiting: deque = deque()
         self._active: dict[int, _InFlight] = {}  # slot -> state
+        # Last (rid, reason) shed instant emitted — backpressure persists
+        # across iterations and the instant is only interesting on
+        # transition, not once per blocked step.
+        self._last_shed: Optional[tuple] = None
 
     # -- intake ------------------------------------------------------------
 
@@ -223,10 +259,13 @@ class ContinuousBatchingScheduler:
             self.registry.timer(reglib.SERVE_TTFT).record(
                 inflight.ttft_s
             )
+            if self.slo is not None:
+                self.slo.observe(reglib.SERVE_TTFT, inflight.ttft_s, now)
         else:
-            self.registry.timer(reglib.SERVE_TPOT).record(
-                now - inflight.t_last
-            )
+            tpot = now - inflight.t_last
+            self.registry.timer(reglib.SERVE_TPOT).record(tpot)
+            if self.slo is not None:
+                self.slo.observe(reglib.SERVE_TPOT, tpot, now)
         inflight.t_last = now
         req = inflight.req
         return (
@@ -244,6 +283,15 @@ class ContinuousBatchingScheduler:
             )
             else "length"
         )
+        self.registry.counter(reglib.SERVE_COMPLETED).inc()
+        trace = self.registry.trace
+        if trace.enabled:
+            trace.instant(REQ_DONE, {
+                "rid": inflight.req.request_id,
+                "reason": reason,
+                "tokens": inflight.pos,
+                "ttft_s": inflight.ttft_s,
+            })
         done.append(
             Completion(
                 request_id=inflight.req.request_id,
@@ -274,10 +322,33 @@ class ContinuousBatchingScheduler:
                 req.request_id, req.prompt, req.max_new_tokens
             )
             if admitted is None:
+                # Backpressure: note the shed on the blocked head-of-line
+                # waiter (its queue span will carry the reason) and emit
+                # a transition-deduped instant — once per (rid, reason),
+                # not once per blocked iteration.
+                reason = (
+                    "no_slot"
+                    if self.engine.slots.free_count < 1
+                    else "no_blocks"
+                )
+                head = self._waiting[0]
+                head.sheds += 1
+                head.shed_reason = reason
+                shed_key = (req.request_id, reason)
+                if shed_key != self._last_shed:
+                    self._last_shed = shed_key
+                    trace = self.registry.trace
+                    if trace.enabled:
+                        trace.instant(REQ_SHED, {
+                            "rid": req.request_id,
+                            "reason": reason,
+                            "waiting": len(self._waiting),
+                        })
                 break
             slot, cached_len = admitted
             inflight = self._waiting.popleft()
             inflight.slot = slot
+            inflight.cached_len = cached_len
             if self.engine.spec_tokens:
                 if self._drafter_factory is not None:
                     inflight.drafter = self._drafter_factory(req)
@@ -293,12 +364,42 @@ class ContinuousBatchingScheduler:
             )
             wave.append(inflight)
         if wave:
+            # Waterfall bookkeeping: the queue span ends and the prefill
+            # span begins at the SAME t_wave instant, and _emit below
+            # measures TTFT at the same `now` that ends the prefill
+            # span — so queue + prefill sums to the measured TTFT
+            # exactly (decode contributes nothing before token 1).
+            trace = self.registry.trace
+            t_wave = time.perf_counter()
+            if trace.enabled:
+                for f in wave:
+                    args = {"rid": f.req.request_id}
+                    if f.sheds:
+                        args["sheds"] = f.sheds
+                        args["shed_reason"] = f.shed_reason
+                    trace.complete(
+                        REQ_QUEUE, t_wave - f.t_submit,
+                        ts_mono=f.t_submit, args=args,
+                    )
             firsts = self.engine.prefill_batch([
                 (f.slot, f.req.prompt, f.keydata[0],
                  f.req.temperature, f.req.top_k, f.req.top_p)
                 for f in wave
             ])
             now = time.perf_counter()
+            if trace.enabled:
+                for f in wave:
+                    trace.complete(
+                        REQ_PREFILL, now - t_wave, ts_mono=t_wave,
+                        args={
+                            "rid": f.req.request_id,
+                            "prompt": len(f.req.prompt),
+                            "cached": f.cached_len,
+                            "suffix": self.engine.padded_suffix(
+                                len(f.req.prompt), f.cached_len
+                            ),
+                        },
+                    )
             for inflight in wave:
                 if self._emit(inflight, firsts[inflight.slot], now):
                     self._retire(inflight, done)  # frees slot + blocks
@@ -338,8 +439,23 @@ class ContinuousBatchingScheduler:
                         draft[max(0, rem - 1):] = NO_DRAFT
                     lane = lane + (draft,)
                 lanes[slot] = lane
+            t_decode = time.perf_counter()
             next_tokens = self.engine.decode_step(lanes)
             now = time.perf_counter()
+            trace = self.registry.trace
+            if trace.enabled:
+                # One complete per lane per dispatch (plain complete()
+                # calls — no contextmanager in the dispatch loop).  All
+                # lanes share the dispatch wall time; "n" is what this
+                # lane got out of it.
+                for slot, inflight in self._active.items():
+                    trace.complete(
+                        REQ_DECODE, now - t_decode, ts_mono=t_decode,
+                        args={
+                            "rid": inflight.req.request_id,
+                            "n": len(next_tokens[slot]),
+                        },
+                    )
             # 3. retire finished sequences (their slots are refillable
             # from the very next admission pass).
             for slot in list(self._active):
@@ -351,12 +467,14 @@ class ContinuousBatchingScheduler:
                         break
         # Iteration-sampled load gauges, recorded as timer distributions
         # so the server's p50/p99 surface covers them too.
-        self.registry.timer(reglib.SERVE_QUEUE_DEPTH).record(
-            float(len(self._waiting))
-        )
+        depth = float(len(self._waiting))
+        self.registry.timer(reglib.SERVE_QUEUE_DEPTH).record(depth)
         self.registry.timer(reglib.SERVE_SLOT_OCCUPANCY).record(
             self.engine.slots.occupancy
         )
+        if self.slo is not None:
+            self.slo.observe(reglib.SERVE_QUEUE_DEPTH, depth)
+            self.slo.evaluate()  # rate-limited internally
         self.registry.gauge(reglib.SERVE_BLOCKS_FREE).set(
             float(self.engine.blocks_free)
         )

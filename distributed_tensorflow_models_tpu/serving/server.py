@@ -27,6 +27,17 @@ TTFT/TPOT/queue-depth/slot-occupancy p50/p99 —
 ``scripts/check_metrics_schema.py --serving-report`` validates the
 latter, ``--flight-recorder`` the former.
 
+**Observability add-ons** (ISSUE 16), both jax-free and both optional:
+``slo_specs`` attaches a :class:`~..telemetry.slo.SLOMonitor` the
+scheduler feeds TTFT/TPOT/queue-depth samples and evaluates once per
+iteration (breach counters + margin gauges + trace instants land in
+this server's registry and flight record); ``timeseries_interval_s > 0``
+attaches a :class:`~..telemetry.timeseries.TimeseriesWriter` appending
+periodic registry snapshots + offered/served counts to
+``timeseries_p<i>.jsonl`` under ``workdir`` (final row at drain).
+``scripts/serving_report.py`` merges all of it — per-request
+waterfalls, SLO verdicts, throughput timeline — across replicas.
+
 Run as ``python -m distributed_tensorflow_models_tpu.serving.server``
 the module becomes one file-queue replica for ``scripts/serve_drill.py``:
 it claims request files from a shared directory by atomic rename (two
@@ -51,17 +62,27 @@ from distributed_tensorflow_models_tpu.resilience.preemption import (
     PreemptionListener,
 )
 from distributed_tensorflow_models_tpu.telemetry import registry as reglib
+from distributed_tensorflow_models_tpu.telemetry import slo as slolib
+from distributed_tensorflow_models_tpu.telemetry import timeseries as tslib
 from distributed_tensorflow_models_tpu.telemetry import trace as tracelib
 
 log = logging.getLogger("dtm")
 
 STATS_BASENAME = "serving_stats_p{index}.json"
+TIMESERIES_BASENAME = "timeseries_p{index}.jsonl"
 
 
 def serving_stats_path(workdir: str, process_index: int) -> str:
     """The per-process serving stats artifact path."""
     return os.path.join(
         workdir, STATS_BASENAME.format(index=process_index)
+    )
+
+
+def timeseries_path(workdir: str, process_index: int) -> str:
+    """The per-process metric time-series artifact path."""
+    return os.path.join(
+        workdir, TIMESERIES_BASENAME.format(index=process_index)
     )
 
 
@@ -124,6 +145,11 @@ class LMServer:
         process_index: Optional[int] = None,
         poll_s: float = 0.02,
         trace_ring_events: int = tracelib.DEFAULT_RING_EVENTS,
+        slo_specs=None,
+        slo_warmup_samples: int = 0,
+        slo_breach_after: int = 3,
+        timeseries_interval_s: float = 0.0,
+        timeseries_max_rows: int = tslib.DEFAULT_MAX_ROWS,
     ):
         self._engine_factory = engine_factory
         self._max_prefill_tokens = max_prefill_tokens
@@ -146,6 +172,25 @@ class LMServer:
         if self.registry.trace is tracelib.NULL_TRACER:
             self.registry.trace = tracelib.Tracer(
                 trace_ring_events, process_index=self.process_index
+            )
+        # SLO monitor + time-series writer: built here (jax-free, and
+        # the pre-created breach/margin metrics must exist before the
+        # first stats() call), driven by the worker thread.
+        self._slo: Optional[slolib.SLOMonitor] = None
+        if slo_specs:
+            self._slo = slolib.SLOMonitor(
+                list(slo_specs), self.registry,
+                warmup_samples=slo_warmup_samples,
+                breach_after=slo_breach_after,
+            )
+        self._ts_writer: Optional[tslib.TimeseriesWriter] = None
+        if self.workdir and timeseries_interval_s > 0:
+            os.makedirs(self.workdir, exist_ok=True)
+            self._ts_writer = tslib.TimeseriesWriter(
+                timeseries_path(self.workdir, self.process_index),
+                self.registry,
+                interval_s=timeseries_interval_s,
+                max_rows=timeseries_max_rows,
             )
         self._queue: queue.Queue = queue.Queue()
         self._ids = itertools.count()
@@ -236,13 +281,17 @@ class LMServer:
     # -- reporting ---------------------------------------------------------
 
     def stats(self) -> dict:
-        """Serving report: the registry snapshot plus p99 expansions for
-        every serving distribution (snapshot() itself carries p50/p95).
-        Touches each serving key first so the report ALWAYS carries the
-        full set — an idle server reports zeros, not absences (the
-        ``--serving-report`` schema contract)."""
+        """Serving report: the registry snapshot (every timer flattens
+        with p50/p95/p99 — the p99 surface SLOs key on comes straight
+        from ``snapshot()``).  Touches each serving key first so the
+        report ALWAYS carries the full set — an idle server reports
+        zeros, not absences (the ``--serving-report`` schema contract).
+        serve/spec_* and serve/slo_* stay full-set-or-absent: they are
+        created by the spec-on engine / the attached SLO monitor, never
+        here."""
         for name in (
             reglib.SERVE_REQUESTS, reglib.SERVE_TOKENS,
+            reglib.SERVE_COMPLETED,
             reglib.SERVE_PREFIX_CACHE_HITS,
             reglib.SERVE_PREFIX_CACHE_MISSES,
             reglib.SERVE_PREFIX_CACHE_EVICTIONS,
@@ -260,12 +309,6 @@ class LMServer:
         ):
             self.registry.timer(name)
         snap = self.registry.snapshot()
-        for name in (
-            reglib.SERVE_TTFT, reglib.SERVE_TPOT,
-            reglib.SERVE_QUEUE_DEPTH, reglib.SERVE_SLOT_OCCUPANCY,
-        ):
-            (p99,) = self.registry.timer(name).percentiles(0.99)
-            snap[f"{name}/p99_s"] = p99
         # Cache effectiveness, computed (not stored): block-granular
         # hit fraction of all matchable pages seen; 0.0 when cold/off.
         hits = self.registry.counter(reglib.SERVE_PREFIX_CACHE_HITS).value
@@ -275,17 +318,6 @@ class LMServer:
         snap[reglib.SERVE_PREFIX_CACHE_HIT_RATE] = (
             hits / (hits + misses) if hits + misses > 0 else 0.0
         )
-        # Speculation keys exist only when the engine runs spec-on (the
-        # full-set-or-absent contract --serving-report validates), so
-        # the p99 expansions are conditional on presence — the timer()
-        # accessor would CREATE the key on a spec-off server.
-        for name in (
-            reglib.SERVE_SPEC_ACCEPTANCE_RATE,
-            reglib.SERVE_SPEC_TOKENS_PER_DISPATCH,
-        ):
-            if f"{name}/count" in snap:
-                (p99,) = self.registry.timer(name).percentiles(0.99)
-                snap[f"{name}/p99_s"] = p99
         return {
             "version": 1,
             "process_index": self.process_index,
@@ -369,6 +401,7 @@ class LMServer:
                 engine,
                 max_prefill_tokens=self._max_prefill_tokens,
                 registry=self.registry,
+                slo_monitor=self._slo,
             )
         except BaseException as e:  # noqa: BLE001 — surface via drain()
             self._fatal = e
@@ -400,6 +433,8 @@ class LMServer:
                     self.drain_grace_s,
                 )
             self._pull(sched, pending)
+            if self._ts_writer is not None:
+                self._ts_writer.maybe_write()  # rate-limited internally
             if sched.has_work:
                 for comp in sched.step():
                     handle = pending.pop(comp.request_id, None)
@@ -436,6 +471,8 @@ class LMServer:
             return
         try:
             os.makedirs(self.workdir, exist_ok=True)
+            if self._ts_writer is not None:
+                self._ts_writer.write_row()  # final point at drain
             self.write_stats(
                 serving_stats_path(self.workdir, self.process_index)
             )
@@ -485,7 +522,7 @@ def _drill_engine_factory(args):
         params = model.init(
             jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
         )["params"]
-        return InferenceEngine(
+        engine = InferenceEngine(
             model, params, max_slots=args.max_slots,
             prefill_chunk=args.prefill_chunk,
             decode_burst=args.decode_burst,
@@ -498,6 +535,20 @@ def _drill_engine_factory(args):
             spec_ngram_order=args.spec_ngram_order,
             spec_min_match=args.spec_min_match,
         )
+        stall_ms = getattr(args, "stall_prefill_ms", 0.0)
+        if stall_ms:
+            # SLO-drill fault injection: throttle every prefill wave.
+            # The sleep lands inside the scheduler's per-request prefill
+            # span, so the stall shows up attributed (waterfalls still
+            # sum to TTFT) and provably trips a TTFT SLO breach.
+            real_prefill = engine.prefill_batch
+
+            def throttled_prefill(items):
+                time.sleep(stall_ms / 1000.0)
+                return real_prefill(items)
+
+            engine.prefill_batch = throttled_prefill
+        return engine
 
     return build
 
@@ -553,6 +604,11 @@ def _replica_main(args) -> int:
         listener=listener,
         workdir=args.workdir,
         process_index=replica,
+        trace_ring_events=args.trace_ring_events,
+        slo_specs=args.slo,
+        slo_warmup_samples=args.slo_warmup,
+        slo_breach_after=args.slo_breach_after,
+        timeseries_interval_s=args.timeseries_interval_s,
     )
     server.start()
     outstanding: dict = {}  # request_id -> (handle, request name)
@@ -713,6 +769,39 @@ def main(argv=None) -> int:
     )
     p.add_argument("--max-prefill-tokens", type=int, default=None)
     p.add_argument("--drain-grace-s", type=float, default=30.0)
+    p.add_argument(
+        "--slo", action="append", default=[],
+        help="SLO spec '[name=]key:pQQ<threshold@WINDOWs' (repeatable), "
+        "e.g. serve/ttft_s:p99<0.25@30s — see telemetry/slo.py",
+    )
+    p.add_argument(
+        "--slo-warmup", type=int, default=0,
+        help="per-key observations dropped before SLO windows fill "
+        "(cold-start compile spikes would pin a short window's p99)",
+    )
+    p.add_argument(
+        "--slo-breach-after", type=int, default=3,
+        help="consecutive failing evaluations before a breach fires "
+        "(hysteresis; the drill sets 1 so a single stalled wave trips)",
+    )
+    p.add_argument(
+        "--timeseries-interval-s", type=float, default=0.0,
+        help="append a registry snapshot row to timeseries_p<i>.jsonl "
+        "every N seconds (0 = off)",
+    )
+    p.add_argument(
+        "--trace-ring-events", type=int,
+        default=tracelib.DEFAULT_RING_EVENTS,
+        help="request-trace ring capacity; per-request lifecycle spans "
+        "cost ~3 + tokens/decode_burst events per request, size the "
+        "ring to cover the window a post-mortem needs",
+    )
+    p.add_argument(
+        "--stall-prefill-ms", type=float, default=0.0,
+        help="fault injection: sleep this long before every prefill "
+        "wave (serve_drill.py's SLO arm uses it to force a TTFT "
+        "breach)",
+    )
     p.add_argument(
         "--self-sigterm-after", type=int, default=0,
         help="after N responses, deliver SIGTERM to self (drill victim)",

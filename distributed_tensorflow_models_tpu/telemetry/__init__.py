@@ -19,6 +19,14 @@ is the layer every perf PR proves its claims against:
   ``flight_recorder_p<i>.json`` on abnormal exits and exportable as
   Chrome-trace JSON that ``scripts/fleet_report.py`` merges across
   hosts.
+- :mod:`slo` — declarative rolling-window SLO specs (metric key,
+  percentile, threshold, window) evaluated with hysteresis into
+  ``serve/slo_breach/<name>`` counters, ``serve/slo_margin/<name>``
+  gauges, and breach/recovery trace instants.  jax-free.
+- :mod:`timeseries` — the periodic atomic-append ``timeseries.jsonl``
+  snapshot writer (registry snapshot + offered/served request counts,
+  monotonic-stamped): the raw material for latency-vs-load curves and
+  ``scripts/serving_report.py``'s throughput timeline.  jax-free.
 
 Wiring (all via an injectable registry, defaulting to the process-global
 one): ``data/pipeline.py`` records queue depth / producer wait / prefetch
@@ -68,6 +76,15 @@ from distributed_tensorflow_models_tpu.telemetry.registry import (  # noqa: F401
     MetricsRegistry,
     Timer,
     get_registry,
+)
+from distributed_tensorflow_models_tpu.telemetry.slo import (  # noqa: F401
+    RollingWindow,
+    SLOMonitor,
+    SLOSpec,
+    parse_slo_spec,
+)
+from distributed_tensorflow_models_tpu.telemetry.timeseries import (  # noqa: F401
+    TimeseriesWriter,
 )
 from distributed_tensorflow_models_tpu.telemetry.trace import (  # noqa: F401
     NULL_TRACER,

@@ -31,7 +31,7 @@ from typing import Iterator
 from distributed_tensorflow_models_tpu.telemetry import trace as tracelib
 
 # Canonical names.  Timers flatten in snapshots as
-# ``<name>/{total_s,count,mean_s,p50_s,p95_s,max_s}``.
+# ``<name>/{total_s,count,mean_s,p50_s,p95_s,p99_s,max_s}``.
 DATA_WAIT = "train/data_wait"  # timer: loop blocked in next(batch)
 DISPATCH = "train/dispatch"  # timer: step-fn call (async dispatch)
 STEP_TIME = "train/step_time"  # timer: full iteration wall time
@@ -174,6 +174,21 @@ SERVE_SPEC_DRAFTED = "serve/spec_drafted"  # counter (draft tokens)
 SERVE_SPEC_ACCEPTED = "serve/spec_accepted"  # counter (accepted drafts)
 SERVE_SPEC_ACCEPTANCE_RATE = "serve/spec_acceptance_rate"  # timer (0-1)
 SERVE_SPEC_TOKENS_PER_DISPATCH = "serve/spec_tokens_per_dispatch"  # timer
+# Serving observability (ISSUE 16).  COMPLETED counts requests retired
+# with a terminal finish_reason — offered (SERVE_REQUESTS) minus served
+# (this) is the live backlog, and the pair is what timeseries.jsonl's
+# offered-vs-served throughput timeline diffs.  SLO_BREACH / SLO_MARGIN
+# are per-SLO families keyed ``serve/slo_breach/<name>`` (counter:
+# breach *episodes*, hysteresis-debounced — not breaching evaluations)
+# and ``serve/slo_margin/<name>`` (gauge: threshold − observed, negative
+# while out of SLO).  telemetry/slo.py pre-creates both at monitor
+# construction so an idle-but-monitored server reports zeros; with no
+# monitor attached the keys are absent (full-set-or-absent, mirroring
+# the spec_* contract — enforced by check_metrics_schema
+# --serving-report).
+SERVE_COMPLETED = "serve/completed"  # counter
+SERVE_SLO_BREACH = "serve/slo_breach"  # counter family: /<slo name>
+SERVE_SLO_MARGIN = "serve/slo_margin"  # gauge family: /<slo name>
 
 
 class Counter:
@@ -310,12 +325,13 @@ class MetricsRegistry:
         for name, g in sorted(self._gauges.items()):
             out[name] = g.value
         for name, t in sorted(self._timers.items()):
-            p50, p95 = t.percentiles(0.50, 0.95)
+            p50, p95, p99 = t.percentiles(0.50, 0.95, 0.99)
             out[f"{name}/count"] = float(t.count)
             out[f"{name}/total_s"] = t.total
             out[f"{name}/mean_s"] = t.total / t.count if t.count else 0.0
             out[f"{name}/p50_s"] = p50
             out[f"{name}/p95_s"] = p95
+            out[f"{name}/p99_s"] = p99
             out[f"{name}/max_s"] = t.max
         return out
 

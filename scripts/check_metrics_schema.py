@@ -43,7 +43,9 @@ Checks, per line:
   non-negative number;
 
 - serving keys (``serve/*`` — TTFT/TPOT/occupancy etc., README
-  "Serving"): any present value must be a non-negative number;
+  "Serving"): any present value must be a non-negative number, except
+  the ``serve/slo_margin/*`` gauges, which are legitimately negative
+  while an SLO is out of budget;
 
 and, across the file with ``--require-telemetry``: at least one row
 carries the full telemetry key set (``data_wait_s``, ``step_time_s``,
@@ -70,11 +72,27 @@ whole registry without a blanket allow on either side).
 With ``--serving-report`` the path is validated as a serving stats
 report (``<workdir>/serving_stats_p<i>.json``, serving/server.py)
 instead: required top-level keys, a numbers-only ``metrics`` snapshot
-carrying the FULL serving key set (both counters, every serving timer's
-``/count`` expansion, and the p99 expansions for TTFT/TPOT/queue-depth/
-slot-occupancy — the server writes the full set even when idle, so an
-absence is a writer regression, not light load), every ``serve/*``
-value non-negative.
+carrying the FULL serving key set (every counter, every serving timer's
+``/count`` AND ``/p99_s`` expansions — snapshot() flattens p99 for all
+timers — the server writes the full set even when idle, so an absence
+is a writer regression, not light load), every ``serve/*`` value
+non-negative (``serve/slo_margin/*`` excepted).  ``serve/spec_*`` and
+``serve/slo_*`` are full-set-or-absent: speculation keys exist only on
+a spec-on engine, SLO keys only with a monitor attached, and in both
+cases one key present implies the whole family (for SLOs: a matching
+``serve/slo_margin/<name>`` for every ``serve/slo_breach/<name>`` and
+vice versa).
+
+With ``--timeseries`` the path is validated as a metric time-series
+(``<workdir>/timeseries_p<i>.jsonl``, telemetry/timeseries.py) instead:
+every row a JSON object carrying numeric ``ts_wall``/``ts_mono``/
+``offered``/``served``, ``ts_mono`` non-decreasing across rows (the
+writer stamps perf_counter, single-writer), ``offered >= served >= 0``,
+numbers-only rows, the serve/ non-negativity sweep, and — unless
+``--no-declared`` — every non-timestamp key must be a key constant
+declared in the registry module (exactly, or as a ``key/...``
+expansion): a time-series carrying keys the registry never heard of is
+the same drift the metric-key lint rule stops at the source.
 
 With ``--flight-recorder`` the path is validated as a flight-recorder
 dump (``<workdir>/flight_recorder_p<i>.json``, telemetry/trace.py)
@@ -122,8 +140,15 @@ CHECKPOINT_PREFIX = "checkpoint/"
 # wherever they appear.
 TRACE_PREFIX = "trace/"
 # Serving keys (serve/ttft_s etc.): latencies, counts and fractions —
-# non-negative wherever they appear.
+# non-negative wherever they appear.  The one exception:
+# serve/slo_margin/<name> gauges are threshold − observed, NEGATIVE by
+# design while the SLO is out of budget.
 SERVE_PREFIX = "serve/"
+SLO_MARGIN_PREFIX = "serve/slo_margin/"
+
+
+def _serve_negative_ok(key: str) -> bool:
+    return key.startswith(SLO_MARGIN_PREFIX)
 # Restart-MTTR gauges TelemetryHook injects together (README
 # "Performance"); a partial set on a row is a writer bug, like the sets
 # above.  Values are overlapped wall readings — non-negative seconds.
@@ -249,7 +274,7 @@ def check_lines(
                 errors.append(
                     f"line {i}: trace key {key!r} is negative: {value!r}"
                 )
-            elif key.startswith(SERVE_PREFIX):
+            elif key.startswith(SERVE_PREFIX) and not _serve_negative_ok(key):
                 errors.append(
                     f"line {i}: serving key {key!r} is negative: {value!r}"
                 )
@@ -262,7 +287,7 @@ def check_lines(
 
 SERVING_REQUIRED = ("version", "process_index", "draining", "metrics")
 SERVING_COUNTERS = (
-    "serve/requests", "serve/tokens",
+    "serve/requests", "serve/tokens", "serve/completed",
     "serve/prefix_cache_hits", "serve/prefix_cache_misses",
     "serve/prefix_cache_evictions",
 )
@@ -276,12 +301,14 @@ SERVING_GAUGES = (
     "serve/blocks_free", "serve/blocks_resident",
     "serve/block_fragmentation", "serve/prefix_cache_hit_rate",
 )
-# Tail-latency expansions the server adds on top of snapshot()'s
-# p50/p95 — the serving SLO surface.
-SERVING_P99 = (
-    "serve/ttft_s", "serve/tpot_s", "serve/queue_depth",
-    "serve/slot_occupancy",
-)
+# Tail-latency expansions — snapshot() flattens p99 beside p50/p95 for
+# EVERY timer, so the serving SLO surface covers all of them.
+SERVING_P99 = SERVING_TIMERS
+# SLO families (telemetry/slo.py): serve/slo_breach/<name> counters and
+# serve/slo_margin/<name> gauges, pre-created together per configured
+# spec — so every breach name must have a margin twin and vice versa
+# (full-set-or-absent, name-wise).
+SLO_BREACH_PREFIX = "serve/slo_breach/"
 # Speculative decoding keys: present ONLY when the engine ran spec-on
 # (spec_tokens > 0 pre-creates all of them; spec-off creates none), so
 # the contract is full-set-or-absent — a partial set means a writer
@@ -320,7 +347,11 @@ def check_serving_report(report) -> list[str]:
             errors.append(
                 f"metrics value for {key!r} is not a number: {value!r}"
             )
-        elif value < 0 and key.startswith(SERVE_PREFIX):
+        elif (
+            value < 0
+            and key.startswith(SERVE_PREFIX)
+            and not _serve_negative_ok(key)
+        ):
             errors.append(f"serving key {key!r} is negative: {value!r}")
     # Full-set requirement: the server touches every serving key before
     # snapshotting, so absence = writer regression (never light load).
@@ -353,6 +384,35 @@ def check_serving_report(report) -> list[str]:
                 errors.append(
                     f"speculation p99 expansion {key!r}/p99_s missing"
                 )
+    # SLO section: any serve/slo_* key present implies a breach counter
+    # AND a margin gauge per SLO name (the monitor pre-creates them as
+    # a pair; a widowed key is a writer regression).
+    if any(k.startswith("serve/slo_") for k in snap):
+        breach_names = {
+            k[len(SLO_BREACH_PREFIX):]
+            for k in snap
+            if k.startswith(SLO_BREACH_PREFIX)
+        }
+        margin_names = {
+            k[len(SLO_MARGIN_PREFIX):]
+            for k in snap
+            if k.startswith(SLO_MARGIN_PREFIX)
+        }
+        if not breach_names and not margin_names:
+            errors.append(
+                "serve/slo_* key present but no serve/slo_breach/<name> "
+                "or serve/slo_margin/<name> family members"
+            )
+        for name in sorted(breach_names - margin_names):
+            errors.append(
+                f"SLO {name!r} has a breach counter but no "
+                f"serve/slo_margin/{name} gauge"
+            )
+        for name in sorted(margin_names - breach_names):
+            errors.append(
+                f"SLO {name!r} has a margin gauge but no "
+                f"serve/slo_breach/{name} counter"
+            )
     return errors
 
 
@@ -372,6 +432,94 @@ def speculation_summary(snap: dict) -> str:
         f"tokens/dispatch mean "
         f"{snap.get('serve/spec_tokens_per_dispatch/mean_s', 0.0):.2f}"
     )
+
+
+# --------------------------------------------------------------------------
+# Metric time-series (telemetry/timeseries.py timeseries_p<i>.jsonl)
+# --------------------------------------------------------------------------
+
+TIMESERIES_REQUIRED = ("ts_wall", "ts_mono", "offered", "served")
+
+
+def check_timeseries(
+    lines: Iterable[str], declared: "dict[str, str] | None" = None
+) -> tuple[list[str], int]:
+    """Violations in a timeseries.jsonl (``(errors, row_count)``).
+
+    ``declared`` (key → constant name, from ``declared_metric_keys``)
+    enables the declared-keys check: every non-timestamp key must be a
+    declared registry key, exactly or as a ``key/...`` expansion.
+    """
+    errors: list[str] = []
+    rows = 0
+    prev_mono = None
+    declared_keys = tuple(declared) if declared else ()
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            errors.append(f"line {i}: blank line")
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"line {i}: unparseable JSON ({e})")
+            continue
+        if not isinstance(row, dict):
+            errors.append(f"line {i}: not a JSON object")
+            continue
+        rows += 1
+        for key in TIMESERIES_REQUIRED:
+            if key not in row:
+                errors.append(f"line {i}: missing required key {key!r}")
+            elif not _is_number(row[key]):
+                errors.append(
+                    f"line {i}: {key!r} is not a number: {row[key]!r}"
+                )
+        mono = row.get("ts_mono")
+        if _is_number(mono):
+            if prev_mono is not None and mono < prev_mono:
+                errors.append(
+                    f"line {i}: ts_mono went backwards "
+                    f"({prev_mono} -> {mono})"
+                )
+            prev_mono = mono
+        offered, served = row.get("offered"), row.get("served")
+        if _is_number(offered) and _is_number(served):
+            if served < 0 or offered < 0:
+                errors.append(
+                    f"line {i}: offered/served negative "
+                    f"({offered!r}/{served!r})"
+                )
+            elif served > offered:
+                errors.append(
+                    f"line {i}: served ({served!r}) exceeds offered "
+                    f"({offered!r})"
+                )
+        for key, value in row.items():
+            if not _is_number(value):
+                errors.append(
+                    f"line {i}: value for {key!r} is not a number: "
+                    f"{value!r}"
+                )
+                continue
+            if (
+                value < 0
+                and key.startswith(SERVE_PREFIX)
+                and not _serve_negative_ok(key)
+            ):
+                errors.append(
+                    f"line {i}: serving key {key!r} is negative: {value!r}"
+                )
+            if key in TIMESERIES_REQUIRED or not declared:
+                continue
+            if key in declared or any(
+                key.startswith(d + "/") for d in declared_keys
+            ):
+                continue
+            errors.append(
+                f"line {i}: key {key!r} is not declared in the registry "
+                "(nor a declared key's /... expansion)"
+            )
+    return errors, rows
 
 
 # --------------------------------------------------------------------------
@@ -544,6 +692,29 @@ def main(argv=None) -> int:
         "a metrics file",
     )
     p.add_argument(
+        "--timeseries",
+        action="store_true",
+        help="validate the path as a metric time-series "
+        "(telemetry/timeseries.py timeseries_p<i>.jsonl schema) instead "
+        "of a metrics file",
+    )
+    p.add_argument(
+        "--registry",
+        metavar="REGISTRY_PY",
+        default=os.path.join(
+            _REPO_ROOT, "distributed_tensorflow_models_tpu", "telemetry",
+            "registry.py",
+        ),
+        help="with --timeseries: registry module whose declared key "
+        "constants bound the row keys (default: the repo's registry.py)",
+    )
+    p.add_argument(
+        "--no-declared",
+        action="store_true",
+        help="with --timeseries: skip the declared-keys check (rows from "
+        "a registry with out-of-tree keys)",
+    )
+    p.add_argument(
         "--declared-coverage",
         metavar="REGISTRY_PY",
         help="validate the path as a telemetry.json report instead: "
@@ -568,6 +739,33 @@ def main(argv=None) -> int:
         "a serving stats report with serve/); repeatable",
     )
     args = p.parse_args(argv)
+    if args.timeseries:
+        try:
+            with open(args.path) as f:
+                lines = f.read().splitlines()
+            declared = (
+                None if args.no_declared
+                else declared_metric_keys(args.registry)
+            )
+        except (OSError, ValueError, SyntaxError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        errors, rows = check_timeseries(lines, declared)
+        if rows == 0:
+            errors.append("no time-series rows found")
+        if errors:
+            for e in errors:
+                print(f"{args.path}: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"{args.path}: OK ({rows} rows, ts_mono monotonic"
+            + (
+                ", declared-keys checked" if declared is not None
+                else ""
+            )
+            + ")"
+        )
+        return 0
     if args.declared_coverage:
         try:
             with open(args.path) as f:

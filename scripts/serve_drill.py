@@ -105,7 +105,9 @@ def _schema_check(path: str, flag: str, errors: list[str]) -> None:
 
 
 def run_drill(scratch: str, n_requests: int, *, spec_tokens: int = 0,
-              port: int = PORT) -> tuple[list[str], dict[int, dict]]:
+              port: int = PORT,
+              extra_argv: tuple[str, ...] = (),
+              ) -> tuple[list[str], dict[int, dict]]:
     errors: list[str] = []
     queue_dir = os.path.join(scratch, "queue")
     workdir = os.path.join(scratch, "wd")
@@ -129,6 +131,7 @@ def run_drill(scratch: str, n_requests: int, *, spec_tokens: int = 0,
     ]
     if spec_tokens:
         argv += ["--spec-tokens", str(spec_tokens)]
+    argv += list(extra_argv)
     codes = launch.launch_local(
         2, argv, port=port, timeout=420.0,
         extra_env={
@@ -260,6 +263,122 @@ def run_drill(scratch: str, n_requests: int, *, spec_tokens: int = 0,
     return errors, responses
 
 
+# -- SLO arm ---------------------------------------------------------------
+# Threshold sits between steady-state TTFT (tens of ms on the tiny
+# model) and the injected stall; warmup is 2*max_slots — exactly the
+# requests a replica claims before its first wave retires, i.e. every
+# TTFT sample contaminated by first-dispatch compile time.
+SLO_THRESHOLD_S = 1.5
+SLO_STALL_MS = 3000.0
+SLO_WARMUP = 8  # 2 * --max-slots
+SLO_SPEC = f"ttft=serve/ttft_s:p99<{SLO_THRESHOLD_S}@30s"
+SLO_ARGV = (
+    "--slo", SLO_SPEC,
+    "--slo-warmup", str(SLO_WARMUP),
+    "--slo-breach-after", "1",
+    "--timeseries-interval-s", "0.5",
+)
+
+
+def check_slo_arm(workdir: str, *, expect_breach: bool) -> list[str]:
+    """SLO-arm forensics: breach instants in the flight records, breach
+    counters in the stats, the report's verdict table, waterfall
+    attribution (queue + prefill + decode must sum to measured TTFT),
+    and schema-clean time-series files."""
+    errors: list[str] = []
+    label = "stall" if expect_breach else "clean"
+    instants = {0: 0, 1: 0}
+    counters = {0: 0.0, 1: 0.0}
+    for proc_index in (0, 1):
+        record_path = os.path.join(
+            workdir, f"flight_recorder_p{proc_index}.json"
+        )
+        if os.path.exists(record_path):
+            with open(record_path) as f:
+                record = json.load(f)
+            instants[proc_index] = sum(
+                1 for e in record.get("events", [])
+                if e.get("name") == "serve/slo_breach"
+            )
+        stats_path = os.path.join(
+            workdir, f"serving_stats_p{proc_index}.json"
+        )
+        if os.path.exists(stats_path):
+            with open(stats_path) as f:
+                snap = json.load(f)["metrics"]
+            counters[proc_index] = sum(
+                v for k, v in snap.items()
+                if k.startswith("serve/slo_breach/")
+            )
+        ts_path = os.path.join(workdir, f"timeseries_p{proc_index}.jsonl")
+        if not os.path.exists(ts_path):
+            errors.append(f"slo-{label}: missing time-series {ts_path}")
+        else:
+            _schema_check(ts_path, "--timeseries", errors)
+
+    report_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "serving_report.py")
+    proc = subprocess.run(
+        [sys.executable, report_py, workdir, "--json"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        errors.append(
+            f"slo-{label}: serving_report failed: {proc.stderr}"
+        )
+        return errors
+    report = json.loads(proc.stdout)
+    att = report["attribution"]
+    if att["attributed"] == 0:
+        errors.append(f"slo-{label}: no attributed waterfalls in report")
+    if att["sum_bad"]:
+        bad = [
+            w for w in report["waterfalls"]
+            if w["attributed"] and not w["sum_ok"]
+        ]
+        errors.append(
+            f"slo-{label}: {att['sum_bad']} waterfall(s) do not sum to "
+            "TTFT: " + ", ".join(
+                f"p{w['proc']}/r{w['rid']} "
+                f"err={w['attribution_err_s']:.4f}s"
+                for w in bad[:5]
+            )
+        )
+    verdicts = {
+        (row["proc"], row["slo"]): row["verdict"] for row in report["slo"]
+    }
+    if not verdicts:
+        errors.append(f"slo-{label}: report has no SLO verdict rows")
+    if expect_breach:
+        if not any(instants.values()):
+            errors.append(
+                "slo-stall: no serve/slo_breach instant in any flight "
+                "record — the injected stall never tripped the monitor"
+            )
+        if not any(counters.values()):
+            errors.append("slo-stall: serve/slo_breach counters all zero")
+        if not any(v == "FAIL" for v in verdicts.values()):
+            errors.append(
+                f"slo-stall: no FAIL verdict in the report ({verdicts})"
+            )
+    else:
+        if any(instants.values()) or any(counters.values()):
+            errors.append(
+                f"slo-clean: unexpected breach(es): instants {instants}, "
+                f"counters {counters}"
+            )
+        bad_verdicts = {
+            f"p{k[0]}:{k[1]}": v for k, v in verdicts.items() if v != "PASS"
+        }
+        if bad_verdicts:
+            errors.append(f"slo-clean: non-PASS verdicts: {bad_verdicts}")
+    print(
+        f"  slo-{label}: breach instants {instants}, waterfalls "
+        f"{att['sum_ok']}/{att['attributed']} sum to TTFT"
+    )
+    return errors
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=24)
@@ -280,6 +399,10 @@ def main(argv=None) -> int:
     p.add_argument(
         "--spec-tokens", type=int, default=3,
         help="draft depth of the speculative arm (0 skips that arm)",
+    )
+    p.add_argument(
+        "--no-slo", action="store_true",
+        help="skip the SLO observability arms (clean + injected stall)",
     )
     args = p.parse_args(argv)
 
@@ -338,6 +461,44 @@ def main(argv=None) -> int:
                         f"spec-off: {spec_resp[rid]['tokens']} vs "
                         f"{base_resp[rid]['tokens']}"
                     )
+        if not args.no_slo:
+            # SLO observability arms: a clean fleet under a TTFT SLO must
+            # report zero breaches and all-PASS verdicts; the same fleet
+            # with an injected prefill stall must provably trip a breach
+            # instant and a FAIL verdict.  Both arms double as the
+            # end-to-end check of waterfall attribution (queue + prefill
+            # + decode == TTFT) and of the time-series schema; streams
+            # stay byte-identical to the base arm's (tracing is a
+            # read-only tap).
+            print(f"  slo clean arm: {SLO_SPEC}")
+            clean_dir = os.path.join(scratch, "slo-clean")
+            clean_errors, clean_resp = run_drill(
+                clean_dir, args.requests, port=PORT + 20,
+                extra_argv=SLO_ARGV,
+            )
+            errors += clean_errors
+            errors += check_slo_arm(
+                os.path.join(clean_dir, "wd"), expect_breach=False
+            )
+            for rid in sorted(set(base_resp) & set(clean_resp)):
+                if base_resp[rid]["tokens"] != clean_resp[rid]["tokens"]:
+                    errors.append(
+                        f"request {rid}: stream changed with SLO "
+                        f"observability on: {clean_resp[rid]['tokens']} "
+                        f"vs {base_resp[rid]['tokens']}"
+                    )
+            print(f"  slo stall arm: {SLO_STALL_MS:.0f}ms prefill stall")
+            stall_dir = os.path.join(scratch, "slo-stall")
+            stall_errors, _ = run_drill(
+                stall_dir, args.requests, port=PORT + 30,
+                extra_argv=SLO_ARGV + (
+                    "--stall-prefill-ms", str(SLO_STALL_MS),
+                ),
+            )
+            errors += stall_errors
+            errors += check_slo_arm(
+                os.path.join(stall_dir, "wd"), expect_breach=True
+            )
         failed = bool(errors)
         if errors:
             print("DRILL serve: FAIL", file=sys.stderr)

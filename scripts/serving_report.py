@@ -1,0 +1,423 @@
+"""Serving fleet report: request waterfalls, SLO verdicts, throughput.
+
+The serving counterpart of ``fleet_report.py`` (whose artifact-merge
+machinery it reuses).  Point it at a serve_drill / LMServer workdir —
+the directory holding ``flight_recorder_p*.json``,
+``serving_stats_p*.json``, and optionally ``timeseries_p*.jsonl`` — and
+it answers the three production questions:
+
+1. **Where did each request's latency go?**  Per-request waterfalls
+   rebuilt from the scheduler's ``serve/req/*`` lifecycle events
+   (grouped by ``args["rid"]``): queue-wait, prefill (with prefix-cache
+   hit/suffix attribution), decode dispatches, completion.  The
+   queue + prefill spans are emitted so they MUST sum to the measured
+   TTFT — the report checks every waterfall against the completion
+   instant's ``ttft_s`` and flags any that don't reconcile.
+2. **Did we hold the SLOs?**  A verdict table per process per SLO from
+   the stats report's ``serve/slo_breach/<name>`` counters and
+   ``serve/slo_margin/<name>`` gauges, cross-referenced with breach /
+   recovery instants in the event stream.
+3. **Offered vs served?**  A throughput timeline diffed from
+   ``timeseries_p*.jsonl`` rows (cumulative offered/served counters →
+   per-interval rates) — the raw material for a latency-vs-load curve.
+
+``--chrome out.json`` additionally writes the merged multi-replica
+Perfetto trace (fleet_report's ``merge_chrome``), where the per-request
+waterfall is visible as nested ``serve/req/*`` spans per process
+track.  ``--json`` emits the whole report machine-readable (the drill's
+assertions parse it).  jax-free, stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import fleet_report  # noqa: E402
+
+# Mirrors serving/scheduler.py's lifecycle event names and
+# telemetry/slo.py's instants (scripts stay importable without the
+# package on sys.path, so the literals are restated here).
+REQ_QUEUE = "serve/req/queue"
+REQ_PREFILL = "serve/req/prefill"
+REQ_DECODE = "serve/req/decode"
+REQ_SHED = "serve/req/shed"
+REQ_DONE = "serve/req/done"
+BREACH_INSTANT = "serve/slo_breach"
+RECOVERY_INSTANT = "serve/slo_recovered"
+SLO_BREACH_PREFIX = "serve/slo_breach/"
+SLO_MARGIN_PREFIX = "serve/slo_margin/"
+
+# |queue + prefill − ttft| must close within this (absolute floor;
+# scaled tolerance below for long requests).
+DEFAULT_TOLERANCE_S = 0.005
+
+
+def load_stats(workdir: str) -> dict[int, dict]:
+    """``{process_index: serving_stats dict}`` from the workdir."""
+    out: dict[int, dict] = {}
+    for path in sorted(
+        glob.glob(os.path.join(workdir, "serving_stats_p*.json"))
+    ):
+        m = re.search(r"serving_stats_p(\d+)\.json$", path)
+        obj = fleet_report._load_json(path)
+        if m and obj is not None:
+            out[int(m.group(1))] = obj
+    return out
+
+
+def load_timeseries(workdir: str) -> dict[int, list]:
+    """``{process_index: [row, ...]}``; unparseable lines are skipped
+    (a torn tail line from a killed replica must not sink the report)."""
+    out: dict[int, list] = {}
+    for path in sorted(
+        glob.glob(os.path.join(workdir, "timeseries_p*.jsonl"))
+    ):
+        m = re.search(r"timeseries_p(\d+)\.jsonl$", path)
+        if not m:
+            continue
+        rows = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rows.append(json.loads(line))
+                    except ValueError:
+                        print(
+                            f"warning: skipping torn row in {path}",
+                            file=sys.stderr,
+                        )
+        except OSError as e:
+            print(f"warning: unreadable {path}: {e}", file=sys.stderr)
+            continue
+        out[int(m.group(1))] = rows
+    return out
+
+
+def build_waterfalls(
+    events: list, tolerance_s: float = DEFAULT_TOLERANCE_S
+) -> list[dict]:
+    """Group ``serve/req/*`` events by (proc, rid) into waterfalls.
+
+    A waterfall is ``attributed`` when its queue, prefill, and done
+    events all survived the ring; only attributed waterfalls get the
+    queue+prefill≈ttft reconciliation (``sum_ok``).  Tolerance is
+    ``max(tolerance_s, 2% of ttft)``.
+    """
+    reqs: dict[tuple, dict] = {}
+
+    def slot(proc: int, rid) -> dict:
+        return reqs.setdefault(
+            (proc, rid),
+            {
+                "proc": proc,
+                "rid": rid,
+                "queue_s": None,
+                "prefill_s": None,
+                "decode_s": 0.0,
+                "decode_dispatches": 0,
+                "t_first": None,
+                "sheds": 0,
+                "shed_reason": None,
+                "cached": None,
+                "suffix": None,
+                "prompt": None,
+                "tokens": None,
+                "finish_reason": None,
+                "ttft_s": None,
+                "done": False,
+            },
+        )
+
+    for e in events:
+        name = e["name"]
+        if not name.startswith("serve/req/"):
+            continue
+        args = e.get("args") or {}
+        rid = args.get("rid")
+        if rid is None:
+            continue
+        w = slot(e["proc"], rid)
+        if w["t_first"] is None or e["t"] < w["t_first"]:
+            w["t_first"] = e["t"]
+        if name == REQ_QUEUE:
+            w["queue_s"] = e.get("dur_s") or 0.0
+            w["sheds"] = args.get("sheds", 0)
+            w["shed_reason"] = args.get("shed_reason")
+        elif name == REQ_PREFILL:
+            w["prefill_s"] = e.get("dur_s") or 0.0
+            w["cached"] = args.get("cached")
+            w["suffix"] = args.get("suffix")
+            w["prompt"] = args.get("prompt")
+        elif name == REQ_DECODE:
+            w["decode_s"] += e.get("dur_s") or 0.0
+            w["decode_dispatches"] += 1
+        elif name == REQ_DONE:
+            w["done"] = True
+            w["tokens"] = args.get("tokens")
+            w["finish_reason"] = args.get("reason")
+            w["ttft_s"] = args.get("ttft_s")
+
+    out = []
+    for w in sorted(reqs.values(), key=lambda w: (w["t_first"] or 0.0)):
+        attributed = (
+            w["done"]
+            and w["queue_s"] is not None
+            and w["prefill_s"] is not None
+            and w["ttft_s"] is not None
+        )
+        w["attributed"] = attributed
+        if attributed:
+            err = abs(w["queue_s"] + w["prefill_s"] - w["ttft_s"])
+            w["attribution_err_s"] = err
+            w["sum_ok"] = err <= max(tolerance_s, 0.02 * w["ttft_s"])
+        else:
+            w["attribution_err_s"] = None
+            w["sum_ok"] = None
+        out.append(w)
+    return out
+
+
+def slo_verdicts(stats: dict[int, dict], events: list) -> list[dict]:
+    """Per (process, SLO) verdict rows from breach counters + margin
+    gauges, cross-checked against breach/recovery instants."""
+    instants: dict[tuple, dict] = {}
+    for e in events:
+        if e["name"] not in (BREACH_INSTANT, RECOVERY_INSTANT):
+            continue
+        name = (e.get("args") or {}).get("slo")
+        if name is None:
+            continue
+        rec = instants.setdefault(
+            (e["proc"], name), {"breach_instants": 0, "recovery_instants": 0}
+        )
+        if e["name"] == BREACH_INSTANT:
+            rec["breach_instants"] += 1
+        else:
+            rec["recovery_instants"] += 1
+    rows = []
+    for proc in sorted(stats):
+        metrics = stats[proc].get("metrics", {})
+        for key in sorted(metrics):
+            if not key.startswith(SLO_BREACH_PREFIX):
+                continue
+            name = key[len(SLO_BREACH_PREFIX):]
+            breaches = metrics[key]
+            inst = instants.get((proc, name), {})
+            rows.append(
+                {
+                    "proc": proc,
+                    "slo": name,
+                    "breaches": breaches,
+                    "margin": metrics.get(f"{SLO_MARGIN_PREFIX}{name}"),
+                    "breach_instants": inst.get("breach_instants", 0),
+                    "recovery_instants": inst.get("recovery_instants", 0),
+                    "verdict": "PASS" if breaches == 0 else "FAIL",
+                }
+            )
+    return rows
+
+
+def throughput_timeline(timeseries: dict[int, list]) -> dict:
+    """Offered-vs-served per process: cumulative counters diffed into
+    per-interval rates over monotonic time."""
+    series: dict[int, list] = {}
+    for proc, rows in sorted(timeseries.items()):
+        pts = []
+        prev = None
+        for row in rows:
+            t = row.get("ts_mono")
+            offered = row.get("offered")
+            served = row.get("served")
+            if t is None or offered is None or served is None:
+                continue
+            pt = {"t": t, "offered": offered, "served": served}
+            if prev is not None and t > prev["t"]:
+                dt = t - prev["t"]
+                pt["offered_rate"] = (offered - prev["offered"]) / dt
+                pt["served_rate"] = (served - prev["served"]) / dt
+            prev = pt
+            pts.append(pt)
+        if pts:
+            t0 = pts[0]["t"]
+            for pt in pts:
+                pt["t"] = pt["t"] - t0
+            series[proc] = pts
+    totals = {
+        "offered": sum(s[-1]["offered"] for s in series.values()),
+        "served": sum(s[-1]["served"] for s in series.values()),
+    } if series else {}
+    return {"series": series, "totals": totals}
+
+
+def build_report(
+    workdir: str, tolerance_s: float = DEFAULT_TOLERANCE_S
+) -> dict:
+    procs = fleet_report.load_artifacts(workdir)
+    events = fleet_report.merged_events(procs)
+    stats = load_stats(workdir)
+    waterfalls = build_waterfalls(events, tolerance_s)
+    attributed = [w for w in waterfalls if w["attributed"]]
+    sheds = [e for e in events if e["name"] == REQ_SHED]
+    report = {
+        "workdir": workdir,
+        "processes": sorted(set(procs) | set(stats)),
+        "waterfalls": waterfalls,
+        "attribution": {
+            "requests": len(waterfalls),
+            "attributed": len(attributed),
+            "sum_ok": sum(1 for w in attributed if w["sum_ok"]),
+            "sum_bad": sum(1 for w in attributed if not w["sum_ok"]),
+        },
+        "sheds": [
+            {"proc": e["proc"], "t": e["t"], **(e.get("args") or {})}
+            for e in sheds
+        ],
+        "slo": slo_verdicts(stats, events),
+        "throughput": throughput_timeline(load_timeseries(workdir)),
+        "stats": {
+            proc: stats[proc].get("metrics", {}) for proc in sorted(stats)
+        },
+    }
+    return report
+
+
+def _fmt_ms(v: Optional[float]) -> str:
+    return "      ?" if v is None else f"{v * 1e3:7.1f}"
+
+
+def format_report(report: dict) -> str:
+    lines = [f"serving report: {report['workdir']}"]
+    if not report["processes"]:
+        lines.append(
+            "  no serving artifacts found (flight_recorder_p*.json / "
+            "serving_stats_p*.json)"
+        )
+        return "\n".join(lines)
+    lines.append(
+        "  processes: " + ", ".join(f"p{p}" for p in report["processes"])
+    )
+    att = report["attribution"]
+    lines.append(
+        f"waterfalls: {att['requests']} request(s), "
+        f"{att['attributed']} fully attributed, "
+        f"{att['sum_bad']} failing queue+prefill=TTFT reconciliation"
+    )
+    if report["waterfalls"]:
+        lines.append(
+            "  rid       queue_ms prefill_ms decode_ms  ttft_ms "
+            "tok fin    cache  ok"
+        )
+        for w in report["waterfalls"][:60]:
+            cache = (
+                f"{w['cached']}/{w['prompt']}"
+                if w["cached"] is not None and w["prompt"] is not None
+                else "?"
+            )
+            ok = (
+                "  ?" if w["sum_ok"] is None
+                else (" ok" if w["sum_ok"] else "BAD")
+            )
+            shed = (
+                f"  shed×{w['sheds']}({w['shed_reason']})"
+                if w["sheds"] else ""
+            )
+            lines.append(
+                f"  p{w['proc']}/r{w['rid']:<6} {_fmt_ms(w['queue_s'])} "
+                f"{_fmt_ms(w['prefill_s'])}  {_fmt_ms(w['decode_s'])} "
+                f"{_fmt_ms(w['ttft_s'])} "
+                f"{w['tokens'] if w['tokens'] is not None else '?':>3} "
+                f"{w['finish_reason'] or '?':<6} {cache:>6} {ok}{shed}"
+            )
+    if report["sheds"]:
+        lines.append(f"sheds: {len(report['sheds'])} backpressure instant(s)")
+        for s in report["sheds"][:10]:
+            lines.append(
+                f"  p{s['proc']} rid={s.get('rid')} "
+                f"reason={s.get('reason')} waiting={s.get('waiting')}"
+            )
+    if report["slo"]:
+        lines.append("SLO verdicts:")
+        lines.append(
+            "  proc  slo                      breaches  margin     verdict"
+        )
+        for row in report["slo"]:
+            margin = (
+                f"{row['margin']:+.4f}" if row["margin"] is not None else "?"
+            )
+            lines.append(
+                f"  p{row['proc']}    {row['slo']:<24} "
+                f"{row['breaches']:>8.0f}  {margin:>9}  {row['verdict']}"
+                + (
+                    f"  ({row['breach_instants']} breach / "
+                    f"{row['recovery_instants']} recovery instants)"
+                    if row["breach_instants"] or row["recovery_instants"]
+                    else ""
+                )
+            )
+    else:
+        lines.append("SLO verdicts: none (no serve/slo_* keys in stats)")
+    thr = report["throughput"]
+    if thr["series"]:
+        t = thr["totals"]
+        lines.append(
+            f"throughput: offered {t['offered']:.0f}, served "
+            f"{t['served']:.0f} across {len(thr['series'])} replica(s)"
+        )
+        for proc, pts in sorted(thr["series"].items()):
+            rates = [
+                f"+{p['t']:.1f}s {p.get('served_rate', 0.0):.1f}/s"
+                for p in pts
+                if "served_rate" in p
+            ]
+            lines.append(
+                f"  p{proc}: {len(pts)} sample(s)"
+                + (": " + ", ".join(rates[-8:]) if rates else "")
+            )
+    else:
+        lines.append("throughput: no timeseries_p*.jsonl rows")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("workdir", help="serving workdir (drill scratch)")
+    p.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    p.add_argument(
+        "--chrome", metavar="OUT",
+        help="also write the merged multi-replica Perfetto trace",
+    )
+    p.add_argument(
+        "--tolerance-s", type=float, default=DEFAULT_TOLERANCE_S,
+        help="absolute TTFT-reconciliation tolerance (floor; 2%% of "
+        "TTFT otherwise)",
+    )
+    args = p.parse_args(argv)
+    report = build_report(args.workdir, args.tolerance_s)
+    if args.chrome:
+        procs = fleet_report.load_artifacts(args.workdir)
+        with open(args.chrome, "w") as f:
+            json.dump(fleet_report.merge_chrome(procs), f)
+        print(f"chrome trace: {args.chrome}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_report(report))
+    if not report["processes"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,7 @@
+"""Known-bad: wall-clock SLO sampling + ad-hoc breach key."""
+import time
+
+
+def observe_ttft(window, registry, ttft_s):
+    window.append((time.time(), ttft_s))
+    registry.counter("serve/slo_breach/ttft").inc(1)

@@ -1,0 +1,9 @@
+"""Known-good: monotonic sampling, breach key declared up front."""
+import time
+
+SLO_BREACH_TTFT = "serve/slo_breach/ttft"
+
+
+def observe_ttft(window, registry, ttft_s):
+    window.append((time.perf_counter(), ttft_s))
+    registry.counter(SLO_BREACH_TTFT).inc(1)

@@ -84,6 +84,8 @@ BAD_EXPECT = {
                          ("resource-lifecycle", 15),
                          ("resource-lifecycle", 24),
                          ("resource-lifecycle", 30)},
+    "bad_serving_obs.py": {("determinism-hazard", 6),
+                           ("metric-key-registry", 7)},
 }
 
 GOOD_FILES = [
@@ -102,6 +104,7 @@ GOOD_FILES = [
     "good_resize.py",
     "meshaxes_good.py",
     "good_lifecycle.py",
+    "good_serving_obs.py",
 ]
 
 

@@ -345,6 +345,82 @@ def test_serving_telemetry_surface(engine):
     assert 0.0 <= snap[f"{reglib.SERVE_SLOT_OCCUPANCY}/max_s"] <= 1.0
 
 
+def test_request_waterfall_attribution_and_stream_identity(
+    engine, small_lm
+):
+    """Lifecycle tracing on: every request leaves queue → prefill →
+    decode → done events in the ring, queue + prefill duration equals
+    the measured TTFT *exactly* (the attribution identity
+    scripts/serving_report.py banks on), the two spans abut at the wave
+    timestamp, and tracing changes no tokens — every stream stays
+    byte-equal to its solo ``generate()``."""
+    from distributed_tensorflow_models_tpu.serving import (
+        scheduler as schedlib,
+    )
+    from distributed_tensorflow_models_tpu.telemetry import (
+        trace as tracelib,
+    )
+
+    model, params = small_lm
+    tracer = tracelib.Tracer(512)
+    old_trace = engine.registry.trace
+    engine.registry.trace = tracer
+    try:
+        rng0 = jax.random.key(7)
+        reqs = _mk_requests(rng0)
+        sched = ContinuousBatchingScheduler(
+            engine, max_prefill_tokens=64, registry=engine.registry
+        )
+        for r in reqs:
+            sched.submit(r)
+        done = list(sched.run_until_idle())
+    finally:
+        engine.registry.trace = old_trace
+    comps = {c.request_id: c for c in done}
+    assert sorted(comps) == list(range(6))
+
+    for i, r in enumerate(reqs):
+        t, k, p = CONFIGS[i]
+        rng = jax.random.fold_in(rng0, i) if t > 0 else None
+        solo = generate(
+            model, params, jnp.asarray(r.prompt)[None], MAXNEW[i],
+            temperature=t, top_k=k, top_p=p, rng=rng,
+        )
+        solo_new = np.asarray(solo)[0, len(r.prompt):].tolist()
+        assert comps[i].tokens == solo_new, (
+            f"request {i}: stream changed with lifecycle tracing on"
+        )
+
+    by_rid: dict = {}
+    for e in tracer.events():
+        rid = (e.get("args") or {}).get("rid")
+        if rid is not None:
+            by_rid.setdefault(rid, {}).setdefault(e["name"], []).append(e)
+    for i in range(6):
+        spans = by_rid[i]
+        (q,) = spans[schedlib.REQ_QUEUE]
+        (p,) = spans[schedlib.REQ_PREFILL]
+        assert schedlib.REQ_DONE in spans
+        decodes = spans.get(schedlib.REQ_DECODE, [])
+        # queue + prefill == TTFT, exactly — both spans are cut from the
+        # same timestamps the scheduler stamps ttft_s with.
+        assert q["dur_s"] + p["dur_s"] == pytest.approx(
+            comps[i].ttft_s, abs=1e-9
+        )
+        # ...and they abut at the wave boundary (no gap, no overlap).
+        assert q["ts_mono"] + q["dur_s"] == pytest.approx(
+            p["ts_mono"], abs=1e-9
+        )
+        # Prefill yielded token 1; decode events cover the rest.
+        assert sum(
+            d["args"]["n"] for d in decodes
+        ) == len(comps[i].tokens) - 1
+        assert p["args"]["prompt"] == len(reqs[i].prompt)
+        assert p["args"]["cached"] + p["args"]["suffix"] >= len(
+            reqs[i].prompt
+        )
+
+
 # -- paged KV arena + radix prefix cache ------------------------------------
 
 
@@ -984,9 +1060,14 @@ def test_server_lifecycle_and_drain_artifacts(tmp_path):
     and flight record (validated by the SAME lint an operator runs).
     Runs spec-on: the declared-coverage check below requires every
     SERVE_* constant in the report, and the serve/spec_* keys exist
-    only on a spec-on server (full-set-or-absent contract)."""
+    only on a spec-on server (full-set-or-absent contract).  Runs with
+    an (unbreachable) SLO attached and the time-series writer on for
+    the same reason: serve/slo_* is full-set-or-absent, and coverage of
+    SERVE_SLO_BREACH / SERVE_SLO_MARGIN needs a monitor present."""
     srv = LMServer(
-        _factory(spec_tokens=2), workdir=str(tmp_path), process_index=0
+        _factory(spec_tokens=2), workdir=str(tmp_path), process_index=0,
+        slo_specs=["serve/ttft_s:p99<60@60s"],
+        timeseries_interval_s=0.01,
     )
     with pytest.raises(RuntimeError):
         srv.submit([1, 2], 2)  # not started
@@ -1012,6 +1093,12 @@ def test_server_lifecycle_and_drain_artifacts(tmp_path):
 
     stats = srv.stats()
     assert stats["metrics"][reglib.SERVE_REQUESTS] == 7.0  # bad: rejected
+    assert stats["metrics"][reglib.SERVE_COMPLETED] == 7.0
+    # SLO family present (monitor attached) and quiet (60s threshold).
+    assert (
+        stats["metrics"][f"{reglib.SERVE_SLO_BREACH}/ttft_s_p99"] == 0.0
+    )
+    assert stats["metrics"][f"{reglib.SERVE_SLO_MARGIN}/ttft_s_p99"] > 0
     srv.drain()
     with pytest.raises(ServerDraining):
         srv.submit([1], 1)
@@ -1048,7 +1135,24 @@ def test_server_lifecycle_and_drain_artifacts(tmp_path):
     record = json.loads(record_path.read_text())
     names = {e["name"] for e in record["events"]}
     assert {"serve/prefill", "serve/decode", "serve/drain"} <= names
+    # Per-request lifecycle spans (ISSUE 16) ride in the same ring.
+    assert {
+        "serve/req/queue", "serve/req/prefill", "serve/req/decode",
+        "serve/req/done",
+    } <= names
+    assert "serve/slo_breach" not in names  # 60s threshold: quiet
     assert record["reason"] == "serve_drain"
+    # Time-series rows: schema-clean (monotonic stamps, numbers-only,
+    # declared keys), final row written at drain.
+    ts_path = tmp_path / "timeseries_p0.jsonl"
+    assert ts_path.exists()
+    proc = subprocess.run(
+        [sys.executable, SCHEMA_LINT, str(ts_path), "--timeseries"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    last = json.loads(ts_path.read_text().splitlines()[-1])
+    assert last["offered"] == 7.0 and last["served"] == 7.0
 
 
 class _StubListener:

@@ -1,0 +1,425 @@
+"""Serving observability (ISSUE 16): SLO monitor, time-series, report.
+
+Everything here is jax-free — the SLO monitor, the time-series writer,
+and ``scripts/serving_report.py`` are supervisor-side tools and must
+stay importable (and correct) without an accelerator stack:
+
+- rolling-window percentiles agree with an exact nearest-rank oracle,
+  including time-based pruning;
+- breach/recovery hysteresis counts *episodes*, not evaluations, and
+  the margin gauge goes negative exactly while out of SLO;
+- warmup swallows cold-start samples;
+- the time-series writer emits monotonic, schema-clean, bounded,
+  never-torn rows (validated by the operator's own schema lint);
+- ``serving_report.py`` rebuilds waterfalls whose queue+prefill must
+  reconcile with TTFT, renders verdict tables, and exports a loadable
+  merged Chrome trace;
+- request IDs stay unique under concurrent front-half submission.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from distributed_tensorflow_models_tpu.serving.server import LMServer
+from distributed_tensorflow_models_tpu.telemetry import registry as reglib
+from distributed_tensorflow_models_tpu.telemetry import slo as slolib
+from distributed_tensorflow_models_tpu.telemetry import (
+    timeseries as tslib,
+)
+from distributed_tensorflow_models_tpu.telemetry import trace as tracelib
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO_ROOT, "scripts")
+SCHEMA_LINT = os.path.join(SCRIPTS, "check_metrics_schema.py")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+import serving_report  # noqa: E402
+
+
+# -- spec parsing ----------------------------------------------------------
+
+
+def test_parse_slo_spec_forms():
+    s = slolib.parse_slo_spec("serve/ttft_s:p99<0.25@30s")
+    assert s.name == "ttft_s_p99"
+    assert s.key == "serve/ttft_s"
+    assert s.percentile == pytest.approx(0.99)
+    assert s.threshold == pytest.approx(0.25)
+    assert s.window_s == pytest.approx(30.0)
+    named = slolib.parse_slo_spec("gold=serve/tpot_s:p50<0.01@5")
+    assert named.name == "gold" and named.percentile == pytest.approx(0.5)
+    fine = slolib.parse_slo_spec("serve/ttft_s:p99.9<1e-1@2.5s")
+    assert fine.percentile == pytest.approx(0.999)
+    assert fine.name == "ttft_s_p99_9"  # dots flattened: metric-key safe
+    assert fine.threshold == pytest.approx(0.1)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "serve/ttft_s",  # no objective at all
+        "serve/ttft_s:p99<0.25",  # no window
+        "serve/ttft_s:p0<0.25@30s",  # percentile out of range
+        "serve/ttft_s:p99<-1@30s",  # negative threshold
+        "serve/ttft_s:p99<0.25@0s",  # empty window
+        "a/b=serve/ttft_s:p99<0.25@30s",  # slash in name
+    ],
+)
+def test_parse_slo_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        slolib.parse_slo_spec(bad)
+
+
+# -- rolling window --------------------------------------------------------
+
+
+def _exact_nearest_rank(values, q):
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+
+
+def test_rolling_window_matches_exact_nearest_rank():
+    """Window percentiles agree with an exact oracle at every prefix
+    and every quantile — same rank rule as Timer.percentiles."""
+    win = slolib.RollingWindow(window_s=1e9)
+    vals = [((7 * i + 3) % 101) / 10.0 for i in range(257)]
+    for i, v in enumerate(vals):
+        win.observe(v, t=float(i))
+        for q in (0.5, 0.9, 0.95, 0.99):
+            got = win.percentile(q, now=float(i))
+            assert got == _exact_nearest_rank(vals[: i + 1], q), (i, q)
+
+
+def test_rolling_window_prunes_by_time_and_caps_samples():
+    win = slolib.RollingWindow(window_s=10.0, max_samples=4)
+    for t in range(8):  # values 0..7 at t=0..7
+        win.observe(float(t), t=float(t))
+    # Sample cap: only the newest 4 remain even though all are in-window.
+    assert win.percentile(0.5, now=7.0) == _exact_nearest_rank(
+        [4.0, 5.0, 6.0, 7.0], 0.5
+    )
+    # Time pruning: advance until only t=7 survives the 10s window.
+    assert win.percentile(0.99, now=16.5) == 7.0
+    # ...and an aged-out window reports None (empty = no opinion).
+    assert win.percentile(0.5, now=100.0) is None
+
+
+# -- monitor hysteresis ----------------------------------------------------
+
+
+def _monitor(reg, **kw):
+    kw.setdefault("eval_interval_s", 0.0)
+    return slolib.SLOMonitor(
+        ["serve/ttft_s:p99<0.1@10s"], reg, **kw
+    )
+
+
+def test_breach_recovery_hysteresis_counts_episodes():
+    reg = reglib.MetricsRegistry()
+    mon = _monitor(reg, breach_after=2, recover_after=2)
+    breach_key = f"{reglib.SERVE_SLO_BREACH}/ttft_s_p99"
+    margin_key = f"{reglib.SERVE_SLO_MARGIN}/ttft_s_p99"
+    # Pre-created at zero / threshold (full-set-or-absent contract).
+    assert reg.snapshot()[breach_key] == 0.0
+    assert reg.snapshot()[margin_key] == pytest.approx(0.1)
+
+    mon.observe("serve/ttft_s", 0.5, t=0.0)
+    assert mon.evaluate(now=0.0, force=True) == []  # streak 1 of 2
+    assert mon.breached() == ()
+    assert reg.snapshot()[margin_key] == pytest.approx(-0.4)  # negative
+    (tr,) = mon.evaluate(now=0.1, force=True)  # streak 2: breach fires
+    assert tr["event"] == "breach" and tr["slo"] == "ttft_s_p99"
+    assert tr["observed"] == pytest.approx(0.5)
+    assert mon.breached() == ("ttft_s_p99",)
+    assert reg.snapshot()[breach_key] == 1.0
+    # Still breaching: episodes, not evaluations — counter stays at 1.
+    assert mon.evaluate(now=0.2, force=True) == []
+    assert reg.snapshot()[breach_key] == 1.0
+
+    # Recovery: the bad sample ages out of the 10s window; an empty
+    # window counts as in-SLO.  Two consecutive clean evaluations.
+    assert mon.evaluate(now=20.0, force=True) == []  # ok streak 1
+    (tr,) = mon.evaluate(now=20.1, force=True)
+    assert tr["event"] == "recovery"
+    assert mon.breached() == ()
+    assert reg.snapshot()[margin_key] == pytest.approx(0.1)
+
+    # A second stall is a second episode.
+    mon.observe("serve/ttft_s", 0.9, t=21.0)
+    mon.evaluate(now=21.0, force=True)
+    (tr,) = mon.evaluate(now=21.1, force=True)
+    assert tr["event"] == "breach"
+    assert reg.snapshot()[breach_key] == 2.0
+
+
+def test_single_spike_does_not_flap():
+    reg = reglib.MetricsRegistry()
+    mon = _monitor(reg, breach_after=3, recover_after=3)
+    mon.observe("serve/ttft_s", 5.0, t=0.0)  # one outlier
+    mon.evaluate(now=0.0, force=True)
+    mon.evaluate(now=0.1, force=True)
+    # Outlier ages out before the third strike: no breach ever fires.
+    assert mon.evaluate(now=11.0, force=True) == []
+    assert mon.breached() == ()
+    assert reg.snapshot()[f"{reglib.SERVE_SLO_BREACH}/ttft_s_p99"] == 0.0
+
+
+def test_warmup_swallows_cold_start_samples():
+    reg = reglib.MetricsRegistry()
+    mon = _monitor(reg, breach_after=1, warmup_samples=3)
+    for i in range(3):  # compile-era spikes: dropped
+        mon.observe("serve/ttft_s", 9.0, t=float(i))
+    assert mon.evaluate(now=3.0, force=True) == []  # window still empty
+    mon.observe("serve/ttft_s", 0.01, t=4.0)  # steady state: sampled
+    assert mon.evaluate(now=4.0, force=True) == []
+    assert mon.breached() == ()
+    mon.observe("serve/ttft_s", 2.0, t=5.0)  # real post-warmup stall
+    (tr,) = mon.evaluate(now=5.0, force=True)
+    assert tr["event"] == "breach"
+
+
+def test_monitor_rate_limits_and_ignores_unwatched_keys():
+    reg = reglib.MetricsRegistry()
+    mon = slolib.SLOMonitor(
+        ["serve/ttft_s:p99<0.1@10s"], reg, eval_interval_s=100.0,
+        breach_after=1,
+    )
+    mon.observe("serve/unwatched", 99.0, t=0.0)  # no-op, no window
+    assert mon.keys == ("serve/ttft_s",)
+    mon.observe("serve/ttft_s", 5.0, t=0.0)
+    assert mon.evaluate(now=0.0) != []  # first call always runs
+    mon.observe("serve/ttft_s", 5.0, t=1.0)
+    assert mon.evaluate(now=1.0) == []  # inside the interval: skipped
+    assert mon.evaluate(now=200.0) == []  # runs again (still breached)
+
+
+# -- time-series writer ----------------------------------------------------
+
+
+def test_timeseries_rows_schema_clean_and_bounded(tmp_path):
+    reg = reglib.MetricsRegistry()
+    reg.counter(reglib.SERVE_REQUESTS)
+    reg.counter(reglib.SERVE_COMPLETED)
+    reg.timer(reglib.SERVE_TTFT).record(0.01)
+    path = str(tmp_path / "timeseries_p0.jsonl")
+    w = tslib.TimeseriesWriter(path, reg, interval_s=0.5, max_rows=10)
+    for i in range(25):
+        reg.counter(reglib.SERVE_REQUESTS).inc(2)
+        reg.counter(reglib.SERVE_COMPLETED).inc()
+        w.write_row(now=float(i))
+    lines = open(path).read().splitlines()
+    # Bounded: compaction kicked in; every surviving line parses whole
+    # (single-write appends never tear).
+    assert len(lines) <= 10
+    rows = [json.loads(line) for line in lines]
+    assert all(r["offered"] >= r["served"] >= 0 for r in rows)
+    assert rows == sorted(rows, key=lambda r: r["ts_mono"])
+    assert rows[-1]["offered"] == 50.0 and rows[-1]["served"] == 25.0
+    assert f"{reglib.SERVE_TTFT}/p99_s" in rows[-1]
+    proc = subprocess.run(
+        [sys.executable, SCHEMA_LINT, path, "--timeseries"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+
+
+def test_timeseries_maybe_write_rate_limits(tmp_path):
+    reg = reglib.MetricsRegistry()
+    path = str(tmp_path / "timeseries_p0.jsonl")
+    w = tslib.TimeseriesWriter(path, reg, interval_s=10.0)
+    assert w.maybe_write(now=0.0) is True  # first row always lands
+    assert w.maybe_write(now=5.0) is False  # inside the interval
+    assert w.maybe_write(now=10.5) is True
+    assert len(open(path).read().splitlines()) == 2
+
+
+def test_timeseries_schema_lint_rejects_bad_rows(tmp_path):
+    path = tmp_path / "timeseries_p0.jsonl"
+    rows = [
+        # served > offered AND an undeclared key
+        {"ts_wall": 1.0, "ts_mono": 5.0, "offered": 1, "served": 2,
+         "serve/made_up_key": 3},
+        # ts_mono going backwards, non-numeric value
+        {"ts_wall": 2.0, "ts_mono": 4.0, "offered": "x", "served": 0},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    proc = subprocess.run(
+        [sys.executable, SCHEMA_LINT, str(path), "--timeseries"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    for needle in (
+        "exceeds offered", "not declared", "went backwards",
+        "not a number",
+    ):
+        assert needle in proc.stderr, proc.stderr
+
+
+# -- serving_report --------------------------------------------------------
+
+
+def _fabricate_workdir(tmp_path):
+    """A one-replica workdir with two requests: rid 0 reconciles
+    (queue+prefill == ttft), rid 1 does not; one shed; one breach +
+    one recovery instant; stats with a FAIL and a PASS SLO; 4
+    time-series rows."""
+    reg = reglib.MetricsRegistry()
+    tracer = tracelib.Tracer(256, process_index=0)
+    t0 = time.perf_counter()
+    tracer.complete(
+        serving_report.REQ_QUEUE, 0.010, ts_mono=t0, args={"rid": 0}
+    )
+    tracer.complete(
+        serving_report.REQ_PREFILL, 0.020, ts_mono=t0 + 0.010,
+        args={"rid": 0, "prompt": 5, "cached": 2, "suffix": 8},
+    )
+    tracer.complete(
+        serving_report.REQ_DECODE, 0.002, ts_mono=t0 + 0.030,
+        args={"rid": 0, "n": 1},
+    )
+    tracer.instant(
+        serving_report.REQ_DONE,
+        {"rid": 0, "reason": "length", "tokens": 4, "ttft_s": 0.030},
+    )
+    tracer.instant(
+        serving_report.REQ_SHED,
+        {"rid": 1, "reason": "no_slot", "waiting": 3},
+    )
+    tracer.complete(
+        serving_report.REQ_QUEUE, 0.010, ts_mono=t0 + 0.050,
+        args={"rid": 1, "sheds": 2, "shed_reason": "no_slot"},
+    )
+    tracer.complete(
+        serving_report.REQ_PREFILL, 0.020, ts_mono=t0 + 0.060,
+        args={"rid": 1, "prompt": 4, "cached": 0, "suffix": 8},
+    )
+    tracer.instant(
+        serving_report.REQ_DONE,
+        {"rid": 1, "reason": "eos", "tokens": 3, "ttft_s": 0.5},
+    )
+    tracer.instant(
+        serving_report.BREACH_INSTANT,
+        {"slo": "ttft", "observed": 0.5, "threshold": 0.1},
+    )
+    tracer.instant(
+        serving_report.RECOVERY_INSTANT,
+        {"slo": "ttft", "observed": 0.05, "threshold": 0.1},
+    )
+    tracer.dump_flight_record(
+        str(tmp_path / "flight_recorder_p0.json"), "serve_drain",
+        registry=reg,
+    )
+    stats = {
+        "metrics": {
+            "serve/slo_breach/ttft": 1.0,
+            "serve/slo_margin/ttft": -0.4,
+            "serve/slo_breach/tpot": 0.0,
+            "serve/slo_margin/tpot": 0.02,
+        }
+    }
+    (tmp_path / "serving_stats_p0.json").write_text(json.dumps(stats))
+    with open(tmp_path / "timeseries_p0.jsonl", "w") as f:
+        for i in range(4):
+            f.write(json.dumps({
+                "ts_wall": 100.0 + i, "ts_mono": float(i),
+                "offered": 2.0 * i, "served": 1.5 * i,
+            }) + "\n")
+
+
+def test_serving_report_waterfalls_verdicts_throughput(tmp_path):
+    _fabricate_workdir(tmp_path)
+    report = serving_report.build_report(str(tmp_path))
+    assert report["processes"] == [0]
+    wf = {w["rid"]: w for w in report["waterfalls"]}
+    assert wf[0]["attributed"] and wf[0]["sum_ok"] is True
+    assert wf[0]["attribution_err_s"] == pytest.approx(0.0, abs=1e-12)
+    assert wf[0]["cached"] == 2 and wf[0]["prompt"] == 5
+    assert wf[0]["decode_dispatches"] == 1
+    # rid 1 claims 500ms TTFT against 30ms of spans: flagged, not hidden.
+    assert wf[1]["attributed"] and wf[1]["sum_ok"] is False
+    assert wf[1]["sheds"] == 2 and wf[1]["shed_reason"] == "no_slot"
+    assert report["attribution"] == {
+        "requests": 2, "attributed": 2, "sum_ok": 1, "sum_bad": 1,
+    }
+    (shed,) = report["sheds"]
+    assert shed["reason"] == "no_slot" and shed["waiting"] == 3
+    verdicts = {r["slo"]: r for r in report["slo"]}
+    assert verdicts["ttft"]["verdict"] == "FAIL"
+    assert verdicts["ttft"]["breaches"] == 1.0
+    assert verdicts["ttft"]["breach_instants"] == 1
+    assert verdicts["ttft"]["recovery_instants"] == 1
+    assert verdicts["ttft"]["margin"] == pytest.approx(-0.4)
+    assert verdicts["tpot"]["verdict"] == "PASS"
+    thr = report["throughput"]
+    assert thr["totals"] == {"offered": 6.0, "served": 4.5}
+    pts = thr["series"][0]
+    assert pts[0]["t"] == 0.0  # rebased
+    assert pts[1]["offered_rate"] == pytest.approx(2.0)
+    assert pts[1]["served_rate"] == pytest.approx(1.5)
+    # The text renderer covers every section without blowing up.
+    text = serving_report.format_report(report)
+    for needle in ("waterfalls:", "SLO verdicts:", "throughput:",
+                   "FAIL", "shed"):
+        assert needle in text, text
+
+
+def test_serving_report_cli_json_and_chrome(tmp_path, capsys):
+    _fabricate_workdir(tmp_path)
+    chrome = tmp_path / "merged_chrome.json"
+    rc = serving_report.main(
+        [str(tmp_path), "--json", "--chrome", str(chrome)]
+    )
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["attribution"]["requests"] == 2
+    merged = json.loads(chrome.read_text())
+    assert merged["traceEvents"], "empty merged Perfetto trace"
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert "serve/req/queue" in names
+    # An empty dir is a hard error, not a vacuous PASS.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert serving_report.main([str(empty)]) == 1
+
+
+# -- front-half request IDs ------------------------------------------------
+
+
+def test_request_ids_unique_under_concurrent_submission():
+    """8 threads hammering submit() on a server whose engine is still
+    'building': every handle gets a distinct request id (the id is the
+    trace/waterfall join key — a dup would merge two requests' spans)."""
+    release = threading.Event()
+
+    def factory():
+        release.wait(30.0)
+        raise RuntimeError("stub engine: drill over")
+
+    srv = LMServer(factory)
+    srv.start()
+    ids: list = []
+    lock = threading.Lock()
+
+    def pump():
+        mine = [
+            srv.submit([1, 2, 3], 2).request_id for _ in range(50)
+        ]
+        with lock:
+            ids.extend(mine)
+
+    threads = [threading.Thread(target=pump) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(ids) == 400 and len(set(ids)) == 400
+    release.set()
+    with pytest.raises(RuntimeError, match="stub engine"):
+        srv.drain()

@@ -50,6 +50,7 @@ def test_counter_gauge_timer_snapshot():
     assert snap["lap/max_s"] == pytest.approx(0.4)
     assert snap["lap/p50_s"] == pytest.approx(0.3)  # nearest-rank
     assert snap["lap/p95_s"] == pytest.approx(0.4)
+    assert snap["lap/p99_s"] == pytest.approx(0.4)
 
 
 def test_timer_reservoir_ages_out_old_samples():
