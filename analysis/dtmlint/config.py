@@ -26,6 +26,7 @@ JAX_FREE_ROOTS = (
     f"{PACKAGE}/resilience/backoff.py",
     f"{PACKAGE}/resilience/heartbeat.py",
     f"{PACKAGE}/serving/server.py",
+    f"{PACKAGE}/serving/replay.py",
     f"{PACKAGE}/telemetry/slo.py",
     f"{PACKAGE}/telemetry/timeseries.py",
 )
@@ -48,6 +49,11 @@ DETERMINISM_SCOPE = (
     # breach forensics — wall-clock reads belong in timeseries.py
     # (deliberately NOT scoped: its rows carry ts_wall by design).
     f"{PACKAGE}/serving/scheduler.py",
+    # Open-loop replayer (ISSUE 17): arrival offsets and prompt mixes
+    # are part of the drill's replay contract — every token and every
+    # inter-arrival gap must come from an explicit seed, and pacing
+    # must never read a wall clock.
+    f"{PACKAGE}/serving/replay.py",
     f"{PACKAGE}/telemetry/slo.py",
 )
 

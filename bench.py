@@ -1151,8 +1151,8 @@ ORDER = [
 # own subprocess fleet and the serving probe is a host-side scheduler
 # comparison, not a hardware kernel number.
 CHILD_MODES = sorted(BUILDERS) + [
-    "flash_check", "decode", "transformer_parts", "restart_mttr",
-    "serving", "speculation",
+    "disagg_serving", "flash_check", "decode", "transformer_parts",
+    "restart_mttr", "serving", "speculation",
 ]
 
 
@@ -2094,6 +2094,193 @@ def run_speculation(args):
     }
 
 
+def run_disagg_serving(args):
+    """Disaggregated prefill/decode serving A/B (ISSUE 17): the same
+    open-loop request traces (``serving.replay`` mixes, seeded arrivals)
+    through two fleet topologies at EQUAL host count — 2 monolithic
+    replicas vs 1 prefill + 1 decode replica — spawned as real
+    file-queue serving fleets under ``launch_local``.
+
+    - **mixed**: the interference trace (every 3rd request is a long
+      prefill with a tiny decode budget, the rest tiny prompts with
+      long decodes).  In a monolithic replica the long prefill waves
+      interleave with in-flight decode steps and blow up the decode
+      TPOT tail; the disagg decode replica never runs prefill, so its
+      TPOT stays flat.  Headline: monolithic decode TPOT p99 (worst
+      replica) over the disagg decode replica's — the direct read of
+      what role isolation buys.
+    - **uniform**: one prompt length, one decode budget — nothing to
+      interfere, so disaggregation should win nothing; the target is
+      bounded overhead (shipping every request costs <= ~1/0.9x on the
+      TPOT tail), not a win.
+
+    Every stream is asserted byte-identical per request_id across the
+    two topologies (greedy AND the seeded sampling modes the mixes
+    cycle through — the replica folds the key with request_id, so
+    same-rid streams are comparable).  CPU-safe, jax-free in this
+    parent (all device work happens in the spawned replicas).
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from distributed_tensorflow_models_tpu import launch
+    from distributed_tensorflow_models_tpu.serving import (
+        replay as replaylib,
+    )
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    base = tempfile.mkdtemp(prefix="dtm-disagg-")
+    port = [10470]
+    # DTM_DISAGG_SMOKE=1 shrinks the traces so the full path (paced
+    # fleets, both topologies, the bit-identity assert) validates in
+    # well under a minute.
+    smoke = os.environ.get("DTM_DISAGG_SMOKE") == "1"
+    # Trace sizes are set so the p99 rank clears the handful of
+    # compile-era TPOT samples (both arms pay one decode compile; with
+    # too few samples that one-time stall IS the p99 and the comparison
+    # reads compile luck, not scheduling).  mixed: 90 reqs ≈ 690
+    # samples; uniform: 180 reqs × 15 gaps = 2700 samples, ~1350 per
+    # monolithic replica.
+    n_mixed, n_uniform = (18, 12) if smoke else (90, 180)
+    uniform_new = 8 if smoke else 16
+
+    def pace(queue_dir, reqs):
+        replaylib.replay(
+            reqs, lambda r: replaylib.write_request(queue_dir, r)
+        )
+        done = os.path.join(queue_dir, "DONE")
+        with open(done + ".tmp", "w") as f:
+            f.write("done\n")
+        os.replace(done + ".tmp", done)
+
+    def run_arm(label, reqs, role_map):
+        port[0] += 1
+        scratch = os.path.join(base, label)
+        queue_dir = os.path.join(scratch, "queue")
+        workdir = os.path.join(scratch, "wd")
+        os.makedirs(queue_dir)
+        os.makedirs(workdir)
+        pacer = threading.Thread(
+            target=pace, args=(queue_dir, list(reqs)), daemon=True
+        )
+        pacer.start()
+        argv = [
+            sys.executable, "-m",
+            "distributed_tensorflow_models_tpu.serving.server",
+            "--queue-dir", queue_dir, "--workdir", workdir,
+            "--max-slots", "4", "--prefill-chunk", "8",
+            "--drain-grace-s", "60", "--timeout", "240",
+        ]
+        if role_map:
+            argv += ["--role-map", role_map]
+        codes = launch.launch_local(
+            2, argv, port=port[0], timeout=420.0,
+            extra_env={
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": repo + os.pathsep + os.environ.get(
+                    "PYTHONPATH", ""
+                ),
+            },
+        )
+        pacer.join(timeout=60)
+        if launch.aggregate_exit_codes(codes) != 0:
+            raise RuntimeError(f"{label}: fleet exit codes {codes}")
+        resp_dir = os.path.join(queue_dir, "resp")
+        responses = {}
+        for name in os.listdir(resp_dir):
+            if name.endswith(".json"):
+                with open(os.path.join(resp_dir, name)) as f:
+                    responses[
+                        int(name.split("-")[1].split(".")[0])
+                    ] = json.load(f)
+        stats = {}
+        for i in (0, 1):
+            path = os.path.join(workdir, f"serving_stats_p{i}.json")
+            with open(path) as f:
+                stats[i] = json.load(f)
+        return responses, stats
+
+    def decode_p99(stats, disagg, key):
+        """Worst decode-serving replica's tail: in the monolithic arm
+        both replicas decode (a request's TPOT tail is set by whichever
+        replica served it), in the disagg arm exactly one does."""
+        rows = [
+            s for s in stats.values()
+            if not disagg or s.get("role") == "decode"
+        ]
+        return max(s["metrics"][key] for s in rows)
+
+    def mix_ab(mix_label, reqs):
+        want = {r.request_id for r in reqs}
+        mono_resp, mono_stats = run_arm(f"{mix_label}-mono", reqs, "")
+        dis_resp, dis_stats = run_arm(
+            f"{mix_label}-disagg", reqs, "prefill,decode"
+        )
+        identical = set(mono_resp) == want and set(dis_resp) == want
+        for rid in sorted(set(mono_resp) & set(dis_resp)):
+            if mono_resp[rid]["tokens"] != dis_resp[rid]["tokens"]:
+                identical = False
+                log(
+                    f"disagg {mix_label} request {rid}: stream DIVERGED "
+                    "between topologies"
+                )
+        mono_tpot = decode_p99(mono_stats, False, "serve/tpot_s/p99_s")
+        dis_tpot = decode_p99(dis_stats, True, "serve/tpot_s/p99_s")
+        out = {
+            "monolithic_tpot_p99_ms": round(mono_tpot * 1e3, 3),
+            "disagg_decode_tpot_p99_ms": round(dis_tpot * 1e3, 3),
+            "tpot_p99_speedup": round(mono_tpot / dis_tpot, 2),
+            "monolithic_ttft_p99_ms": round(
+                decode_p99(mono_stats, False, "serve/ttft_s/p99_s") * 1e3,
+                3,
+            ),
+            "requests": len(reqs),
+            "shipped": int(
+                sum(
+                    s["metrics"].get("serve/ship_requests", 0.0)
+                    for s in dis_stats.values()
+                )
+            ),
+        }
+        log(f"disagg {mix_label}: {json.dumps(out)}")
+        return out, identical
+
+    try:
+        mixed_reqs = replaylib.assign_arrivals(
+            replaylib.mixed_mix(n_mixed, seed=23, sample_every=5),
+            seed=230, mean_gap_s=0.03,
+        )
+        uniform_reqs = replaylib.assign_arrivals(
+            replaylib.uniform_mix(
+                n_uniform, seed=24, new_tokens=uniform_new,
+                sample_every=5,
+            ),
+            seed=240, mean_gap_s=0.03,
+        )
+        mixed, ok_m = mix_ab("mixed", mixed_reqs)
+        uniform, ok_u = mix_ab("uniform", uniform_reqs)
+        return {
+            "metric": "disagg_serving",
+            # Headline: role isolation's effect on the decode TPOT tail
+            # under interference, at equal host count.
+            "value": mixed["tpot_p99_speedup"],
+            "unit": "x_decode_tpot_p99_vs_monolithic",
+            "bit_identical": ok_m and ok_u,
+            "mixed": mixed,
+            "uniform": uniform,
+            "hosts_per_arm": 2,
+            "trace": {
+                "mixed_requests": n_mixed,
+                "uniform_requests": n_uniform,
+                "mean_gap_s": 0.03,
+                "sample_every": 5,
+            },
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def run_mode(name, args):
     """Single dispatch point for both the child process and the
     --in-process path: train-loop configs go through run_one; standalone
@@ -2106,6 +2293,8 @@ def run_mode(name, args):
         return run_restart_mttr(args)
     if name == "serving":
         return run_serving(args)
+    if name == "disagg_serving":
+        return run_disagg_serving(args)
     if name == "speculation":
         return run_speculation(args)
     if name == "transformer_parts":
@@ -2192,8 +2381,8 @@ def main():
     )
     args = p.parse_args()
     if args.compile_only and (args.child or args.config) in (
-        "flash_check", "decode", "transformer_parts", "restart_mttr",
-        "serving", "all",
+        "disagg_serving", "flash_check", "decode", "transformer_parts",
+        "restart_mttr", "serving", "all",
     ):
         p.error("--compile-only supports a single builder config only")
     if args.compile_only and not (args.child or args.in_process):

@@ -181,6 +181,7 @@ class InferenceEngine:
         spec_ngram_order: int = 3,
         spec_min_match: int = 1,
         registry: Optional[reglib.MetricsRegistry] = None,
+        fleet_cache=None,
     ):
         if decode_burst < 1:
             raise ValueError(
@@ -267,6 +268,20 @@ class InferenceEngine:
             if prefix_cache else None
         )
         self._evictions_seen = 0  # cache.evictions already mirrored
+        # Fleet-wide prefix index (shipping.FleetPrefixIndex, or any
+        # object with the same chain-digest lookup/advertise surface).
+        # A resident prefix on ANY prefill replica serves the whole
+        # fleet: admission consults the index for pages the local trie
+        # misses (adopting them into the local trie, so the normal
+        # match path below reuses them), and prefill advertises freshly
+        # resident pages.  Requires the local prefix cache — adopted
+        # pages live in the trie like any other resident prefix.
+        if fleet_cache is not None and not prefix_cache:
+            raise ValueError(
+                "fleet_cache requires prefix_cache=True (fleet pages "
+                "are adopted into the local radix trie)"
+            )
+        self.fleet_cache = fleet_cache
         self._decode_model = model.clone(decode=True, dropout_rate=0.0)
         self.pool = kv_slots.make_pool(
             self._decode_model, self.num_blocks, self._page
@@ -428,6 +443,8 @@ class InferenceEngine:
         matchable = (
             self._matchable(prompt) if self.prefix_cache is not None else []
         )
+        if matchable and self.fleet_cache is not None:
+            self._fleet_extend(matchable)
         depth = (
             self.prefix_cache.peek(matchable) if matchable else 0
         )
@@ -481,6 +498,203 @@ class InferenceEngine:
         self._lengths[slot] = 0
         self._views_fresh[slot] = False
         return request_id
+
+    # -- KV page shipping (disaggregated prefill/decode) -------------------
+    #
+    # The wire unit is the pool page: export gathers a finished slot's
+    # prompt pages through the SAME gather_cache/cache_pages ops the
+    # prefill program uses (so the shipped bytes are exactly what a
+    # dedicated slot would hold), and import scatters them into the
+    # receiving pool at freshly allocated physical blocks.  Both sides
+    # run eagerly — they add ZERO compiled programs to the two jitted
+    # entry points, so the per-role compile pins ((1, 0) prefill /
+    # (0, 1) decode) come straight from jit laziness.  Only pages below
+    # the prompt length ship: positions past it are right-padding
+    # garbage that is causally masked on both ends (module docstring),
+    # so decode over adopted pages reduces identically to decode over
+    # the pages prefill wrote in place.
+
+    def _flatten_pages(self, node, prefix="", out=None) -> dict:
+        """Pool-shaped nested dict -> ``{"a/b/c": leaf}`` in sorted key
+        order, skipping counter leaves (lengths are host truth and
+        travel in the bundle header, never as pool bytes)."""
+        if out is None:
+            out = {}
+        for k in sorted(node):
+            if k in kv_slots.COUNTER_LEAVES:
+                continue
+            path = f"{prefix}/{k}" if prefix else k
+            if isinstance(node[k], dict):
+                self._flatten_pages(node[k], path, out)
+            else:
+                out[path] = node[k]
+        return out
+
+    def _unflatten_pages(self, flat: dict) -> dict:
+        """``{"a/b/c": arr}`` -> the pool's nested-dict shape (counter
+        leaves omitted — :func:`~.kv_slots.scatter_pages` never reads
+        them from the page tree)."""
+
+        def walk(node, prefix):
+            out = {}
+            for k in node:
+                if k in kv_slots.COUNTER_LEAVES:
+                    continue
+                path = f"{prefix}/{k}" if prefix else k
+                if isinstance(node[k], dict):
+                    out[k] = walk(node[k], path)
+                else:
+                    if path not in flat:
+                        raise ValueError(
+                            f"shipped pages missing pool leaf {path!r}"
+                        )
+                    out[k] = flat[path]
+            return out
+
+        return walk(self.pool, "")
+
+    def _export_prompt_pages(self, slot: int, n_pages: int) -> dict:
+        """The first ``n_pages`` of ``slot``'s sequence as host arrays,
+        ``{path: [n_pages, page_tokens, ...]}`` — gathered through the
+        slot's block table via :func:`~.kv_slots.gather_cache` then
+        re-paged via :func:`~.kv_slots.cache_pages`, the exact ops the
+        compiled programs move pages with."""
+        view = kv_slots.gather_cache(
+            self.pool, jnp.asarray(self._tables[slot]),
+            int(self._lengths[slot]),
+        )
+        paged = kv_slots.cache_pages(view, self._page)
+        return {
+            path: np.asarray(leaf[:n_pages])
+            for path, leaf in self._flatten_pages(paged).items()
+        }
+
+    def export_slot(self, slot: int) -> tuple:
+        """Export a prefilled slot's KV for shipping: returns
+        ``(prompt_len, {path: [n_pages, page_tokens, ...]})`` covering
+        ``ceil(prompt_len / page_tokens)`` pages.  Call after
+        ``prefill_batch`` set the slot's true length and before
+        ``release`` frees its blocks."""
+        plen = int(self._lengths[slot])
+        if plen < 1:
+            raise ValueError(f"slot {slot} has no prefilled tokens")
+        n_pages = -(-plen // self._page)
+        return plen, self._export_prompt_pages(slot, n_pages)
+
+    def _scatter_shipped(self, pages: dict, block_ids) -> None:
+        """Write shipped pages (``{path: [n, page_tokens, ...]}``) into
+        the pool at physical ``block_ids`` — the import side of the
+        wire, via :func:`~.kv_slots.scatter_pages`."""
+        indices = jnp.asarray(np.asarray(block_ids, np.int32))
+        self.pool = kv_slots.scatter_pages(
+            self.pool, self._unflatten_pages(pages), indices
+        )
+
+    def admit_shipped(self, request_id: int, prompt_len: int,
+                      max_new_tokens: int, pages: dict):
+        """Decode-side admission of a shipped request: claim a slot AND
+        the request's FULL fresh reservation (no prefix matching — the
+        prompt's KV arrives on the wire), scatter the shipped prompt
+        pages in, and mark the lane for view adoption.  Returns the
+        slot, or None on backpressure (slots/blocks exhausted — nothing
+        leaked, the caller requeues).  The adopted lane then decodes
+        byte-identically to one the local prefill program filled: the
+        gathered view is the same bytes either way."""
+        plen = int(prompt_len)
+        if plen < 1:
+            raise ValueError("shipped prompt_len must be >= 1")
+        n_pages = -(-plen // self._page)
+        for path, arr in pages.items():
+            if arr.shape[0] != n_pages or arr.shape[1] != self._page:
+                raise ValueError(
+                    f"shipped leaf {path!r} shape {arr.shape} does not "
+                    f"cover {n_pages} pages of {self._page} tokens"
+                )
+        n_need = -(-(plen + max_new_tokens) // self._page)
+        if self.slots.free_count < 1:
+            return None
+        if n_need > self.blocks.free_count and self.prefix_cache is not None:
+            self.prefix_cache.evict(n_need - self.blocks.free_count)
+            self._sync_eviction_counter()
+        fresh = self.blocks.alloc(n_need)
+        if fresh is None:
+            return None
+        self._scatter_shipped(pages, fresh[:n_pages])
+        slot = self.slots.alloc(request_id)
+        row = np.zeros((self._bps,), np.int32)
+        row[: len(fresh)] = fresh
+        self._tables[slot] = row
+        self._lengths[slot] = plen
+        self._slot_blocks[slot] = fresh
+        self._slot_cached[slot] = 0
+        self._views_fresh[slot] = True
+        return slot
+
+    def _fleet_extend(self, matchable: list) -> None:
+        """Pull pages the local trie misses from the fleet index: adopt
+        the longest advertised extension into freshly allocated blocks
+        and insert it into the local trie, so the normal match path
+        reuses fleet pages exactly like locally prefilled ones.  Counts
+        ``serve/fleet_prefix_{hits,misses}`` block-granularly over the
+        consulted tail.  Failure to adopt (no block headroom) is a
+        miss, never an error."""
+        depth = self.prefix_cache.peek(matchable)
+        if depth >= len(matchable):
+            return
+        found = self.fleet_cache.lookup(matchable)
+        n_new = len(found) - depth
+        misses = len(matchable) - max(depth, len(found))
+        if n_new > 0:
+            if n_new > self.blocks.free_count:
+                self.prefix_cache.evict(n_new - self.blocks.free_count)
+                self._sync_eviction_counter()
+            fresh = self.blocks.alloc(n_new)
+            if fresh is None:
+                misses += n_new
+                n_new = 0
+            else:
+                stacked = {
+                    path: np.stack(
+                        [np.asarray(lv[path]) for lv in found[depth:]]
+                    )
+                    for path in found[depth]
+                }
+                self._scatter_shipped(stacked, fresh)
+                # Chain blocks for the trie walk: the already-resident
+                # prefix keeps its own blocks (insert leaves existing
+                # nodes untouched), the extension adopts the fresh
+                # ones; our temporary alloc reference is dropped once
+                # the cache holds its own.
+                chain = (
+                    self.prefix_cache.match(matchable[:depth]) + fresh
+                )
+                self.prefix_cache.insert(matchable[:len(found)], chain)
+                self.blocks.release(fresh)
+                self._sync_eviction_counter()
+        if n_new > 0:
+            self.registry.counter(
+                reglib.SERVE_FLEET_PREFIX_HITS
+            ).inc(n_new)
+        if misses > 0:
+            self.registry.counter(
+                reglib.SERVE_FLEET_PREFIX_MISSES
+            ).inc(misses)
+
+    def _fleet_advertise(self, slot: int, pages: list) -> None:
+        """Advertise a freshly prefilled prompt's shareable pages to
+        the fleet index (publish-if-absent; skipped wholesale when
+        every digest is already advertised, so steady-state repeat
+        traffic exports nothing)."""
+        if not pages or not self.fleet_cache.any_missing(pages):
+            return
+        stacked = self._export_prompt_pages(slot, len(pages))
+        self.fleet_cache.advertise(
+            pages,
+            [
+                {path: arr[i] for path, arr in stacked.items()}
+                for i in range(len(pages))
+            ],
+        )
 
     def _sync_eviction_counter(self) -> None:
         delta = (
@@ -740,6 +954,8 @@ class InferenceEngine:
                                  self._tables[slot][:len(pages)]],
                             )
                             self._sync_eviction_counter()
+                            if self.fleet_cache is not None:
+                                self._fleet_advertise(slot, pages)
         return out
 
     def decode_step(self, lanes: dict) -> dict:

@@ -1,0 +1,228 @@
+"""Deterministic open-loop request replayer for serving drills and benches.
+
+Lifts the request mixes that ``bench.py`` previously built inline
+(mixed long-prefill/short-decode traffic, shared-prefix traffic with a
+common system prompt, and a uniform control mix) into one reusable
+module, and adds the piece the disaggregated drill needs: **open-loop
+arrivals**.  A closed-loop driver (write every request up front, let
+replicas drain the queue) hides interference — prefill of a long
+prompt stalls decode steps only when the two actually overlap, which
+requires requests to *arrive over time*.  The replayer assigns each
+request a deterministic arrival offset (seeded exponential
+inter-arrival gaps) and paces emission against ``time.perf_counter``.
+
+Determinism contract (this module is in the dtm-lint determinism
+scope, and the drill parent imports it without jax):
+
+- every token of every prompt and every arrival offset is derived from
+  an explicit seed through ``random.Random`` instances — replaying the
+  same (mix, seed) yields byte-identical request specs and offsets;
+- the replay-critical path never reads a wall clock: pacing uses
+  ``time.perf_counter`` (the allowlisted monotonic timer) only, and
+  the emitted specs carry no timestamps — timing enters the system
+  when the serving replica *admits* the request, not here;
+- module-level imports are stdlib-only, so the drill/bench parent
+  stays jax-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from random import Random
+from typing import Callable, Iterable, Optional
+
+__all__ = [
+    "ReplayRequest",
+    "uniform_mix",
+    "mixed_mix",
+    "shared_prefix_mix",
+    "open_loop_arrivals",
+    "assign_arrivals",
+    "write_request",
+    "replay",
+]
+
+
+@dataclasses.dataclass
+class ReplayRequest:
+    """One request of a replay trace.
+
+    ``arrival_s`` is the offset from trace start (seconds) at which
+    the replayer emits the request; 0.0 until ``assign_arrivals``.
+    """
+
+    request_id: int
+    prompt: list
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    seed: int = 0
+    arrival_s: float = 0.0
+
+    def spec(self) -> dict:
+        """The file-queue request spec (what ``req-<id>.json`` holds)."""
+        out = {
+            "request_id": self.request_id,
+            "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
+            "seed": self.seed,
+        }
+        if self.eos_id is not None:
+            out["eos_id"] = self.eos_id
+        return out
+
+
+def _tokens(rng: Random, n: int, vocab: int) -> list:
+    return [rng.randrange(vocab) for _ in range(n)]
+
+
+def _mode(rid: int, sample_every: int, seed: int) -> dict:
+    """Sampling mode for request ``rid``: greedy by default, seeded
+    temperature/top-k/top-p every ``sample_every``-th request so a
+    trace exercises every decode path (0 disables sampling)."""
+    if not sample_every or rid % sample_every:
+        return {}
+    kind = (rid // sample_every) % 3
+    if kind == 0:
+        return {"temperature": 0.7, "seed": seed + rid}
+    if kind == 1:
+        return {"temperature": 1.0, "top_k": 5, "seed": seed + rid}
+    return {"temperature": 1.0, "top_p": 0.9, "seed": seed + rid}
+
+
+def uniform_mix(n: int, *, seed: int, vocab: int = 64, prompt_len: int = 8,
+                new_tokens: int = 8, sample_every: int = 0,
+                first_id: int = 0) -> list:
+    """Control mix: ``n`` distinct prompts of one length, one decode
+    budget.  Disaggregation should not help here (nothing to
+    interfere), which is exactly what the bench's >=0.9x floor checks.
+    """
+    rng = Random(seed)
+    reqs = []
+    for i in range(n):
+        rid = first_id + i
+        reqs.append(ReplayRequest(
+            request_id=rid,
+            prompt=_tokens(rng, prompt_len, vocab),
+            max_new_tokens=new_tokens,
+            **_mode(rid, sample_every, seed),
+        ))
+    return reqs
+
+
+def mixed_mix(n: int, *, seed: int, vocab: int = 64, long_len: int = 48,
+              long_new: int = 2, short_len: int = 4, short_new: int = 12,
+              long_every: int = 3, sample_every: int = 0,
+              first_id: int = 0) -> list:
+    """The interference mix: every ``long_every``-th request is
+    prefill-heavy (long prompt, tiny decode), the rest are
+    decode-heavy (tiny prompt, long decode).  In a monolithic replica
+    the long prefills stall in-flight decode steps and blow up TPOT
+    tails; a decode-only replica never runs prefill, so its TPOT is
+    flat.  This is the trace the disagg bench arm measures."""
+    rng = Random(seed)
+    reqs = []
+    for i in range(n):
+        rid = first_id + i
+        heavy = long_every and i % long_every == 0
+        reqs.append(ReplayRequest(
+            request_id=rid,
+            prompt=_tokens(rng, long_len if heavy else short_len, vocab),
+            max_new_tokens=long_new if heavy else short_new,
+            **_mode(rid, sample_every, seed),
+        ))
+    return reqs
+
+
+def shared_prefix_mix(n: int, *, seed: int, vocab: int = 64,
+                      shared_len: int = 8, tail_len: int = 2,
+                      new_tokens: int = 4, copies: int = 1,
+                      sample_every: int = 0, first_id: int = 0) -> list:
+    """Shared-system-prompt mix: every prompt starts with one common
+    ``shared_len``-token block followed by a unique tail.  With
+    ``copies`` > 1 each (prompt, decode-budget) spec is emitted that
+    many times under distinct request_ids — consecutive copies, so a
+    round-robin fleet lands them on different replicas and the
+    fleet-wide prefix cache (not the local trie) has to supply the
+    shared block."""
+    rng = Random(seed)
+    shared = _tokens(rng, shared_len, vocab)
+    reqs = []
+    rid = first_id
+    for i in range(n):
+        tail = _tokens(rng, tail_len, vocab)
+        for _ in range(max(1, copies)):
+            reqs.append(ReplayRequest(
+                request_id=rid,
+                prompt=shared + tail,
+                max_new_tokens=new_tokens,
+                **_mode(rid, sample_every, seed),
+            ))
+            rid += 1
+    return reqs
+
+
+def open_loop_arrivals(n: int, *, seed: int, mean_gap_s: float) -> list:
+    """``n`` cumulative arrival offsets with exponential inter-arrival
+    gaps of mean ``mean_gap_s`` — the standard open-loop (Poisson)
+    arrival process, fully determined by ``seed``."""
+    rng = Random(seed)
+    out, t = [], 0.0
+    for _ in range(n):
+        t += rng.expovariate(1.0 / mean_gap_s) if mean_gap_s > 0 else 0.0
+        out.append(t)
+    return out
+
+
+def assign_arrivals(requests: list, *, seed: int, mean_gap_s: float) -> list:
+    """Stamp each request's ``arrival_s`` in submission order."""
+    for req, t in zip(requests,
+                      open_loop_arrivals(len(requests), seed=seed,
+                                         mean_gap_s=mean_gap_s)):
+        req.arrival_s = t
+    return requests
+
+
+def write_request(queue_dir: str, req: ReplayRequest) -> str:
+    """Atomically publish one request file into the shared queue
+    (tmp + rename, same protocol the replicas claim against)."""
+    path = os.path.join(queue_dir, f"req-{req.request_id}.json")
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(req.spec(), f)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def replay(requests: Iterable[ReplayRequest],
+           emit: Callable[[ReplayRequest], object], *,
+           speedup: float = 1.0) -> int:
+    """Emit each request at its arrival offset (open loop: pacing
+    never waits on completions).  ``speedup`` > 1 compresses the
+    trace.  Pacing reads ``time.perf_counter`` only — no wall clock —
+    and sleeps are capped so SIGINT/teardown stay responsive.  Returns
+    the number of requests emitted."""
+    t0 = time.perf_counter()
+    n = 0
+    for req in sorted(requests, key=lambda r: (r.arrival_s, r.request_id)):
+        target = t0 + req.arrival_s / max(speedup, 1e-9)
+        while True:
+            delay = target - time.perf_counter()
+            if delay <= 0:
+                break
+            time.sleep(min(delay, 0.05))
+        emit(req)
+        n += 1
+    return n

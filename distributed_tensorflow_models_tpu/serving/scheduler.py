@@ -90,6 +90,7 @@ from .drafter import NO_DRAFT, NgramDrafter
 # registry metric keys — serving_report.py groups them by args["rid"]).
 REQ_QUEUE = "serve/req/queue"
 REQ_PREFILL = "serve/req/prefill"
+REQ_SHIP = "serve/req/ship"
 REQ_DECODE = "serve/req/decode"
 REQ_SHED = "serve/req/shed"
 REQ_DONE = "serve/req/done"
@@ -121,7 +122,7 @@ class Completion:
 
     request_id: int
     tokens: list
-    finish_reason: str  # "eos" | "length"
+    finish_reason: str  # "eos" | "length" | "shipped" (prefill role)
     ttft_s: float
     decode_steps: int
 
@@ -132,6 +133,7 @@ class _InFlight:
     __slots__ = (
         "req", "slot", "keydata", "tokens", "pos", "t_submit", "ttft_s",
         "t_last", "drafter", "cached_len", "sheds", "shed_reason",
+        "ship",
     )
 
     def __init__(self, req, slot, keydata, t_submit):
@@ -147,6 +149,7 @@ class _InFlight:
         self.cached_len = 0  # prefix-cache hit length, set at admission
         self.sheds = 0  # backpressure events suffered while head-of-line
         self.shed_reason = ""  # last shed reason ("no_slot" | "no_blocks")
+        self.ship = None  # shipped-arrival facts dict (decode role only)
 
 
 class ContinuousBatchingScheduler:
@@ -166,7 +169,31 @@ class ContinuousBatchingScheduler:
         registry: Optional[reglib.MetricsRegistry] = None,
         drafter_factory=None,
         slo_monitor=None,
+        role: str = "monolithic",
+        ship=None,
     ):
+        if role not in ("monolithic", "prefill", "decode"):
+            raise ValueError(
+                f"role must be monolithic|prefill|decode, got {role!r}"
+            )
+        if (ship is not None) != (role == "prefill"):
+            raise ValueError(
+                "ship callback is required for role='prefill' and "
+                "forbidden otherwise"
+            )
+        # Disaggregation (see serving/shipping.py): a "prefill" scheduler
+        # runs ONLY admission + the prefill program, then hands every
+        # unfinished request to the ship callback
+        # ``ship(inflight, first_token, t_prefill_start, t_prefill_end)``
+        # — called while the slot is still allocated so the callback can
+        # export its KV pages — and retires it locally with
+        # ``finish_reason="shipped"``.  A "decode" scheduler takes intake
+        # ONLY via :meth:`submit_shipped` (adopting wire pages through
+        # ``engine.admit_shipped``) and runs ONLY the decode program.
+        # Each role therefore never calls the other role's jitted entry
+        # point, so jit laziness pins compile counts at (1, 0) / (0, 1).
+        self.role = role
+        self._ship = ship
         self.engine = engine
         # Optional telemetry/slo.py monitor: _emit feeds it TTFT/TPOT
         # samples, step's tail feeds queue depth and evaluates (the
@@ -211,6 +238,12 @@ class ContinuousBatchingScheduler:
         in :meth:`step`).  Raises ``ValueError`` for requests that could
         never be served — rejecting at the door beats a slot wedged on
         an impossible request."""
+        if self.role == "decode":
+            raise ValueError(
+                "a decode-role scheduler takes intake only via "
+                "submit_shipped (raw prompts belong on a prefill or "
+                "monolithic replica)"
+            )
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
         if req.max_new_tokens < 1:
             raise ValueError(
@@ -230,6 +263,62 @@ class ContinuousBatchingScheduler:
         self._waiting.append(
             _InFlight(req, -1, keydata, time.perf_counter())
         )
+
+    def submit_shipped(
+        self,
+        req: Request,
+        *,
+        pages: dict,
+        keydata,
+        first_token: int,
+        t_submit: float,
+        queue_s: float,
+        prefill_s: float,
+        cached_len: int = 0,
+        wire_bytes: int = 0,
+        src_replica: int = -1,
+    ) -> None:
+        """Decode-role intake: enqueue a request whose prefill ALREADY
+        ran on another replica.  ``pages`` is the shipped prompt KV
+        (``{path: [n_pages, page_tokens, ...]}``), ``keydata`` the full
+        shipped key schedule (row 0 was consumed by prefill — indexing
+        stays identical to the monolithic path), ``first_token`` the
+        prefill program's sampled token (emitted here so TTFT lands on
+        the replica that streams), and ``t_submit`` the ORIGINAL submit
+        stamp rebased into this process's ``perf_counter`` frame
+        (:func:`~.shipping.mono_of_wall`) with the prefill replica's
+        measured ``queue_s``/``prefill_s`` legs — so this replica's
+        waterfall carries queue + prefill + ship spans summing exactly
+        to the TTFT it records."""
+        if self.role != "decode":
+            raise ValueError(
+                "submit_shipped is decode-role intake only"
+            )
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
+            )
+        self.engine.check_fits(len(prompt), req.max_new_tokens)
+        req.prompt = prompt
+        keydata = np.asarray(keydata)
+        if keydata.shape[0] != req.max_new_tokens:
+            raise ValueError(
+                f"shipped keydata covers {keydata.shape[0]} tokens, "
+                f"request wants {req.max_new_tokens}"
+            )
+        inflight = _InFlight(req, -1, keydata, float(t_submit))
+        inflight.cached_len = int(cached_len)
+        inflight.ship = {
+            "pages": pages,
+            "first_token": int(first_token),
+            "queue_s": float(queue_s),
+            "prefill_s": float(prefill_s),
+            "bytes": int(wire_bytes),
+            "src": int(src_replica),
+        }
+        self.registry.counter(reglib.SERVE_REQUESTS).inc()
+        self._waiting.append(inflight)
 
     # -- introspection -----------------------------------------------------
 
@@ -302,6 +391,36 @@ class ContinuousBatchingScheduler:
             )
         )
 
+    def _ship_out(self, inflight, first_token, t_wave: float,
+                  now: float, done: list) -> None:
+        """Prefill role: hand an unfinished request to the ship
+        callback (slot still allocated — the callback exports its KV
+        pages), then retire it locally as ``finish_reason="shipped"``.
+        The first token travels in the bundle and is EMITTED on the
+        decode replica, so TTFT/TPOT/token counters land where the
+        stream is served; here we record only the lifecycle instant."""
+        try:
+            self._ship(inflight, first_token, t_wave, now)
+        finally:
+            self.engine.release(inflight.slot)
+        trace = self.registry.trace
+        if trace.enabled:
+            trace.instant(REQ_DONE, {
+                "rid": inflight.req.request_id,
+                "reason": "shipped",
+                "tokens": 1,
+                "ttft_s": 0.0,
+            })
+        done.append(
+            Completion(
+                request_id=inflight.req.request_id,
+                tokens=[int(first_token)],
+                finish_reason="shipped",
+                ttft_s=0.0,
+                decode_steps=0,
+            )
+        )
+
     def step(self) -> list:
         """One scheduling iteration; returns retired :class:`Completion`s
         (possibly empty).  No-op when idle."""
@@ -313,14 +432,25 @@ class ContinuousBatchingScheduler:
         # (slots or blocks exhausted); retirement below frees both.
         spent = 0
         wave = []
+        adopted = []  # decode role: shipped requests admitted this pass
         while self._waiting:
-            req = self._waiting[0].req
-            cost = self.engine.peek_prefill_cost(req.prompt)
-            if wave and spent + cost > self.max_prefill_tokens:
-                break
-            admitted = self.engine.admit(
-                req.request_id, req.prompt, req.max_new_tokens
-            )
+            head = self._waiting[0]
+            req = head.req
+            if head.ship is not None:
+                # Shipped intake: the prompt's KV arrives on the wire,
+                # so admission costs no prefill compute and no budget —
+                # slots/blocks backpressure alone bounds the pass.
+                admitted = self.engine.admit_shipped(
+                    req.request_id, len(req.prompt),
+                    req.max_new_tokens, head.ship["pages"],
+                )
+            else:
+                cost = self.engine.peek_prefill_cost(req.prompt)
+                if wave and spent + cost > self.max_prefill_tokens:
+                    break
+                admitted = self.engine.admit(
+                    req.request_id, req.prompt, req.max_new_tokens
+                )
             if admitted is None:
                 # Backpressure: note the shed on the blocked head-of-line
                 # waiter (its queue span will carry the reason) and emit
@@ -345,11 +475,15 @@ class ContinuousBatchingScheduler:
                             "waiting": len(self._waiting),
                         })
                 break
-            slot, cached_len = admitted
             inflight = self._waiting.popleft()
+            if inflight.ship is not None:
+                inflight.slot = admitted
+                adopted.append(inflight)
+                continue
+            slot, cached_len = admitted
             inflight.slot = slot
             inflight.cached_len = cached_len
-            if self.engine.spec_tokens:
+            if self.engine.spec_tokens and self.role != "prefill":
                 if self._drafter_factory is not None:
                     inflight.drafter = self._drafter_factory(req)
                 else:
@@ -401,10 +535,81 @@ class ContinuousBatchingScheduler:
                         },
                     )
             for inflight in wave:
-                if self._emit(inflight, firsts[inflight.slot], now):
+                first = firsts[inflight.slot]
+                if self.role == "prefill":
+                    req = inflight.req
+                    finished = (
+                        req.eos_id is not None and first == req.eos_id
+                    ) or req.max_new_tokens == 1
+                    if finished:
+                        # Done AT prefill — nothing to ship; this
+                        # replica answers, exactly like monolithic.
+                        self._emit(inflight, first, now)
+                        self._retire(inflight, done)
+                    else:
+                        self._ship_out(inflight, first, t_wave, now,
+                                       done)
+                elif self._emit(inflight, first, now):
                     self._retire(inflight, done)  # frees slot + blocks
                 else:
                     self._active[inflight.slot] = inflight
+        if adopted:
+            # Shipped requests adopted this pass (decode role).  Emit
+            # the travelled queue/prefill legs plus the ship leg cut at
+            # this instant, then the first token: its TTFT lands at
+            # now - t_submit == queue_s + prefill_s + ship_s exactly
+            # (all three spans and the timer read the same stamps), so
+            # attribution still sums to TTFT with the wire in between.
+            now = time.perf_counter()
+            trace = self.registry.trace
+            for f in adopted:
+                s = f.ship
+                t_ship = f.t_submit + s["queue_s"] + s["prefill_s"]
+                ship_s = now - t_ship
+                self.registry.timer(reglib.SERVE_SHIP).record(ship_s)
+                if trace.enabled:
+                    args = {"rid": f.req.request_id}
+                    if f.sheds:
+                        args["sheds"] = f.sheds
+                        args["shed_reason"] = f.shed_reason
+                    trace.complete(
+                        REQ_QUEUE, s["queue_s"], ts_mono=f.t_submit,
+                        args=args,
+                    )
+                    trace.complete(
+                        REQ_PREFILL, s["prefill_s"],
+                        ts_mono=f.t_submit + s["queue_s"],
+                        args={
+                            "rid": f.req.request_id,
+                            "prompt": len(f.req.prompt),
+                            "cached": f.cached_len,
+                            "suffix": self.engine.padded_suffix(
+                                len(f.req.prompt), f.cached_len
+                            ),
+                        },
+                    )
+                    trace.complete(
+                        REQ_SHIP, ship_s, ts_mono=t_ship,
+                        args={
+                            "rid": f.req.request_id,
+                            "bytes": s["bytes"],
+                            "src": s["src"],
+                        },
+                    )
+                if self.engine.spec_tokens:
+                    if self._drafter_factory is not None:
+                        f.drafter = self._drafter_factory(f.req)
+                    else:
+                        f.drafter = NgramDrafter(
+                            f.req.prompt,
+                            spec_tokens=self.engine.spec_tokens,
+                            ngram_order=self.engine.spec_ngram_order,
+                            min_match=self.engine.spec_min_match,
+                        )
+                if self._emit(f, s["first_token"], now):
+                    self._retire(f, done)
+                else:
+                    self._active[f.slot] = f
         # 2. one batched decode dispatch (decode_burst tokens) for every
         # active slot.  A lane with fewer tokens left than the burst
         # passes only its remaining key rows; it finishes mid-burst and

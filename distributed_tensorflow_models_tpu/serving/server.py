@@ -61,6 +61,7 @@ from typing import Optional
 from distributed_tensorflow_models_tpu.resilience.preemption import (
     PreemptionListener,
 )
+from distributed_tensorflow_models_tpu.serving import shipping as shiplib
 from distributed_tensorflow_models_tpu.telemetry import registry as reglib
 from distributed_tensorflow_models_tpu.telemetry import slo as slolib
 from distributed_tensorflow_models_tpu.telemetry import timeseries as tslib
@@ -150,13 +151,46 @@ class LMServer:
         slo_breach_after: int = 3,
         timeseries_interval_s: float = 0.0,
         timeseries_max_rows: int = tslib.DEFAULT_MAX_ROWS,
+        role: str = "monolithic",
+        handoff_dir: Optional[str] = None,
+        ship_chunk_bytes: int = 1 << 20,
     ):
+        # Disaggregated serving (serving/shipping.py): a "prefill"
+        # server runs admission + the prefill program and publishes
+        # each unfinished request's KV pages as a handoff bundle; a
+        # "decode" server takes intake via :meth:`submit_shipped`,
+        # adopts the pages, and streams the tokens.
+        if role not in ("monolithic", "prefill", "decode"):
+            raise ValueError(
+                f"role must be monolithic|prefill|decode, got {role!r}"
+            )
+        if role == "prefill" and not handoff_dir:
+            raise ValueError("role='prefill' needs a handoff_dir")
+        self.role = role
+        self.handoff_dir = handoff_dir
+        self.ship_chunk_bytes = int(ship_chunk_bytes)
+        self._engine = None  # set by the worker; stats() reads pins
+        self._fsck_errors: Optional[list] = None  # set at drain
         self._engine_factory = engine_factory
         self._max_prefill_tokens = max_prefill_tokens
         self.drain_grace_s = float(drain_grace_s)
         self.registry = (
             registry if registry is not None else reglib.MetricsRegistry()
         )
+        if role != "monolithic":
+            # Pre-create the disagg metric family so even an idle
+            # prefill/decode replica reports the FULL serve/ship_* +
+            # fleet split set (zeros, not absences) — the
+            # full-set-when-disagg / absent-when-monolithic schema
+            # contract, mirroring serve/spec_*.
+            for name in (
+                reglib.SERVE_SHIP_REQUESTS, reglib.SERVE_SHIP_BYTES,
+                reglib.SERVE_SHIP_PAGES,
+                reglib.SERVE_FLEET_PREFIX_HITS,
+                reglib.SERVE_FLEET_PREFIX_MISSES,
+            ):
+                self.registry.counter(name)
+            self.registry.timer(reglib.SERVE_SHIP)
         self._listener = listener
         self.workdir = workdir
         self.process_index = (
@@ -255,6 +289,12 @@ class LMServer:
         or a ``seed``, from which the worker derives the conventional
         per-request key ``fold_in(key(seed), request_id)``.
         """
+        if self.role == "decode":
+            raise ValueError(
+                "a decode-role server takes intake only via "
+                "submit_shipped (raw prompts belong on a prefill or "
+                "monolithic replica)"
+            )
         if self.draining:
             raise ServerDraining("server is draining; not accepting work")
         if self._thread is None:
@@ -276,6 +316,25 @@ class LMServer:
                 },
             )
         )
+        return handle
+
+    def submit_shipped(self, meta: dict, leaves: dict) -> ServeHandle:
+        """Decode-role intake: enqueue one claimed handoff bundle
+        (already unpacked — ``meta``/``leaves`` straight from
+        :func:`~.shipping.claim_bundle`).  The worker rebases the
+        travelled stamps into this process's clock and adopts the KV
+        pages through ``engine.admit_shipped``; the handle resolves
+        with the full token stream, first token included."""
+        if self.role != "decode":
+            raise ValueError(
+                "submit_shipped is decode-role intake only"
+            )
+        if self.draining:
+            raise ServerDraining("server is draining; not accepting work")
+        if self._thread is None:
+            raise RuntimeError("server not started")
+        handle = ServeHandle(int(meta["request_id"]))
+        self._queue.put((handle, {"shipped": (dict(meta), leaves)}))
         return handle
 
     # -- reporting ---------------------------------------------------------
@@ -308,6 +367,18 @@ class LMServer:
             reglib.SERVE_SLOT_OCCUPANCY,
         ):
             self.registry.timer(name)
+        # Compiled-program pins, on EVERY report regardless of role:
+        # a monolithic replica shows (1, N), a prefill replica must
+        # show (1, 0) and a decode replica (0, 1) — the drill asserts
+        # the role split added no compiled programs.
+        engine = self._engine
+        counts = engine.compile_counts() if engine is not None else (0, 0)
+        self.registry.gauge(reglib.SERVE_COMPILED_PREFILL).set(
+            float(counts[0])
+        )
+        self.registry.gauge(reglib.SERVE_COMPILED_DECODE).set(
+            float(counts[1])
+        )
         snap = self.registry.snapshot()
         # Cache effectiveness, computed (not stored): block-granular
         # hit fraction of all matchable pages seen; 0.0 when cold/off.
@@ -318,12 +389,18 @@ class LMServer:
         snap[reglib.SERVE_PREFIX_CACHE_HIT_RATE] = (
             hits / (hits + misses) if hits + misses > 0 else 0.0
         )
-        return {
+        out = {
             "version": 1,
             "process_index": self.process_index,
+            "role": self.role,
             "draining": self.draining,
             "metrics": snap,
         }
+        if self._fsck_errors is not None:
+            # Arena audit at drain (both ends of every ship ran it):
+            # refcount/eviction correctness under concurrent shipping.
+            out["fsck_errors"] = self._fsck_errors
+        return out
 
     def write_stats(self, path: str) -> None:
         tmp = f"{path}.{os.getpid()}.tmp"
@@ -343,11 +420,53 @@ class LMServer:
 
     def _admit(self, sched, pending, handle, spec) -> None:
         try:
-            import jax  # worker thread only — the front half stays jax-free
-
             from distributed_tensorflow_models_tpu.serving.scheduler import (
                 Request,
             )
+
+            if "shipped" in spec:
+                # A claimed handoff bundle: no rng rebuild (the key
+                # schedule travelled as wire data), stamps rebased from
+                # the prefill replica's wall clock into this process's
+                # monotonic frame HERE — the scheduler stays inside
+                # dtm-lint's determinism scope, this module does not.
+                meta, leaves = spec["shipped"]
+                pages = dict(leaves)
+                keydata = pages.pop("__keydata__")
+                self.registry.counter(reglib.SERVE_SHIP_REQUESTS).inc()
+                self.registry.counter(reglib.SERVE_SHIP_BYTES).inc(
+                    int(meta.get("wire_bytes", 0))
+                )
+                if pages:
+                    self.registry.counter(reglib.SERVE_SHIP_PAGES).inc(
+                        next(iter(pages.values())).shape[0]
+                    )
+                sched.submit_shipped(
+                    Request(
+                        request_id=int(meta["request_id"]),
+                        prompt=meta["prompt"],
+                        max_new_tokens=int(meta["max_new_tokens"]),
+                        temperature=float(meta["temperature"]),
+                        top_k=int(meta["top_k"]),
+                        top_p=float(meta["top_p"]),
+                        eos_id=meta["eos_id"],
+                    ),
+                    pages=pages,
+                    keydata=keydata,
+                    first_token=int(meta["first_token"]),
+                    t_submit=shiplib.mono_of_wall(
+                        float(meta["t_submit_wall"])
+                    ),
+                    queue_s=float(meta["queue_s"]),
+                    prefill_s=float(meta["prefill_s"]),
+                    cached_len=int(meta.get("cached_len", 0)),
+                    wire_bytes=int(meta.get("wire_bytes", 0)),
+                    src_replica=int(meta.get("src_replica", -1)),
+                )
+                pending[handle.request_id] = handle
+                return
+
+            import jax  # worker thread only — the front half stays jax-free
 
             rng = spec["rng"]
             if rng is None and spec["temperature"] > 0:
@@ -379,6 +498,57 @@ class LMServer:
                 return
             self._admit(sched, pending, handle, spec)
 
+    def _make_ship_callback(self, engine):
+        """The prefill scheduler's ship hook: export the slot's prompt
+        KV, pack it with everything decode needs (sampling knobs, key
+        schedule, first token, travel-safe wall stamps), and publish it
+        into the handoff directory.  Runs on the worker thread while
+        the slot is still allocated."""
+
+        def ship_out(inflight, first_token, t_wave, now):
+            import numpy as np  # worker thread only
+
+            t0 = time.perf_counter()
+            req = inflight.req
+            plen, pages = engine.export_slot(inflight.slot)
+            meta = {
+                "kind": "request",
+                "request_id": int(req.request_id),
+                "prompt": [int(t) for t in req.prompt],
+                "max_new_tokens": int(req.max_new_tokens),
+                "temperature": float(req.temperature),
+                "top_k": int(req.top_k),
+                "top_p": float(req.top_p),
+                "eos_id": (
+                    int(req.eos_id) if req.eos_id is not None else None
+                ),
+                "first_token": int(first_token),
+                "prompt_len": int(plen),
+                "cached_len": int(inflight.cached_len),
+                "queue_s": t_wave - inflight.t_submit,
+                "prefill_s": now - t_wave,
+                "t_submit_wall": shiplib.wall_of_mono(inflight.t_submit),
+                "src_replica": self.process_index,
+            }
+            leaves = dict(pages)
+            leaves["__keydata__"] = np.asarray(inflight.keydata)
+            data = shiplib.pack_bundle(meta, leaves)
+            shiplib.publish_bundle(
+                self.handoff_dir, req.request_id, data,
+                chunk_bytes=self.ship_chunk_bytes,
+            )
+            n_pages = (
+                next(iter(pages.values())).shape[0] if pages else 0
+            )
+            self.registry.timer(reglib.SERVE_SHIP).record(
+                time.perf_counter() - t0
+            )
+            self.registry.counter(reglib.SERVE_SHIP_REQUESTS).inc()
+            self.registry.counter(reglib.SERVE_SHIP_BYTES).inc(len(data))
+            self.registry.counter(reglib.SERVE_SHIP_PAGES).inc(n_pages)
+
+        return ship_out
+
     def _run(self) -> None:
         try:
             engine = self._engine_factory()
@@ -397,11 +567,17 @@ class LMServer:
                 ContinuousBatchingScheduler,
             )
 
+            self._engine = engine
             sched = ContinuousBatchingScheduler(
                 engine,
                 max_prefill_tokens=self._max_prefill_tokens,
                 registry=self.registry,
                 slo_monitor=self._slo,
+                role=self.role,
+                ship=(
+                    self._make_ship_callback(engine)
+                    if self.role == "prefill" else None
+                ),
             )
         except BaseException as e:  # noqa: BLE001 — surface via drain()
             self._fatal = e
@@ -462,6 +638,14 @@ class LMServer:
             for handle in pending.values():
                 handle._fail(err)
             self._fail_queue(err)
+        try:
+            # Arena audit on the way out: every refcount/eviction
+            # invariant must hold on BOTH ends of every ship — the
+            # stats artifact carries the verdict for the drill.
+            self._fsck_errors = engine.fsck()
+        except Exception:  # noqa: BLE001 — forensics must not crash drain
+            log.exception("arena fsck failed at drain")
+            self._fsck_errors = ["fsck raised; see log"]
         self._finalize(
             "serve_drain_timeout" if timed_out else "serve_drain"
         )
@@ -502,10 +686,12 @@ class LMServer:
 # the drill asserts no response is missing or duplicated.
 
 
-def _drill_engine_factory(args):
+def _drill_engine_factory(args, role: str = "monolithic"):
     """Tiny deterministic LM (params from seed 0 — replicas identical)."""
 
     def build():
+        import math
+
         import jax
         import jax.numpy as jnp
 
@@ -514,14 +700,27 @@ def _drill_engine_factory(args):
             InferenceEngine,
         )
 
+        max_len = getattr(args, "max_len", 64)
         model = get_model(
             "transformer_lm", vocab_size=64, num_layers=2, num_heads=2,
-            d_model=32, d_ff=64, max_len=64, dropout_rate=0.0,
+            d_model=32, d_ff=64, max_len=max_len, dropout_rate=0.0,
             dtype=jnp.float32, attn_impl="reference",
         )
         params = model.init(
             jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
         )["params"]
+        fleet = None
+        if getattr(args, "fleet_cache_dir", None) and role == "prefill":
+            # Same page-size resolution the engine ctor applies — the
+            # index's chain digests are page-granular, so every prefill
+            # replica must agree on the page size.
+            page = args.kv_page_tokens or math.gcd(
+                max_len, args.prefill_chunk
+            )
+            fleet = shiplib.FleetPrefixIndex(
+                args.fleet_cache_dir, page,
+                max_entries=args.fleet_cache_entries,
+            )
         engine = InferenceEngine(
             model, params, max_slots=args.max_slots,
             prefill_chunk=args.prefill_chunk,
@@ -534,6 +733,7 @@ def _drill_engine_factory(args):
             spec_tokens=args.spec_tokens,
             spec_ngram_order=args.spec_ngram_order,
             spec_min_match=args.spec_min_match,
+            fleet_cache=fleet,
         )
         stall_ms = getattr(args, "stall_prefill_ms", 0.0)
         if stall_ms:
@@ -591,6 +791,22 @@ def _write_response(resp_dir: str, rid: int, payload: dict) -> None:
 
 def _replica_main(args) -> int:
     replica = int(os.environ.get("DTM_PROCESS_ID", "0"))
+    role_map = [
+        r.strip() for r in args.role_map.split(",") if r.strip()
+    ] if args.role_map else []
+    for r in role_map:
+        if r not in ("monolithic", "prefill", "decode"):
+            raise SystemExit(f"bad --role-map entry {r!r}")
+    role = role_map[replica] if replica < len(role_map) else "monolithic"
+    n_prefill = role_map.count("prefill")
+    if args.fleet_cache_dir and "prefill" not in role_map:
+        raise SystemExit(
+            "--fleet-cache-dir needs a disaggregated --role-map with "
+            "at least one prefill replica"
+        )
+    handoff_dir = args.handoff_dir or os.path.join(
+        args.queue_dir, "handoff"
+    )
     claimed_dir = os.path.join(args.queue_dir, "claimed")
     resp_dir = os.path.join(args.queue_dir, "resp")
     os.makedirs(claimed_dir, exist_ok=True)
@@ -598,7 +814,7 @@ def _replica_main(args) -> int:
     listener = PreemptionListener(signals=(signal.SIGTERM,))
     listener.install()
     server = LMServer(
-        _drill_engine_factory(args),
+        _drill_engine_factory(args, role),
         max_prefill_tokens=args.max_prefill_tokens,
         drain_grace_s=args.drain_grace_s,
         listener=listener,
@@ -609,15 +825,19 @@ def _replica_main(args) -> int:
         slo_warmup_samples=args.slo_warmup,
         slo_breach_after=args.slo_breach_after,
         timeseries_interval_s=args.timeseries_interval_s,
+        role=role,
+        handoff_dir=handoff_dir if role == "prefill" else None,
+        ship_chunk_bytes=args.ship_chunk_bytes,
     )
     server.start()
     outstanding: dict = {}  # request_id -> (handle, request name)
     responded = 0
+    handled = 0  # responded + shipped — the drill victim's trigger
     sigterm_sent = False
     deadline = time.perf_counter() + args.timeout
 
     def resolve_finished(block: bool) -> int:
-        nonlocal responded
+        nonlocal responded, handled
         n = 0
         for rid in list(outstanding):
             handle, name = outstanding[rid]
@@ -631,6 +851,14 @@ def _replica_main(args) -> int:
                 log.error("request %d failed: %s", rid, e)  # missing resp
                 del outstanding[rid]
                 continue
+            if comp.finish_reason == "shipped":
+                # The handoff bundle IS the answer: a decode replica
+                # claims it and writes the response.  Writing one here
+                # too would be the duplicate the drill hunts for.
+                del outstanding[rid]
+                handled += 1
+                n += 1
+                continue
             _write_response(
                 resp_dir, rid,
                 {
@@ -643,6 +871,7 @@ def _replica_main(args) -> int:
             )
             del outstanding[rid]
             responded += 1
+            handled += 1
             n += 1
         return n
 
@@ -656,44 +885,77 @@ def _replica_main(args) -> int:
         # replica could be serving — and everything hoarded becomes
         # drain debt when this replica is SIGTERM'd.
         can_claim = len(outstanding) < 2 * args.max_slots
-        got = (
-            _claim_one(args.queue_dir, claimed_dir, replica)
-            if can_claim else None
-        )
-        if got is not None:
-            name, spec = got
-            try:
-                handle = server.submit(
-                    spec["prompt"], spec["max_new_tokens"],
-                    temperature=spec.get("temperature", 0.0),
-                    top_k=spec.get("top_k", 0),
-                    top_p=spec.get("top_p", 1.0),
-                    eos_id=spec.get("eos_id"),
-                    seed=spec.get("seed"),
-                    request_id=spec["request_id"],
-                )
-                outstanding[spec["request_id"]] = (handle, name)
-            except ServerDraining:
-                # SIGTERM won the race between claim and submit: hand
-                # the request back for the surviving replica.
-                _unclaim(args.queue_dir, claimed_dir, name, replica)
-                exit_reason = "drain_race"
-                break
+        if role == "decode":
+            # A decode replica's intake is the handoff directory: claim
+            # a bundle by atomic rename (exactly-once across peers),
+            # adopt its pages, stream the tokens.
+            got = (
+                shiplib.claim_bundle(handoff_dir, replica)
+                if can_claim else None
+            )
+            if got is not None:
+                name, meta, leaves = got
+                try:
+                    meta["wire_bytes"] = os.path.getsize(os.path.join(
+                        handoff_dir, shiplib.CLAIMED_DIR,
+                        f"{name}.p{replica}",
+                    ))
+                except OSError:
+                    meta["wire_bytes"] = 0
+                try:
+                    handle = server.submit_shipped(meta, leaves)
+                    outstanding[meta["request_id"]] = (handle, name)
+                except ServerDraining:
+                    # SIGTERM won the race between claim and adopt:
+                    # hand the bundle back for a surviving decoder.
+                    shiplib.unclaim_bundle(handoff_dir, name, replica)
+                    exit_reason = "drain_race"
+                    break
+        else:
+            got = (
+                _claim_one(args.queue_dir, claimed_dir, replica)
+                if can_claim else None
+            )
+            if got is not None:
+                name, spec = got
+                try:
+                    handle = server.submit(
+                        spec["prompt"], spec["max_new_tokens"],
+                        temperature=spec.get("temperature", 0.0),
+                        top_k=spec.get("top_k", 0),
+                        top_p=spec.get("top_p", 1.0),
+                        eos_id=spec.get("eos_id"),
+                        seed=spec.get("seed"),
+                        request_id=spec["request_id"],
+                    )
+                    outstanding[spec["request_id"]] = (handle, name)
+                except ServerDraining:
+                    # SIGTERM won the race between claim and submit: hand
+                    # the request back for the surviving replica.
+                    _unclaim(args.queue_dir, claimed_dir, name, replica)
+                    exit_reason = "drain_race"
+                    break
         resolve_finished(block=False)
         if (
             args.self_sigterm_after
             and replica == args.sigterm_replica
-            and responded >= args.self_sigterm_after
+            and handled >= args.self_sigterm_after
             and not sigterm_sent
         ):
             sigterm_sent = True
             log.warning(
-                "replica %d self-delivering SIGTERM after %d responses "
-                "(drill victim)", replica, responded,
+                "replica %d self-delivering SIGTERM after %d handled "
+                "(drill victim)", replica, handled,
             )
             os.kill(os.getpid(), signal.SIGTERM)
         if got is None:
             done = os.path.exists(os.path.join(args.queue_dir, "DONE"))
+            if role == "decode":
+                # "handoff dir empty" only means "no bundles EVER
+                # again" once every prefill replica marked done.
+                done = done and shiplib.prefill_done_count(
+                    handoff_dir
+                ) >= n_prefill
             if done and not outstanding and can_claim:
                 # Only exit on a GENUINE empty claim attempt.  When
                 # backpressure suppressed this iteration's claim, a
@@ -703,14 +965,20 @@ def _replica_main(args) -> int:
                 exit_reason = "queue_drained"
                 break
             listener.wait(args.poll_s)
-    # Drain: everything this replica claimed must be answered before it
-    # exits — the drill's no-dropped-responses assertion.
-    resolve_finished(block=True)
-    server.drain()
+    # Drain: everything this replica claimed must be answered (or
+    # shipped) before it exits — the drill's no-dropped-responses
+    # assertion.  A prefill replica marks its no-more-bundles sentinel
+    # on EVERY exit path, else decode replicas could wait forever.
+    try:
+        resolve_finished(block=True)
+        server.drain()
+    finally:
+        if role == "prefill":
+            shiplib.mark_prefill_done(handoff_dir, replica)
     listener.uninstall()
     log.info(
-        "replica %d exiting (%s): %d responses", replica, exit_reason,
-        responded,
+        "replica %d (%s) exiting (%s): %d responses, %d handled",
+        replica, role, exit_reason, responded, handled,
     )
     return 0
 
@@ -766,6 +1034,39 @@ def main(argv=None) -> int:
     p.add_argument(
         "--spec-min-match", type=int, default=1,
         help="shortest suffix match worth proposing a draft for",
+    )
+    p.add_argument(
+        "--role-map", default="",
+        help="comma list of replica roles indexed by DTM_PROCESS_ID, "
+        "e.g. 'prefill,decode' (empty = every replica monolithic); "
+        "prefill replicas ship finished prompts' KV pages through the "
+        "handoff dir, decode replicas adopt them and stream tokens",
+    )
+    p.add_argument(
+        "--handoff-dir", default=None,
+        help="KV handoff bundle directory (default: "
+        "<queue-dir>/handoff)",
+    )
+    p.add_argument(
+        "--fleet-cache-dir", default=None,
+        help="fleet-wide prefix index directory: prefill replicas "
+        "advertise resident prompt pages here so any replica's hit "
+        "serves the whole fleet (default: off; needs a disaggregated "
+        "--role-map)",
+    )
+    p.add_argument(
+        "--fleet-cache-entries", type=int, default=None,
+        help="bound on fleet index entries, evicted mtime-LRU "
+        "(default: unbounded)",
+    )
+    p.add_argument(
+        "--ship-chunk-bytes", type=int, default=1 << 20,
+        help="bundle write syscall granularity — payload streams out "
+        "in chunks of this many bytes",
+    )
+    p.add_argument(
+        "--max-len", type=int, default=64,
+        help="drill model context length (must hold prompt + max_new)",
     )
     p.add_argument("--max-prefill-tokens", type=int, default=None)
     p.add_argument("--drain-grace-s", type=float, default=30.0)

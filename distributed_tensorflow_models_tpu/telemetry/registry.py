@@ -189,6 +189,35 @@ SERVE_SPEC_TOKENS_PER_DISPATCH = "serve/spec_tokens_per_dispatch"  # timer
 SERVE_COMPLETED = "serve/completed"  # counter
 SERVE_SLO_BREACH = "serve/slo_breach"  # counter family: /<slo name>
 SERVE_SLO_MARGIN = "serve/slo_margin"  # gauge family: /<slo name>
+# Disaggregated prefill/decode serving (ISSUE 17; --role-map splits the
+# file-queue fleet into prefill and decode replicas).  The serve/ship*
+# and serve/fleet_prefix* keys exist ONLY on a disaggregated replica
+# (full-set-or-absent, mirroring the spec_* contract — a monolithic
+# registry stays byte-for-byte the PR 16 registry; enforced by
+# check_metrics_schema --serving-report).  SHIP is the handoff leg's
+# timer + waterfall span: on a prefill replica it prices export +
+# serialize + publish of one bundle, on a decode replica the full
+# prefill-done → first-token-emitted gap (handoff-dir dwell + parse +
+# scatter-adopt), which is exactly the queue+prefill+ship−TTFT
+# attribution residue serving_report audits.  SHIP_BYTES / SHIP_PAGES
+# count wire payload (prefill: shipped out; decode: adopted in).
+# FLEET_PREFIX_* split the prefix-cache story across the fleet: pages a
+# prefill replica adopted from the shared fleet index instead of
+# re-prefilling (hits) vs matchable pages no replica had (misses) —
+# block-granular like the local serve/prefix_cache_* pair.
+SERVE_SHIP = "serve/ship"  # timer + span (disagg only)
+SERVE_SHIP_REQUESTS = "serve/ship_requests"  # counter (disagg only)
+SERVE_SHIP_BYTES = "serve/ship_bytes"  # counter (disagg only)
+SERVE_SHIP_PAGES = "serve/ship_pages"  # counter (disagg only)
+SERVE_FLEET_PREFIX_HITS = "serve/fleet_prefix_hits"  # counter (blocks)
+SERVE_FLEET_PREFIX_MISSES = "serve/fleet_prefix_misses"  # counter
+# Compiled-program-count pins, observable from stats artifacts: every
+# serving report carries them (monolithic steady state (1, 1), or
+# (1, 2) spec-on; a prefill replica must report (1, 0) and a decode
+# replica (0, 1) — jit laziness IS the per-role pin, a role that never
+# calls the other program never compiles it).
+SERVE_COMPILED_PREFILL = "serve/compiled_prefill"  # gauge
+SERVE_COMPILED_DECODE = "serve/compiled_decode"  # gauge
 
 
 class Counter:

@@ -318,6 +318,24 @@ SERVING_SPEC_TIMERS = (
     "serve/spec_acceptance_rate", "serve/spec_tokens_per_dispatch",
 )
 SERVING_SPEC_P99 = SERVING_SPEC_TIMERS
+# Disaggregated-serving keys: the server pre-creates the WHOLE family
+# when it runs as a prefill or decode replica and none of it when
+# monolithic, so — like speculation — the contract is
+# full-set-or-absent, keyed off the report's ``role`` field when it
+# carries one (reports from this version always do) and off any
+# serve/ship_* key otherwise.
+SERVING_SHIP_COUNTERS = (
+    "serve/ship_requests", "serve/ship_bytes", "serve/ship_pages",
+    "serve/fleet_prefix_hits", "serve/fleet_prefix_misses",
+)
+SERVING_SHIP_TIMERS = ("serve/ship",)
+SERVING_SHIP_P99 = SERVING_SHIP_TIMERS
+SERVING_ROLES = ("monolithic", "prefill", "decode")
+# Compiled-program pins: stats() publishes the engine's compile-cache
+# sizes for EVERY role (the disagg acceptance gate — a prefill replica
+# must pin (n, 0), a decode replica (0, n)), so both gauges are part of
+# the unconditional full set.
+SERVING_COMPILED_GAUGES = ("serve/compiled_prefill", "serve/compiled_decode")
 
 
 def check_serving_report(report) -> list[str]:
@@ -367,6 +385,38 @@ def check_serving_report(report) -> list[str]:
     for key in SERVING_P99:
         if f"{key}/p99_s" not in snap:
             errors.append(f"serving p99 expansion {key!r}/p99_s missing")
+    for key in SERVING_COMPILED_GAUGES:
+        if key not in snap:
+            errors.append(f"compiled-program gauge {key!r} missing")
+    # Disaggregation section: role field (when present) must be valid,
+    # and the ship/fleet family is full-set on a disagg replica, fully
+    # absent on a monolithic one.
+    role = report.get("role")
+    if role is not None and role not in SERVING_ROLES:
+        errors.append(f"'role' must be one of {list(SERVING_ROLES)}, "
+                      f"got {role!r}")
+    has_ship = any(
+        k.startswith(("serve/ship", "serve/fleet_prefix_")) for k in snap
+    )
+    disagg = role in ("prefill", "decode") if role is not None else has_ship
+    if disagg:
+        for key in SERVING_SHIP_COUNTERS:
+            if key not in snap:
+                errors.append(f"ship counter {key!r} missing")
+        for key in SERVING_SHIP_TIMERS:
+            if f"{key}/count" not in snap:
+                errors.append(f"ship timer {key!r} missing (no /count)")
+        for key in SERVING_SHIP_P99:
+            if f"{key}/p99_s" not in snap:
+                errors.append(f"ship p99 expansion {key!r}/p99_s missing")
+    elif has_ship:
+        leaked = sorted(
+            k for k in snap
+            if k.startswith(("serve/ship", "serve/fleet_prefix_"))
+        )
+        errors.append(
+            f"monolithic report leaks disaggregation keys: {leaked}"
+        )
     # Speculation section: any serve/spec_* key present implies the
     # whole set (counters, timers, p99 expansions); values already
     # passed the non-negativity sweep above via the serve/ prefix.
@@ -812,10 +862,14 @@ def main(argv=None) -> int:
                 print(f"{args.path}: {e}", file=sys.stderr)
             return 1
         m = report["metrics"]
+        role = report.get("role", "monolithic")
         print(
-            f"{args.path}: OK ({int(m['serve/requests'])} requests, "
+            f"{args.path}: OK (role {role}, "
+            f"{int(m['serve/requests'])} requests, "
             f"{int(m['serve/tokens'])} tokens, "
-            f"ttft p99 {m['serve/ttft_s/p99_s']:.4f}s; "
+            f"ttft p99 {m['serve/ttft_s/p99_s']:.4f}s, "
+            f"compiled {int(m.get('serve/compiled_prefill', 0))}p/"
+            f"{int(m.get('serve/compiled_decode', 0))}d; "
             f"{speculation_summary(m)})"
         )
         return 0

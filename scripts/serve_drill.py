@@ -30,6 +30,24 @@ A second arm repeats the drill with speculative decoding on
 stream must be byte-equal to the spec-off arm's — speculation is a
 throughput knob, never a token knob, even under drain and failover.
 
+Disaggregated arms (``--no-disagg`` skips) certify the prefill/decode
+role split end to end under OPEN-LOOP paced arrivals (the
+``serving.replay`` module's seeded trace, emitted by a parent thread
+while the fleet runs):
+
+- **D1** — 1 prefill + 1 decode, clean: every stream byte-identical to
+  a monolithic fleet serving the SAME trace, ship spans present in
+  every attributed waterfall with queue + prefill + ship ≡ TTFT, roles
+  labelled in the report, per-role compiled-program pins (prefill
+  compiles no decode program and vice versa);
+- **D2** — 2 prefill + 1 decode, prefill-role victim (self-SIGTERM
+  mid-traffic) with the fleet-wide prefix cache on and duplicate
+  prompts re-arriving later: drain-to-zero on the prefill role, fleet
+  cache hits observed, greedy duplicates byte-identical;
+- **D3** — 1 prefill + 2 decode, decode-role victim: claim/unclaim
+  drain correctness on the decode role, zero dropped or duplicated
+  responses, streams byte-identical to D1's.
+
 The parent process never imports jax (safe on a login host); all device
 work happens in the spawned replicas.  Exit 0 when every check passes.
 
@@ -47,12 +65,15 @@ import shutil
 import subprocess
 import sys
 import tempfile
+import threading
+import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:  # runnable as a script from anywhere
     sys.path.insert(0, _REPO)
 
 from distributed_tensorflow_models_tpu import launch  # noqa: E402
+from distributed_tensorflow_models_tpu.serving import replay as replaylib  # noqa: E402
 
 PORT = 9871
 SIGTERM_AFTER = 3  # victim self-SIGTERMs after this many responses
@@ -379,6 +400,371 @@ def check_slo_arm(workdir: str, *, expect_breach: bool) -> list[str]:
     return errors
 
 
+# -- disaggregated arms ----------------------------------------------------
+# The victim threshold counts HANDLED requests (responded + shipped), so
+# a prefill victim's SIGTERM is as deterministic-ish as the monolithic
+# one's.  The ring is sized to hold every request's spans: the report
+# check below demands a ship span in EVERY attributed waterfall, and an
+# evicted event would read as a missing span.
+DISAGG_RING = 8192
+
+
+def _disagg_trace(n: int) -> list:
+    """D1/D3 trace: the interference mix (every 3rd request
+    prefill-heavy), every 5th request on a seeded sampling mode, paced
+    by seeded exponential inter-arrival gaps."""
+    reqs = replaylib.mixed_mix(n, seed=17, sample_every=5)
+    return replaylib.assign_arrivals(reqs, seed=170, mean_gap_s=0.05)
+
+
+def _fleet_trace(n_pairs: int) -> list[list]:
+    """D2 trace, two phases: shared-prefix prompts with page-aligned
+    unique tails (shared 8 = one page, tail 9 so a second FULL page per
+    prompt is matchable and advertised), then byte-identical duplicates
+    under fresh request_ids.  The pacer gates phase 2 on phase 1's
+    responses (compile time is seconds on a cold replica, so a fixed
+    delay races the advertises), guaranteeing every original's tail
+    page is advertised in the fleet index before its duplicate arrives;
+    a duplicate claimed by a replica that did not prefill its original
+    must then pull the tail page from the fleet, not its local trie."""
+    first = replaylib.assign_arrivals(
+        replaylib.shared_prefix_mix(
+            n_pairs, seed=21, shared_len=8, tail_len=9, new_tokens=4
+        ),
+        seed=210, mean_gap_s=0.08,
+    )
+    dup = replaylib.assign_arrivals(
+        replaylib.shared_prefix_mix(
+            n_pairs, seed=21, shared_len=8, tail_len=9, new_tokens=4,
+            first_id=n_pairs,
+        ),
+        seed=211, mean_gap_s=0.08,
+    )
+    return [first, dup]
+
+
+def _pace(queue_dir: str, phases: list[list]) -> None:
+    """Parent-thread replayer: emit each phase open-loop while
+    launch_local blocks on the fleet, waiting for the previous phase's
+    responses between phases, then publish DONE."""
+    resp_dir = os.path.join(queue_dir, "resp")
+    for i, phase in enumerate(phases):
+        if i:
+            want = {r.request_id for r in phases[i - 1]}
+            deadline = time.perf_counter() + 120.0
+            while time.perf_counter() < deadline:
+                have = {
+                    int(n.split("-")[1].split(".")[0])
+                    for n in os.listdir(resp_dir)
+                    if n.endswith(".json")
+                } if os.path.isdir(resp_dir) else set()
+                if want <= have:
+                    break
+                time.sleep(0.05)
+        replaylib.replay(
+            phase, lambda r: replaylib.write_request(queue_dir, r)
+        )
+    done = os.path.join(queue_dir, "DONE")
+    with open(done + ".tmp", "w") as f:
+        f.write("done\n")
+    os.replace(done + ".tmp", done)
+
+
+def run_disagg_drill(
+    scratch: str, reqs: list, *, role_map: str = "", port: int,
+    victim: int | None = None, sigterm_after: int = SIGTERM_AFTER,
+    fleet_cache: bool = False, phases: list[list] | None = None,
+) -> tuple[list[str], dict[int, dict]]:
+    """One paced fleet run.  ``role_map`` "" means a 2-replica
+    monolithic fleet (the byte-identity reference for the same trace);
+    otherwise one replica per role entry.  ``phases`` overrides the
+    single-phase pacing (see :func:`_pace`).  Returns (errors,
+    responses-by-request-id)."""
+    errors: list[str] = []
+    disagg = bool(role_map)
+    roles = role_map.split(",") if disagg else ["monolithic"] * 2
+    queue_dir = os.path.join(scratch, "queue")
+    workdir = os.path.join(scratch, "wd")
+    os.makedirs(queue_dir, exist_ok=True)
+    os.makedirs(workdir, exist_ok=True)
+    specs = {r.request_id: r.spec() for r in reqs}
+
+    pacer = threading.Thread(
+        target=_pace, args=(queue_dir, phases or [list(reqs)]),
+        daemon=True,
+    )
+    pacer.start()
+    argv = [
+        sys.executable, "-m",
+        "distributed_tensorflow_models_tpu.serving.server",
+        "--queue-dir", queue_dir, "--workdir", workdir,
+        "--max-slots", "4", "--prefill-chunk", "8",
+        "--drain-grace-s", "60",
+        "--trace-ring-events", str(DISAGG_RING),
+        "--self-sigterm-after",
+        str(sigterm_after if victim is not None else 0),
+        "--sigterm-replica", str(victim if victim is not None else 0),
+        "--timeout", "240",
+    ]
+    if disagg:
+        argv += ["--role-map", role_map]
+    if fleet_cache:
+        argv += ["--fleet-cache-dir", os.path.join(scratch, "fleet")]
+    try:
+        codes = launch.launch_local(
+            len(roles), argv, port=port, timeout=420.0,
+            extra_env={
+                "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+                "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
+                    "PYTHONPATH", ""
+                ),
+            },
+        )
+    finally:
+        pacer.join(timeout=60)
+    if pacer.is_alive():
+        errors.append("replayer thread still pacing after fleet exit")
+    if launch.aggregate_exit_codes(codes) != 0:
+        errors.append(
+            f"fleet exit codes {codes} (victim must DRAIN to 0)"
+        )
+
+    # -- request queue: exactly-once ---------------------------------------
+    claimed_dir = os.path.join(queue_dir, "claimed")
+    req_claims: dict[int, list[str]] = {}
+    claims_by_replica: dict[int, int] = {}
+    for name in (
+        os.listdir(claimed_dir) if os.path.isdir(claimed_dir) else []
+    ):
+        rid = int(name.split("-")[1].split(".")[0])
+        req_claims.setdefault(rid, []).append(name)
+        rep = int(name.rsplit(".p", 1)[1])
+        claims_by_replica[rep] = claims_by_replica.get(rep, 0) + 1
+    for rid, names in sorted(req_claims.items()):
+        if len(names) > 1:
+            errors.append(f"request {rid} claimed twice: {names}")
+    unclaimed = [
+        n for n in os.listdir(queue_dir)
+        if n.startswith("req-") and n.endswith(".json")
+    ]
+    if unclaimed:
+        errors.append(f"requests never claimed: {sorted(unclaimed)}")
+    if disagg:
+        non_prefill = [
+            rep for rep in claims_by_replica
+            if roles[rep] != "prefill"
+        ]
+        if non_prefill:
+            errors.append(
+                f"non-prefill replicas claimed request files: "
+                f"{sorted(non_prefill)}"
+            )
+
+    # -- handoff dir: every request shipped exactly once -------------------
+    if disagg:
+        handoff = os.path.join(queue_dir, "handoff")
+        ship_claims: dict[int, list[str]] = {}
+        for name in (
+            os.listdir(os.path.join(handoff, "claimed"))
+            if os.path.isdir(os.path.join(handoff, "claimed")) else []
+        ):
+            rid = int(name.split("-")[1].split(".")[0])
+            ship_claims.setdefault(rid, []).append(name)
+        for rid, names in sorted(ship_claims.items()):
+            if len(names) > 1:
+                errors.append(f"bundle {rid} claimed twice: {names}")
+        if set(ship_claims) != set(specs):
+            errors.append(
+                "shipped-bundle set != request set: missing "
+                f"{sorted(set(specs) - set(ship_claims))}, extra "
+                f"{sorted(set(ship_claims) - set(specs))}"
+            )
+        leftovers = [
+            n for n in os.listdir(handoff) if n.endswith(".kvh")
+        ] if os.path.isdir(handoff) else []
+        if leftovers:
+            errors.append(f"unclaimed bundles left: {sorted(leftovers)}")
+        n_prefill = sum(1 for r in roles if r == "prefill")
+        n_done = sum(
+            1 for n in os.listdir(handoff)
+            if n.startswith("PREFILL_DONE.p")
+        ) if os.path.isdir(handoff) else 0
+        if n_done != n_prefill:
+            errors.append(
+                f"{n_done} PREFILL_DONE markers, expected {n_prefill}"
+            )
+
+    # -- responses: none dropped, none duplicated, decode-written ----------
+    resp_dir = os.path.join(queue_dir, "resp")
+    responses: dict[int, dict] = {}
+    for name in os.listdir(resp_dir) if os.path.isdir(resp_dir) else []:
+        if name.endswith(".json"):
+            with open(os.path.join(resp_dir, name)) as f:
+                responses[int(name.split("-")[1].split(".")[0])] = (
+                    json.load(f)
+                )
+    missing = sorted(set(specs) - set(responses))
+    extra = sorted(set(responses) - set(specs))
+    if missing:
+        errors.append(f"dropped responses (drain lost work): {missing}")
+    if extra:
+        errors.append(f"responses for unknown requests: {extra}")
+    by_replica: dict[int, int] = {}
+    for rid, resp in sorted(responses.items()):
+        want = specs[rid]["max_new_tokens"]
+        if len(resp["tokens"]) != want:
+            errors.append(
+                f"request {rid}: {len(resp['tokens'])} tokens, "
+                f"expected {want}"
+            )
+        by_replica[resp["replica"]] = by_replica.get(resp["replica"], 0) + 1
+        if disagg and roles[resp["replica"]] != "decode":
+            errors.append(
+                f"request {rid} answered by replica {resp['replica']} "
+                f"({roles[resp['replica']]}) — only decode replicas "
+                "stream multi-token responses in a disagg fleet"
+            )
+    print(f"  responses by replica: {by_replica}, "
+          f"request claims by replica: {claims_by_replica}")
+
+    # -- victim drained, survivor of the same role took over ---------------
+    if victim is not None:
+        vrole = roles[victim]
+        served = (
+            claims_by_replica.get(victim, 0) if vrole == "prefill"
+            else by_replica.get(victim, 0)
+        )
+        if served < sigterm_after:
+            errors.append(
+                f"{vrole} victim handled {served} < {sigterm_after} "
+                "requests — SIGTERM fired before real traffic"
+            )
+        survivors = sum(
+            (claims_by_replica if vrole == "prefill" else by_replica)
+            .get(i, 0)
+            for i, r in enumerate(roles) if r == vrole and i != victim
+        )
+        if survivors == 0:
+            errors.append(
+                f"no surviving {vrole} replica served anything — "
+                "no failover happened"
+            )
+
+    # -- forensics: schema, roles, per-role compile pins, fleet hits -------
+    fleet_hits = 0.0
+    for i, role in enumerate(roles):
+        record_path = os.path.join(workdir, f"flight_recorder_p{i}.json")
+        stats_path = os.path.join(workdir, f"serving_stats_p{i}.json")
+        for path, flag in (
+            (record_path, "--flight-recorder"),
+            (stats_path, "--serving-report"),
+        ):
+            if not os.path.exists(path):
+                errors.append(f"missing artifact {path}")
+                continue
+            _schema_check(path, flag, errors)
+        if not os.path.exists(stats_path):
+            continue
+        with open(stats_path) as f:
+            snap = json.load(f)
+        metrics = snap.get("metrics", {})
+        if disagg:
+            if snap.get("role") != role:
+                errors.append(
+                    f"p{i}: stats role {snap.get('role')!r}, expected "
+                    f"{role!r}"
+                )
+            want = (1.0, 0.0) if role == "prefill" else (0.0, 1.0)
+            got = (
+                metrics.get("serve/compiled_prefill"),
+                metrics.get("serve/compiled_decode"),
+            )
+            if got != want:
+                errors.append(
+                    f"p{i} ({role}): compiled (prefill, decode) "
+                    f"programs {got}, expected {want} — the role pin "
+                    "failed"
+                )
+            if role == "prefill":
+                fleet_hits += metrics.get("serve/fleet_prefix_hits", 0.0)
+        fsck = snap.get("fsck_errors")
+        if fsck:
+            errors.append(f"p{i} ({role}): fsck errors {fsck}")
+    if fleet_cache and fleet_hits < 1:
+        errors.append(
+            "fleet prefix cache never hit: duplicates re-prefilled "
+            "instead of adopting advertised pages"
+        )
+    return errors, responses
+
+
+def check_disagg_report(
+    workdir: str, roles: list[str], n_requests: int
+) -> list[str]:
+    """Role-aware report forensics: replicas labelled, every request's
+    decode-side waterfall attributed WITH a ship span, and
+    queue + prefill + ship summing to measured TTFT; the prefill-side
+    hand-off markers (finish_reason ``shipped``) counted, not
+    attributed."""
+    errors: list[str] = []
+    report_py = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "serving_report.py"
+    )
+    proc = subprocess.run(
+        [sys.executable, report_py, workdir, "--json"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        errors.append(f"disagg: serving_report failed: {proc.stderr}")
+        return errors
+    report = json.loads(proc.stdout)
+    want_roles = {str(i): role for i, role in enumerate(roles)}
+    if report.get("roles") != want_roles:
+        errors.append(
+            f"disagg: report roles {report.get('roles')}, expected "
+            f"{want_roles}"
+        )
+    att = report["attribution"]
+    if att["shipped_out"] != n_requests:
+        errors.append(
+            f"disagg: {att['shipped_out']} shipped hand-off markers, "
+            f"expected {n_requests}"
+        )
+    if att["attributed"] != n_requests:
+        errors.append(
+            f"disagg: {att['attributed']}/{n_requests} requests have an "
+            "attributed decode-side waterfall"
+        )
+    if att["sum_bad"]:
+        bad = [
+            w for w in report["waterfalls"]
+            if w["attributed"] and not w["sum_ok"]
+        ]
+        errors.append(
+            f"disagg: {att['sum_bad']} waterfall(s) do not sum "
+            "queue+prefill+ship to TTFT: " + ", ".join(
+                f"p{w['proc']}/r{w['rid']} "
+                f"err={w['attribution_err_s']:.4f}s"
+                for w in bad[:5]
+            )
+        )
+    no_ship = [
+        w for w in report["waterfalls"]
+        if w["attributed"] and w.get("ship_s") is None
+    ]
+    if no_ship:
+        errors.append(
+            "disagg: attributed waterfalls missing the ship span: "
+            + ", ".join(f"p{w['proc']}/r{w['rid']}" for w in no_ship[:5])
+        )
+    print(
+        f"  disagg report: roles {report.get('roles')}, "
+        f"{att['sum_ok']}/{att['attributed']} waterfalls sum to TTFT, "
+        f"{att['shipped_out']} shipped markers"
+    )
+    return errors
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=24)
@@ -403,6 +789,10 @@ def main(argv=None) -> int:
     p.add_argument(
         "--no-slo", action="store_true",
         help="skip the SLO observability arms (clean + injected stall)",
+    )
+    p.add_argument(
+        "--no-disagg", action="store_true",
+        help="skip the disaggregated prefill/decode arms (D1-D3)",
     )
     args = p.parse_args(argv)
 
@@ -499,6 +889,92 @@ def main(argv=None) -> int:
             errors += check_slo_arm(
                 os.path.join(stall_dir, "wd"), expect_breach=True
             )
+        if not args.no_disagg:
+            # D1: 1 prefill + 1 decode under the paced interference
+            # trace, vs a monolithic fleet on the SAME trace — every
+            # stream (greedy AND seeded sampling modes: the replica
+            # folds the key with request_id, so same-rid streams are
+            # comparable across topologies) must be byte-identical.
+            trace = _disagg_trace(args.requests)
+            print(
+                f"  disagg arm D1: 1 prefill + 1 decode, "
+                f"{len(trace)} paced requests"
+            )
+            d1_dir = os.path.join(scratch, "disagg")
+            d1_errors, d1_resp = run_disagg_drill(
+                d1_dir, trace, role_map="prefill,decode", port=PORT + 40,
+            )
+            errors += d1_errors
+            errors += check_disagg_report(
+                os.path.join(d1_dir, "wd"), ["prefill", "decode"],
+                len(trace),
+            )
+            print("  disagg reference: monolithic fleet, same trace")
+            ref_errors, ref_resp = run_disagg_drill(
+                os.path.join(scratch, "disagg-ref"), trace,
+                port=PORT + 44,
+            )
+            errors += ref_errors
+            for rid in sorted(set(d1_resp) & set(ref_resp)):
+                if d1_resp[rid]["tokens"] != ref_resp[rid]["tokens"]:
+                    errors.append(
+                        f"request {rid}: disagg stream diverged from "
+                        f"monolithic: {d1_resp[rid]['tokens']} vs "
+                        f"{ref_resp[rid]['tokens']}"
+                    )
+            # D2: prefill-role victim + fleet-wide prefix cache.  The
+            # victim is replica 0 — the replica that claims the
+            # originals — so the duplicates are served by the survivor
+            # off the victim's advertised pages.
+            fphases = _fleet_trace(8)
+            ftrace = [r for phase in fphases for r in phase]
+            print(
+                "  disagg arm D2: 2 prefill + 1 decode, prefill victim, "
+                f"fleet cache, {len(ftrace)} requests"
+            )
+            d2_dir = os.path.join(scratch, "disagg-fleet")
+            d2_errors, d2_resp = run_disagg_drill(
+                d2_dir, ftrace, role_map="prefill,prefill,decode",
+                port=PORT + 50, victim=0, fleet_cache=True,
+                phases=fphases,
+            )
+            errors += d2_errors
+            errors += check_disagg_report(
+                os.path.join(d2_dir, "wd"),
+                ["prefill", "prefill", "decode"], len(ftrace),
+            )
+            # Duplicate pairs are greedy and byte-identical specs:
+            # streams must match even when the duplicate's KV pages
+            # came off the fleet index instead of a local prefill.
+            for j in range(len(ftrace) // 2):
+                a, b = d2_resp.get(j), d2_resp.get(j + len(ftrace) // 2)
+                if a is not None and b is not None \
+                        and a["tokens"] != b["tokens"]:
+                    errors.append(
+                        f"fleet duplicate pair ({j}, "
+                        f"{j + len(ftrace) // 2}) diverged: "
+                        f"{a['tokens']} vs {b['tokens']}"
+                    )
+            # D3: decode-role victim on the D1 trace; streams must
+            # match D1's (and hence the monolithic reference's).
+            print("  disagg arm D3: 1 prefill + 2 decode, decode victim")
+            d3_dir = os.path.join(scratch, "disagg-dvic")
+            d3_errors, d3_resp = run_disagg_drill(
+                d3_dir, trace, role_map="prefill,decode,decode",
+                port=PORT + 60, victim=2,
+            )
+            errors += d3_errors
+            errors += check_disagg_report(
+                os.path.join(d3_dir, "wd"),
+                ["prefill", "decode", "decode"], len(trace),
+            )
+            for rid in sorted(set(d1_resp) & set(d3_resp)):
+                if d1_resp[rid]["tokens"] != d3_resp[rid]["tokens"]:
+                    errors.append(
+                        f"request {rid}: stream changed under decode "
+                        f"failover: {d3_resp[rid]['tokens']} vs "
+                        f"{d1_resp[rid]['tokens']}"
+                    )
         failed = bool(errors)
         if errors:
             print("DRILL serve: FAIL", file=sys.stderr)

@@ -9,10 +9,17 @@ it answers the three production questions:
 1. **Where did each request's latency go?**  Per-request waterfalls
    rebuilt from the scheduler's ``serve/req/*`` lifecycle events
    (grouped by ``args["rid"]``): queue-wait, prefill (with prefix-cache
-   hit/suffix attribution), decode dispatches, completion.  The
-   queue + prefill spans are emitted so they MUST sum to the measured
-   TTFT — the report checks every waterfall against the completion
-   instant's ``ttft_s`` and flags any that don't reconcile.
+   hit/suffix attribution), the KV-shipping leg on a disaggregated
+   fleet, decode dispatches, completion.  The queue + prefill (+ ship)
+   spans are emitted so they MUST sum to the measured TTFT — the
+   report checks every waterfall against the completion instant's
+   ``ttft_s`` and flags any that don't reconcile.  On a disaggregated
+   fleet the report is role-aware: each replica is labelled with its
+   ``role`` from the stats report, a shipped request's full waterfall
+   lives on the DECODE replica (the adopting scheduler backdates the
+   queue/prefill/ship spans from the shipped timestamps), and the
+   prefill side's ``reason="shipped"`` completion is reported as a
+   hand-off marker, never as a latency row.
 2. **Did we hold the SLOs?**  A verdict table per process per SLO from
    the stats report's ``serve/slo_breach/<name>`` counters and
    ``serve/slo_margin/<name>`` gauges, cross-referenced with breach /
@@ -46,6 +53,7 @@ import fleet_report  # noqa: E402
 # package on sys.path, so the literals are restated here).
 REQ_QUEUE = "serve/req/queue"
 REQ_PREFILL = "serve/req/prefill"
+REQ_SHIP = "serve/req/ship"
 REQ_DECODE = "serve/req/decode"
 REQ_SHED = "serve/req/shed"
 REQ_DONE = "serve/req/done"
@@ -123,6 +131,9 @@ def build_waterfalls(
                 "rid": rid,
                 "queue_s": None,
                 "prefill_s": None,
+                "ship_s": None,
+                "ship_bytes": None,
+                "ship_src": None,
                 "decode_s": 0.0,
                 "decode_dispatches": 0,
                 "t_first": None,
@@ -158,6 +169,10 @@ def build_waterfalls(
             w["cached"] = args.get("cached")
             w["suffix"] = args.get("suffix")
             w["prompt"] = args.get("prompt")
+        elif name == REQ_SHIP:
+            w["ship_s"] = e.get("dur_s") or 0.0
+            w["ship_bytes"] = args.get("bytes")
+            w["ship_src"] = args.get("src")
         elif name == REQ_DECODE:
             w["decode_s"] += e.get("dur_s") or 0.0
             w["decode_dispatches"] += 1
@@ -169,15 +184,30 @@ def build_waterfalls(
 
     out = []
     for w in sorted(reqs.values(), key=lambda w: (w["t_first"] or 0.0)):
+        # A prefill replica's reason="shipped" completion is a hand-off
+        # marker (the real latency waterfall lives on the decode
+        # replica that adopted the pages) — never a latency row, so it
+        # is excluded from attribution instead of counting as a
+        # failure.
+        shipped_out = w["finish_reason"] == "shipped"
+        w["shipped_out"] = shipped_out
         attributed = (
-            w["done"]
+            not shipped_out
+            and w["done"]
             and w["queue_s"] is not None
             and w["prefill_s"] is not None
             and w["ttft_s"] is not None
         )
         w["attributed"] = attributed
         if attributed:
-            err = abs(w["queue_s"] + w["prefill_s"] - w["ttft_s"])
+            # TTFT decomposes into queue + prefill on a monolithic
+            # replica and queue + prefill + ship on a decode replica;
+            # ship_s is None (0) whenever the request was served
+            # locally.
+            err = abs(
+                w["queue_s"] + w["prefill_s"] + (w["ship_s"] or 0.0)
+                - w["ttft_s"]
+            )
             w["attribution_err_s"] = err
             w["sum_ok"] = err <= max(tolerance_s, 0.02 * w["ttft_s"])
         else:
@@ -268,15 +298,20 @@ def build_report(
     waterfalls = build_waterfalls(events, tolerance_s)
     attributed = [w for w in waterfalls if w["attributed"]]
     sheds = [e for e in events if e["name"] == REQ_SHED]
+    roles = {
+        proc: stats[proc].get("role", "monolithic") for proc in sorted(stats)
+    }
     report = {
         "workdir": workdir,
         "processes": sorted(set(procs) | set(stats)),
+        "roles": roles,
         "waterfalls": waterfalls,
         "attribution": {
             "requests": len(waterfalls),
             "attributed": len(attributed),
             "sum_ok": sum(1 for w in attributed if w["sum_ok"]),
             "sum_bad": sum(1 for w in attributed if not w["sum_ok"]),
+            "shipped_out": sum(1 for w in waterfalls if w["shipped_out"]),
         },
         "sheds": [
             {"proc": e["proc"], "t": e["t"], **(e.get("args") or {})}
@@ -303,18 +338,26 @@ def format_report(report: dict) -> str:
             "serving_stats_p*.json)"
         )
         return "\n".join(lines)
+    roles = report.get("roles", {})
     lines.append(
-        "  processes: " + ", ".join(f"p{p}" for p in report["processes"])
+        "  processes: " + ", ".join(
+            f"p{p}({roles[p]})" if p in roles else f"p{p}"
+            for p in report["processes"]
+        )
     )
     att = report["attribution"]
     lines.append(
         f"waterfalls: {att['requests']} request(s), "
         f"{att['attributed']} fully attributed, "
-        f"{att['sum_bad']} failing queue+prefill=TTFT reconciliation"
+        f"{att['sum_bad']} failing queue+prefill+ship=TTFT reconciliation"
+        + (
+            f", {att['shipped_out']} shipped hand-off marker(s)"
+            if att.get("shipped_out") else ""
+        )
     )
     if report["waterfalls"]:
         lines.append(
-            "  rid       queue_ms prefill_ms decode_ms  ttft_ms "
+            "  rid       queue_ms prefill_ms ship_ms decode_ms  ttft_ms "
             "tok fin    cache  ok"
         )
         for w in report["waterfalls"][:60]:
@@ -327,13 +370,20 @@ def format_report(report: dict) -> str:
                 "  ?" if w["sum_ok"] is None
                 else (" ok" if w["sum_ok"] else "BAD")
             )
+            if w.get("shipped_out"):
+                ok = "  >"  # hand-off marker; latency row is elsewhere
             shed = (
                 f"  shed×{w['sheds']}({w['shed_reason']})"
                 if w["sheds"] else ""
             )
+            ship = (
+                f"{_fmt_ms(w['ship_s'])}" if w.get("ship_s") is not None
+                else "      -"
+            )
             lines.append(
                 f"  p{w['proc']}/r{w['rid']:<6} {_fmt_ms(w['queue_s'])} "
-                f"{_fmt_ms(w['prefill_s'])}  {_fmt_ms(w['decode_s'])} "
+                f"{_fmt_ms(w['prefill_s'])} {ship}  "
+                f"{_fmt_ms(w['decode_s'])} "
                 f"{_fmt_ms(w['ttft_s'])} "
                 f"{w['tokens'] if w['tokens'] is not None else '?':>3} "
                 f"{w['finish_reason'] or '?':<6} {cache:>6} {ok}{shed}"
@@ -344,6 +394,23 @@ def format_report(report: dict) -> str:
             lines.append(
                 f"  p{s['proc']} rid={s.get('rid')} "
                 f"reason={s.get('reason')} waiting={s.get('waiting')}"
+            )
+    ship_stats = [
+        (proc, m) for proc, m in sorted(report["stats"].items())
+        if any(str(k).startswith("serve/ship_") for k in m)
+    ]
+    if ship_stats:
+        lines.append("shipping (disaggregated fleet):")
+        for proc, m in ship_stats:
+            role = roles.get(proc, "?")
+            lines.append(
+                f"  p{proc}({role}): "
+                f"{int(m.get('serve/ship_requests', 0))} bundle(s), "
+                f"{int(m.get('serve/ship_bytes', 0))} bytes, "
+                f"{int(m.get('serve/ship_pages', 0))} page(s), "
+                f"ship p99 {m.get('serve/ship/p99_s', 0.0) * 1e3:.1f}ms, "
+                f"fleet hits {int(m.get('serve/fleet_prefix_hits', 0))} / "
+                f"misses {int(m.get('serve/fleet_prefix_misses', 0))}"
             )
     if report["slo"]:
         lines.append("SLO verdicts:")

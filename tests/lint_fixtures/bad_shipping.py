@@ -1,0 +1,15 @@
+"""Known-bad: 64-bit page id on the wire, leaked handoff tmp file."""
+import numpy as np
+
+PAGE_ID_SENTINEL = 1 << 40
+
+
+def advertise_page(consensus):
+    consensus.broadcast_int(PAGE_ID_SENTINEL)
+    return consensus.allgather_int(np.int64(7))
+
+
+def publish_bundle(handoff_dir, name, data):
+    f = open(handoff_dir + "/" + name + ".tmp", "wb")
+    f.write(data)
+    f.close()

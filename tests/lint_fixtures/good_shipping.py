@@ -1,0 +1,21 @@
+"""Known-good: int32-safe page id, tmp file closed on every path."""
+import os
+
+import numpy as np
+
+PAGE_ID_SENTINEL = 2 ** 31 - 1
+
+
+def advertise_page(consensus):
+    consensus.broadcast_int(PAGE_ID_SENTINEL)
+    return consensus.allgather_int(int(np.int32(7)))
+
+
+def publish_bundle(handoff_dir, name, data):
+    path = os.path.join(handoff_dir, name)
+    f = open(path + ".tmp", "wb")
+    try:
+        f.write(data)
+    finally:
+        f.close()
+    os.replace(path + ".tmp", path)

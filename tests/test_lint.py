@@ -86,6 +86,9 @@ BAD_EXPECT = {
                          ("resource-lifecycle", 30)},
     "bad_serving_obs.py": {("determinism-hazard", 6),
                            ("metric-key-registry", 7)},
+    "bad_shipping.py": {("int32-wire", 8),
+                        ("int32-wire", 9),
+                        ("resource-lifecycle", 13)},
 }
 
 GOOD_FILES = [
@@ -105,6 +108,7 @@ GOOD_FILES = [
     "meshaxes_good.py",
     "good_lifecycle.py",
     "good_serving_obs.py",
+    "good_shipping.py",
 ]
 
 

@@ -1118,8 +1118,12 @@ def test_server_lifecycle_and_drain_artifacts(tmp_path):
     # registry: every SERVE_* constant must appear in this report's
     # snapshot — the serving twin of test_telemetry's training-side
     # coverage check, which excuses serve/ precisely because it is
-    # owned here.  No --allow-missing: a served-traffic report that
-    # misses any serve/ key is a writer regression.
+    # owned here.  The only allowed-missing prefixes are the
+    # disaggregation families (serve/ship_*, serve/ship/*,
+    # serve/fleet_prefix_*): a MONOLITHIC server must not emit them
+    # (full-set-or-absent), and test_disagg_stream_identity's coverage
+    # check owns them from the other side — together the two checks
+    # tile the serve/ registry with no blanket allow on either.
     registry_py = os.path.join(
         os.path.dirname(SCHEMA_LINT), "..",
         "distributed_tensorflow_models_tpu", "telemetry", "registry.py",
@@ -1127,7 +1131,9 @@ def test_server_lifecycle_and_drain_artifacts(tmp_path):
     proc = subprocess.run(
         [sys.executable, SCHEMA_LINT, str(stats_path),
          "--declared-coverage", registry_py,
-         "--only-prefix", "serve/"],
+         "--only-prefix", "serve/",
+         "--allow-missing", "serve/ship",
+         "--allow-missing", "serve/fleet_prefix_"],
         capture_output=True, text=True,
     )
     assert proc.returncode == 0, proc.stderr + proc.stdout
@@ -1201,3 +1207,242 @@ def test_engine_factory_failure_fails_handles_not_hangs():
         pass
     with pytest.raises(RuntimeError, match="no accelerator"):
         srv.drain()
+
+
+# -- disaggregated prefill/decode serving (ISSUE 17) -----------------------
+
+
+from distributed_tensorflow_models_tpu.serving import shipping as shiplib  # noqa: E402
+
+SERVING_REPORT = os.path.join(
+    os.path.dirname(__file__), "..", "scripts", "serving_report.py"
+)
+SHIP_KEYS = (
+    "serve/ship_requests", "serve/ship_bytes", "serve/ship_pages",
+    "serve/fleet_prefix_hits", "serve/fleet_prefix_misses",
+)
+
+
+def _disagg_factory(fleet_dir=None, page_tokens=8):
+    def build():
+        model, params = _small_lm()
+        fleet = (
+            shiplib.FleetPrefixIndex(fleet_dir, page_tokens)
+            if fleet_dir else None
+        )
+        return InferenceEngine(
+            model, params, max_slots=2, prefill_chunk=8,
+            prefix_cache=True, fleet_cache=fleet,
+        )
+
+    return build
+
+
+def _claim_all(handoff, decode_srv, n, replica=9):
+    """Claim ``n`` bundles and adopt them; ``{rid: handle}``."""
+    out = {}
+    for _ in range(n):
+        name, meta, leaves = shiplib.claim_bundle(handoff, replica)
+        meta["wire_bytes"] = os.path.getsize(
+            os.path.join(handoff, shiplib.CLAIMED_DIR, f"{name}.p{replica}")
+        )
+        out[meta["request_id"]] = decode_srv.submit_shipped(meta, leaves)
+    return out
+
+
+def test_disagg_stream_identity_and_role_pins(tmp_path):
+    """The tentpole contract, in-suite: a request prefillled on one
+    replica, its KV pages shipped through the handoff dir, and decoded
+    on another must stream byte-identically to the monolithic server —
+    greedy AND sampled — while each role pins its compiled-program
+    count ((n,0) prefill / (0,n) decode), keeps a clean arena
+    (``fsck``), and carries the full ship metric family that a
+    monolithic server must not leak."""
+    handoff = str(tmp_path / "handoff")
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    prompt = list(range(1, 12))
+    modes = {
+        1: {},  # greedy
+        2: dict(temperature=0.7, top_k=5, top_p=0.9, seed=13),
+    }
+
+    mono = LMServer(_disagg_factory())
+    mono.start()
+    refs = {
+        rid: mono.submit(prompt, 8, request_id=rid, **kw).result(300)
+        for rid, kw in modes.items()
+    }
+    mono.drain()
+    mono_stats = mono.stats()
+
+    pre = LMServer(
+        _disagg_factory(), role="prefill", handoff_dir=handoff,
+        workdir=str(wd), process_index=0,
+    )
+    pre.start()
+    shipped = {
+        rid: pre.submit(prompt, 8, request_id=rid, **kw).result(300)
+        for rid, kw in modes.items()
+    }
+    pre.drain()
+    pre_stats = pre.stats()
+    assert all(c.finish_reason == "shipped" for c in shipped.values())
+    assert all(c.decode_steps == 0 for c in shipped.values())
+
+    dec = LMServer(
+        _disagg_factory(), role="decode", workdir=str(wd), process_index=1,
+    )
+    dec.start()
+    handles = _claim_all(handoff, dec, len(modes))
+    comps = {rid: h.result(300) for rid, h in handles.items()}
+    dec.drain()
+    dec_stats = dec.stats()
+
+    # Byte-identity: the shipped stream IS the monolithic stream.
+    for rid, ref in refs.items():
+        assert comps[rid].tokens == ref.tokens, (rid, comps[rid], ref)
+        assert comps[rid].finish_reason == ref.finish_reason
+
+    # Roles + compile pins: a role that never runs a program never
+    # compiles it.
+    for stats, role, pins in (
+        (mono_stats, "monolithic", (1.0, 1.0)),
+        (pre_stats, "prefill", (1.0, 0.0)),
+        (dec_stats, "decode", (0.0, 1.0)),
+    ):
+        assert stats["role"] == role
+        got = (
+            stats["metrics"][reglib.SERVE_COMPILED_PREFILL],
+            stats["metrics"][reglib.SERVE_COMPILED_DECODE],
+        )
+        assert got == pins, (role, got)
+
+    # Arena refcounts prove out clean on every replica.
+    assert mono_stats["fsck_errors"] == []
+    assert pre_stats["fsck_errors"] == []
+    assert dec_stats["fsck_errors"] == []
+
+    # Ship metric family: full set on both disagg roles, absent on
+    # monolithic (full-set-or-absent, like serve/spec_*).
+    for key in SHIP_KEYS:
+        assert key in pre_stats["metrics"], key
+        assert key in dec_stats["metrics"], key
+        assert key not in mono_stats["metrics"], key
+    assert pre_stats["metrics"]["serve/ship_requests"] == float(len(modes))
+    assert dec_stats["metrics"]["serve/ship_requests"] == float(len(modes))
+    assert pre_stats["metrics"]["serve/ship_bytes"] > 0
+
+    # Both stats reports are schema-clean, and the prefill one closes
+    # the disagg side of the declared-coverage tiling (serve/ship_* and
+    # serve/fleet_prefix_* NOT excused here; spec/slo are owned by
+    # test_server_lifecycle_and_drain_artifacts).
+    registry_py = os.path.join(
+        os.path.dirname(SCHEMA_LINT), "..",
+        "distributed_tensorflow_models_tpu", "telemetry", "registry.py",
+    )
+    for idx in (0, 1):
+        path = wd / f"serving_stats_p{idx}.json"
+        proc = subprocess.run(
+            [sys.executable, SCHEMA_LINT, str(path), "--serving-report"],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+    proc = subprocess.run(
+        [sys.executable, SCHEMA_LINT, str(wd / "serving_stats_p0.json"),
+         "--declared-coverage", registry_py, "--only-prefix", "serve/",
+         "--allow-missing", "serve/spec_", "--allow-missing", "serve/slo_"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+
+    # Role-aware report over the merged workdir: the decode replica
+    # carries the full waterfall with a ship leg that reconciles
+    # queue + prefill + ship == TTFT; the prefill side's completions
+    # are hand-off markers, not latency rows.
+    proc = subprocess.run(
+        [sys.executable, SERVING_REPORT, str(wd), "--json"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    report = json.loads(proc.stdout)
+    assert report["roles"] == {"0": "prefill", "1": "decode"}
+    att = report["attribution"]
+    assert att["shipped_out"] == len(modes)
+    assert att["attributed"] == len(modes)
+    assert att["sum_bad"] == 0 and att["sum_ok"] == len(modes)
+    decode_rows = [
+        w for w in report["waterfalls"] if w["attributed"]
+    ]
+    assert all(w["ship_s"] is not None and w["ship_s"] >= 0
+               for w in decode_rows)
+    assert all(w["ship_bytes"] > 0 for w in decode_rows)
+
+
+def test_disagg_fleet_prefix_cache_hit_identity(tmp_path):
+    """Fleet-wide prefix cache: replica A prefills cold and advertises
+    its prompt pages; replica B — a cold local trie, same fleet dir —
+    must adopt the advertised pages (fleet hits, no recompute) and
+    still ship KV that decodes byte-identically.  Greedy and sampled
+    requests ride the same advertised pages."""
+    handoff = str(tmp_path / "handoff")
+    fleet_dir = str(tmp_path / "fleet")
+    prompt = list(range(1, 18))  # 17 tokens -> 2 full matchable pages
+    modes = {
+        1: dict(temperature=0.5, top_k=8, top_p=0.95, seed=3),
+        3: {},  # greedy
+    }
+    shifted = {rid + 1: kw for rid, kw in modes.items()}  # B's copies
+
+    mono = LMServer(_disagg_factory())
+    mono.start()
+    refs = {
+        rid: mono.submit(prompt, 6, request_id=rid, **kw).result(300)
+        for rid, kw in {**modes, **shifted}.items()
+    }
+    mono.drain()
+
+    a = LMServer(
+        _disagg_factory(fleet_dir), role="prefill", handoff_dir=handoff,
+        process_index=0,
+    )
+    a.start()
+    for rid, kw in modes.items():
+        assert a.submit(
+            prompt, 6, request_id=rid, **kw
+        ).result(300).finish_reason == "shipped"
+    a.drain()
+    a_stats = a.stats()
+    # Cold fleet: A missed both pages once, then its LOCAL trie served
+    # the second request, so no further fleet traffic.
+    assert a_stats["metrics"]["serve/fleet_prefix_hits"] == 0.0
+    assert a_stats["metrics"]["serve/fleet_prefix_misses"] == 2.0
+    assert a_stats["fsck_errors"] == []
+    idx = shiplib.FleetPrefixIndex(fleet_dir, 8)
+    assert idx.entry_count() == 2  # both prompt pages advertised once
+
+    b = LMServer(
+        _disagg_factory(fleet_dir), role="prefill", handoff_dir=handoff,
+        process_index=1,
+    )
+    b.start()
+    for rid, kw in shifted.items():
+        assert b.submit(
+            prompt, 6, request_id=rid, **kw
+        ).result(300).finish_reason == "shipped"
+    b.drain()
+    b_stats = b.stats()
+    # B never saw this prompt locally: the shared pages came from the
+    # fleet index (2 hits), after which its local trie took over.
+    assert b_stats["metrics"]["serve/fleet_prefix_hits"] == 2.0
+    assert b_stats["fsck_errors"] == []
+
+    dec = LMServer(_disagg_factory(), role="decode", process_index=2)
+    dec.start()
+    handles = _claim_all(handoff, dec, len(refs))
+    comps = {rid: h.result(300) for rid, h in handles.items()}
+    dec.drain()
+    dec_stats = dec.stats()
+    assert dec_stats["fsck_errors"] == []
+    for rid, ref in refs.items():
+        assert comps[rid].tokens == ref.tokens, (rid, comps[rid], ref)

@@ -347,6 +347,7 @@ def test_serving_report_waterfalls_verdicts_throughput(tmp_path):
     assert wf[1]["sheds"] == 2 and wf[1]["shed_reason"] == "no_slot"
     assert report["attribution"] == {
         "requests": 2, "attributed": 2, "sum_ok": 1, "sum_bad": 1,
+        "shipped_out": 0,  # monolithic workdir: nothing left by shipping
     }
     (shed,) = report["sheds"]
     assert shed["reason"] == "no_slot" and shed["waiting"] == 3
