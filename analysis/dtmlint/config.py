@@ -27,6 +27,7 @@ JAX_FREE_ROOTS = (
     f"{PACKAGE}/resilience/heartbeat.py",
     f"{PACKAGE}/serving/server.py",
     f"{PACKAGE}/serving/replay.py",
+    f"{PACKAGE}/serving/admission.py",
     f"{PACKAGE}/telemetry/slo.py",
     f"{PACKAGE}/telemetry/timeseries.py",
 )
@@ -54,6 +55,11 @@ DETERMINISM_SCOPE = (
     # inter-arrival gap must come from an explicit seed, and pacing
     # must never read a wall clock.
     f"{PACKAGE}/serving/replay.py",
+    # Overload tier (ISSUE 19): admission / shed / backpressure /
+    # autoscale decisions are pure arithmetic over explicit stamps — a
+    # clock read here would make shed ordering and scale decisions
+    # unreplayable from the flight record.
+    f"{PACKAGE}/serving/admission.py",
     f"{PACKAGE}/telemetry/slo.py",
 )
 

@@ -1152,7 +1152,7 @@ ORDER = [
 # comparison, not a hardware kernel number.
 CHILD_MODES = sorted(BUILDERS) + [
     "disagg_serving", "flash_check", "decode", "transformer_parts",
-    "restart_mttr", "serving", "speculation",
+    "restart_mttr", "serving", "serving_load", "speculation",
 ]
 
 
@@ -1680,16 +1680,18 @@ def run_serving(args):
     # Shapes keep every prompt page-aligned: page == chunk divides the
     # shared length, so the warm path resumes exactly at the cached
     # page boundary.
-    if smoke:
-        sp_shared, sp_tail, sp_new = 8, 2, 4
-        sp_page = 2
-        mix_requests, mix_slots = 4, 4
-        lc_plen = 8
-    else:
-        sp_shared, sp_tail, sp_new = 96, 16, 32
-        sp_page = 16
-        mix_requests, mix_slots = 8, 8
-        lc_plen = 112
+    from distributed_tensorflow_models_tpu.serving import (
+        replay as replaylib,
+    )
+
+    sp = replaylib.preset_params("shared_prefix", smoke=smoke)
+    lc = replaylib.preset_params("long_context", smoke=smoke)
+    sp_shared, sp_tail, sp_new = (
+        sp["shared_len"], sp["tail_len"], sp["new_tokens"]
+    )
+    sp_page = sp["page_tokens"]
+    mix_requests, mix_slots = sp["requests"], sp["slots"]
+    lc_plen = lc["prompt_len"]
     sp_plen = sp_shared + sp_tail
     mix_max_len = max(sp_plen, lc_plen) + sp_new
 
@@ -2281,6 +2283,223 @@ def run_disagg_serving(args):
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_serving_load(args):
+    """Latency-vs-load curve (ISSUE 19): TTFT/TPOT p50/p99 against
+    offered QPS, at two fleet sizes, through real file-queue serving
+    fleets under ``launch_local``.
+
+    Each (replicas, QPS) point spawns a fresh fleet, soaks it with an
+    unmeasured warmup burst (sized past one replica's claim-ahead so
+    EVERY replica pays its prefill+decode compile before the clock
+    starts), then offers the measured trace open-loop at the target
+    rate — seeded Poisson arrivals from the shared ``uniform`` preset,
+    identical prompts AND identical arrival offsets across the two
+    fleet sizes so a point differs only in capacity.  Latency
+    percentiles come from the per-request ``ttft_s``/``tpot_s`` the
+    response payloads carry (warmup requests excluded), not from the
+    replicas' cumulative registry timers: a small trace cannot rank
+    its p99 past compile-era samples, and the whole point of the curve
+    is the queueing tail, not compile luck.  The pacing report guards
+    the x-axis — a point whose replayer fell >25% behind schedule is
+    rejected rather than banked at a load it never offered.
+
+    Headline: TTFT p99 at the highest offered QPS, 1 replica over 2 —
+    the direct read of what doubling capacity buys under load.
+    CPU-safe, jax-free in this parent.
+    """
+    import math
+    import shutil
+    import tempfile
+    import threading
+
+    from distributed_tensorflow_models_tpu import launch
+    from distributed_tensorflow_models_tpu.serving import (
+        replay as replaylib,
+    )
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    base = tempfile.mkdtemp(prefix="dtm-servload-")
+    port = [10520]
+    # DTM_SERVING_LOAD_SMOKE=1 shrinks the grid to one QPS point with a
+    # tiny trace so the full path (warmup soak, paced fleet at both
+    # sizes, the headline ratio) validates in about a minute.
+    smoke = os.environ.get("DTM_SERVING_LOAD_SMOKE") == "1"
+    replica_counts = (1, 2)
+    qps_points = (4.0,) if smoke else (2.0, 8.0, 24.0)
+    warm_gap_s = 0.02
+
+    def measured_n(qps):
+        # ~6 s of offered traffic per point, clamped: the slow point
+        # stays short, the fast point keeps a p99-worthy sample count.
+        if smoke:
+            return 10
+        return max(24, min(96, int(round(qps * 6.0))))
+
+    def pct(vals, q):
+        vs = sorted(vals)
+        if not vs:
+            return 0.0
+        return vs[min(len(vs) - 1, max(0, math.ceil(q * len(vs)) - 1))]
+
+    def read_responses(queue_dir):
+        resp_dir = os.path.join(queue_dir, "resp")
+        out = {}
+        if os.path.isdir(resp_dir):
+            for name in os.listdir(resp_dir):
+                if name.endswith(".json"):
+                    with open(os.path.join(resp_dir, name)) as f:
+                        out[
+                            int(name.split("-")[1].split(".")[0])
+                        ] = json.load(f)
+        return out
+
+    def run_point(replicas, qps):
+        port[0] += 1
+        label = f"r{replicas}-q{qps:g}"
+        scratch = os.path.join(base, label)
+        queue_dir = os.path.join(scratch, "queue")
+        workdir = os.path.join(scratch, "wd")
+        os.makedirs(queue_dir)
+        os.makedirs(workdir)
+        n = measured_n(qps)
+        # Claim-ahead is 2*max_slots per replica; a warmup burst larger
+        # than one replica's claim window cannot be swallowed whole by
+        # whichever replica boots first, so every replica compiles.
+        n_warm = 2 * 4 * replicas + 2
+        warm = replaylib.assign_arrivals(
+            replaylib.preset_trace(
+                "uniform", n_warm, seed=47, first_id=9000,
+            ),
+            seed=470, mean_gap_s=warm_gap_s,
+        )
+        # Prompt seed AND arrival seed depend only on the QPS point:
+        # both fleet sizes see the identical offered trace.
+        measured = replaylib.assign_arrivals(
+            replaylib.preset_trace("uniform", n, seed=48),
+            seed=480 + int(round(qps * 10)), mean_gap_s=1.0 / qps,
+        )
+        warm_ids = {r.request_id for r in warm}
+        paced = {}
+
+        def pace():
+            replaylib.replay(
+                warm, lambda r: replaylib.write_request(queue_dir, r)
+            )
+            # Measured clock starts only once the warmup burst is fully
+            # answered: every replica idle, every compile paid.
+            soak_deadline = time.perf_counter() + 300.0
+            while time.perf_counter() < soak_deadline:
+                if warm_ids <= set(read_responses(queue_dir)):
+                    break
+                time.sleep(0.1)
+            paced["report"] = replaylib.replay(
+                measured, lambda r: replaylib.write_request(queue_dir, r)
+            )
+            done = os.path.join(queue_dir, "DONE")
+            with open(done + ".tmp", "w") as f:
+                f.write("done\n")
+            os.replace(done + ".tmp", done)
+
+        pacer = threading.Thread(target=pace, daemon=True)
+        pacer.start()
+        argv = [
+            sys.executable, "-m",
+            "distributed_tensorflow_models_tpu.serving.server",
+            "--queue-dir", queue_dir, "--workdir", workdir,
+            "--max-slots", "4", "--prefill-chunk", "8",
+            "--drain-grace-s", "60", "--timeout", "240",
+        ]
+        codes = launch.launch_local(
+            replicas, argv, port=port[0], timeout=420.0,
+            extra_env={
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": repo + os.pathsep + os.environ.get(
+                    "PYTHONPATH", ""
+                ),
+            },
+        )
+        pacer.join(timeout=60)
+        if launch.aggregate_exit_codes(codes) != 0:
+            raise RuntimeError(f"{label}: fleet exit codes {codes}")
+        responses = read_responses(queue_dir)
+        want = warm_ids | {r.request_id for r in measured}
+        if set(responses) != want:
+            raise RuntimeError(
+                f"{label}: exactly-once broken — "
+                f"{len(want - set(responses))} missing, "
+                f"{len(set(responses) - want)} unexpected responses"
+            )
+        report = paced.get("report")
+        if report is None:
+            raise RuntimeError(f"{label}: pacer never ran the trace")
+        if report.pacing_error > 0.25:
+            raise RuntimeError(
+                f"{label}: replayer fell {report.pacing_error:.0%} behind "
+                f"schedule — the point never offered {qps:g} QPS"
+            )
+        meas = [
+            responses[r.request_id] for r in measured
+        ]
+        served_by = {}
+        for i in range(replicas):
+            path = os.path.join(workdir, f"serving_stats_p{i}.json")
+            with open(path) as f:
+                served_by[i] = int(
+                    json.load(f)["metrics"].get("serve/requests", 0.0)
+                )
+        ttfts = [m["ttft_s"] for m in meas]
+        tpots = [m["tpot_s"] for m in meas if m["tpot_s"] > 0.0]
+        out = {
+            "replicas": replicas,
+            "target_qps": qps,
+            "offered_qps": round(report.offered_qps, 3),
+            "achieved_qps": round(report.achieved_qps, 3),
+            "pacing_error": round(report.pacing_error, 4),
+            "requests": n,
+            "ttft_p50_ms": round(pct(ttfts, 0.50) * 1e3, 3),
+            "ttft_p99_ms": round(pct(ttfts, 0.99) * 1e3, 3),
+            "tpot_p50_ms": round(pct(tpots, 0.50) * 1e3, 3),
+            "tpot_p99_ms": round(pct(tpots, 0.99) * 1e3, 3),
+            "served_by_replica": served_by,
+        }
+        log(f"serving_load {label}: {json.dumps(out)}")
+        return out
+
+    try:
+        curve = []
+        for replicas in replica_counts:
+            for qps in qps_points:
+                curve.append(run_point(replicas, qps))
+        peak = max(qps_points)
+
+        def peak_ttft(replicas):
+            row = next(
+                c for c in curve
+                if c["replicas"] == replicas and c["target_qps"] == peak
+            )
+            return row["ttft_p99_ms"]
+
+        return {
+            "metric": "serving_load",
+            # Headline: what doubling the fleet buys the TTFT tail at
+            # the highest offered load.
+            "value": round(peak_ttft(1) / max(peak_ttft(2), 1e-9), 2),
+            "unit": "x_ttft_p99_1_vs_2_replicas_at_peak_qps",
+            "curve": curve,
+            "replica_counts": list(replica_counts),
+            "qps_points": list(qps_points),
+            "trace": {
+                "preset": "uniform",
+                "arrivals": "open_loop_poisson",
+                "requests_per_point": [
+                    measured_n(q) for q in qps_points
+                ],
+            },
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def run_mode(name, args):
     """Single dispatch point for both the child process and the
     --in-process path: train-loop configs go through run_one; standalone
@@ -2295,6 +2514,8 @@ def run_mode(name, args):
         return run_serving(args)
     if name == "disagg_serving":
         return run_disagg_serving(args)
+    if name == "serving_load":
+        return run_serving_load(args)
     if name == "speculation":
         return run_speculation(args)
     if name == "transformer_parts":
@@ -2382,7 +2603,7 @@ def main():
     args = p.parse_args()
     if args.compile_only and (args.child or args.config) in (
         "disagg_serving", "flash_check", "decode", "transformer_parts",
-        "restart_mttr", "serving", "all",
+        "restart_mttr", "serving", "serving_load", "all",
     ):
         p.error("--compile-only supports a single builder config only")
     if args.compile_only and not (args.child or args.in_process):

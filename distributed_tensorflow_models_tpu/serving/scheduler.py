@@ -4,8 +4,21 @@ Orca's observation, applied to the slotted engine: scheduling decisions
 belong at TOKEN granularity, not request granularity.  Each
 :meth:`ContinuousBatchingScheduler.step`:
 
-1. **Admits** — packs waiting prompts (FIFO; no reordering, so TTFT is
-   arrival-ordered and starvation-free) into free slots AND free KV
+1. **Sheds** (admission policy attached) — before admitting, waiting
+   requests past their TTFT deadline, plus — while a configured SLO is
+   in breach — a bounded number of lowest-class waiters, retire
+   immediately with ``finish_reason="shed"`` and an EMPTY token list.
+   A shed is a *response*: the server resolves its handle and a
+   file-queue replica writes it back, so clients always hear back and
+   the exactly-once ledger stays balanced under overload.  The
+   attached :class:`~.admission.BackpressureGate` is fed blocks-free +
+   queue-depth once per iteration; while engaged
+   (``intake_paused``), the server pauses *claiming* new work so the
+   arena is protected BEFORE it exhausts, not after.
+2. **Admits** — packs waiting prompts (FIFO by default, so TTFT is
+   arrival-ordered and starvation-free; with an
+   :class:`~.admission.AdmissionPolicy` attached, highest priority
+   class first and FIFO *within* a class) into free slots AND free KV
    blocks (``engine.admit`` reserves the request's whole paged
    footprint up front, reusing any resident prefix), bounded by the
    ``max_prefill_tokens`` budget: prefill compute is O(uncached
@@ -20,7 +33,7 @@ belong at TOKEN granularity, not request granularity.  Each
    the one prefill program.  A request finishing AT admission (EOS
    first token, or ``max_new_tokens == 1``) frees its slot and blocks
    inside the same pass, so the next iteration's waiter takes them.
-2. **Decodes** — ONE batched dispatch advances every active slot
+3. **Decodes** — ONE batched dispatch advances every active slot
    ``engine.decode_burst`` tokens (1 by default — classic per-token
    scheduling; >1 amortizes per-dispatch host cost over the burst at
    the price of burst-granular admission, vLLM's multi-step
@@ -33,7 +46,7 @@ belong at TOKEN granularity, not request granularity.  Each
    covers overrun past EOS mid-acceptance, and proposals are clipped
    to the lane's remaining ``max_new`` budget before dispatch so
    acceptance alone can never overrun it.
-3. **Retires** — sequences that emitted ``eos_id`` or reached
+4. **Retires** — sequences that emitted ``eos_id`` or reached
    ``max_new_tokens`` release their slot and block references
    (``engine.release``; pages the prefix cache adopted stay resident
    for future admissions); the NEXT iteration's admission pass refills
@@ -113,6 +126,11 @@ class Request:
     top_p: float = 1.0
     eos_id: Optional[int] = None
     rng: Optional[object] = None  # jax PRNG key; opaque at this layer
+    # Admission-control facts (ignored without an AdmissionPolicy):
+    # priority names a class ("" = policy default), deadline_s is a
+    # TTFT deadline relative to submit — overdue waiters are shed.
+    priority: str = ""
+    deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -122,9 +140,15 @@ class Completion:
 
     request_id: int
     tokens: list
-    finish_reason: str  # "eos" | "length" | "shipped" (prefill role)
+    finish_reason: str  # "eos" | "length" | "shipped" | "shed"
     ttft_s: float
     decode_steps: int
+    # Mean per-token decode latency of THIS request (0.0 when it never
+    # decoded past its first token).  Lets an open-loop driver build
+    # warmup-excluded latency distributions from response payloads
+    # alone — the registry timers fold compile-era samples into their
+    # percentiles, which a small trace cannot rank past.
+    tpot_s: float = 0.0
 
 
 class _InFlight:
@@ -133,7 +157,7 @@ class _InFlight:
     __slots__ = (
         "req", "slot", "keydata", "tokens", "pos", "t_submit", "ttft_s",
         "t_last", "drafter", "cached_len", "sheds", "shed_reason",
-        "ship",
+        "ship", "cls",
     )
 
     def __init__(self, req, slot, keydata, t_submit):
@@ -150,6 +174,7 @@ class _InFlight:
         self.sheds = 0  # backpressure events suffered while head-of-line
         self.shed_reason = ""  # last shed reason ("no_slot" | "no_blocks")
         self.ship = None  # shipped-arrival facts dict (decode role only)
+        self.cls = ""  # resolved priority class (admission policy only)
 
 
 class ContinuousBatchingScheduler:
@@ -171,6 +196,8 @@ class ContinuousBatchingScheduler:
         slo_monitor=None,
         role: str = "monolithic",
         ship=None,
+        admission=None,
+        backpressure=None,
     ):
         if role not in ("monolithic", "prefill", "decode"):
             raise ValueError(
@@ -224,7 +251,34 @@ class ContinuousBatchingScheduler:
         self.registry = (
             registry if registry is not None else engine.registry
         )
-        self._waiting: deque = deque()
+        # Admission control (serving/admission.py).  The policy brings
+        # priority classes + shed rules; the gate brings pre-exhaustion
+        # intake pausing.  Attaching either pre-creates the WHOLE
+        # admission metric family (per-class submitted/shed counters,
+        # backpressure gauge + episode counter) so the
+        # full-set-or-absent stats contract holds from the first
+        # snapshot; without a policy the scheduler is byte-for-byte the
+        # PR 18 FIFO scheduler.
+        if backpressure is not None and admission is None:
+            raise ValueError(
+                "a BackpressureGate needs an AdmissionPolicy attached "
+                "(the gate's metrics are part of the admission family)"
+            )
+        self.admission = admission
+        self._gate = backpressure
+        self._gate_episodes_seen = 0
+        if admission is not None:
+            for cls in admission.classes:
+                self.registry.counter(f"{reglib.SERVE_SUBMITTED}/{cls}")
+                self.registry.counter(f"{reglib.SERVE_SHED}/{cls}")
+            self.registry.gauge(reglib.SERVE_BACKPRESSURE).set(0.0)
+            self.registry.counter(reglib.SERVE_BACKPRESSURE_ENGAGED)
+        # One FIFO deque per priority rank (a single rank without a
+        # policy); admission drains the highest non-empty rank first.
+        self._queues: list = [
+            deque()
+            for _ in range(len(admission.classes) if admission else 1)
+        ]
         self._active: dict[int, _InFlight] = {}  # slot -> state
         # Last (rid, reason) shed instant emitted — backpressure persists
         # across iterations and the instant is only interesting on
@@ -260,9 +314,8 @@ class ContinuousBatchingScheduler:
         else:
             keydata = self.engine.zero_keys(req.max_new_tokens)
         self.registry.counter(reglib.SERVE_REQUESTS).inc()
-        self._waiting.append(
-            _InFlight(req, -1, keydata, time.perf_counter())
-        )
+        inflight = _InFlight(req, -1, keydata, time.perf_counter())
+        self._enqueue(inflight)
 
     def submit_shipped(
         self,
@@ -318,13 +371,26 @@ class ContinuousBatchingScheduler:
             "src": int(src_replica),
         }
         self.registry.counter(reglib.SERVE_REQUESTS).inc()
-        self._waiting.append(inflight)
+        self._enqueue(inflight)
+
+    def _enqueue(self, inflight) -> None:
+        """File into the priority rank its class maps to (rank 0 — the
+        only queue — without a policy), counting intake by class."""
+        rank = 0
+        if self.admission is not None:
+            cls = self.admission.resolve(inflight.req.priority)
+            inflight.cls = cls
+            rank = self.admission.rank(cls)
+            self.registry.counter(
+                f"{reglib.SERVE_SUBMITTED}/{cls}"
+            ).inc()
+        self._queues[rank].append(inflight)
 
     # -- introspection -----------------------------------------------------
 
     @property
     def waiting_count(self) -> int:
-        return len(self._waiting)
+        return sum(len(q) for q in self._queues)
 
     @property
     def active_count(self) -> int:
@@ -332,7 +398,13 @@ class ContinuousBatchingScheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._waiting or self._active)
+        return bool(self._active or any(self._queues))
+
+    @property
+    def intake_paused(self) -> bool:
+        """True while the backpressure gate is engaged — the server's
+        signal to stop claiming new work before the arena exhausts."""
+        return self._gate is not None and self._gate.engaged
 
     # -- the iteration -----------------------------------------------------
 
@@ -381,15 +453,93 @@ class ContinuousBatchingScheduler:
                 "tokens": inflight.pos,
                 "ttft_s": inflight.ttft_s,
             })
+        decode_steps = max(0, inflight.pos - 1)
         done.append(
             Completion(
                 request_id=inflight.req.request_id,
                 tokens=list(inflight.tokens),
                 finish_reason=reason,
                 ttft_s=inflight.ttft_s,
-                decode_steps=max(0, inflight.pos - 1),
+                decode_steps=decode_steps,
+                tpot_s=(
+                    (inflight.t_last - inflight.t_submit - inflight.ttft_s)
+                    / decode_steps
+                    if decode_steps > 0 else 0.0
+                ),
             )
         )
+
+    def _shed(self, inflight, why: str, now: float, done: list) -> None:
+        """Retire a WAITING request unserved: empty token list,
+        ``finish_reason="shed"``.  It never held a slot or blocks, so
+        there is nothing to release — but it still produces a
+        completion (the server resolves its handle / writes its
+        response) and still counts as completed: shed + served =
+        answered, which is what the exactly-once ledger balances."""
+        cls = inflight.cls
+        if cls:
+            self.registry.counter(f"{reglib.SERVE_SHED}/{cls}").inc()
+        self.registry.counter(reglib.SERVE_COMPLETED).inc()
+        trace = self.registry.trace
+        if trace.enabled:
+            trace.instant(REQ_SHED, {
+                "rid": inflight.req.request_id,
+                "reason": why,
+                "cls": cls,
+                "waited_s": round(now - inflight.t_submit, 6),
+            })
+            trace.instant(REQ_DONE, {
+                "rid": inflight.req.request_id,
+                "reason": "shed",
+                "tokens": 0,
+                "ttft_s": 0.0,
+            })
+        done.append(
+            Completion(
+                request_id=inflight.req.request_id,
+                tokens=[],
+                finish_reason="shed",
+                ttft_s=0.0,
+                decode_steps=0,
+            )
+        )
+
+    def _shed_pass(self, done: list) -> None:
+        """Pre-admission shedding (admission policy attached).
+
+        Deadline sheds are unconditional and unbounded — a waiter past
+        its TTFT deadline is dead weight in every class.  SLO sheds
+        fire only while a policy-configured SLO name is in breach
+        state (hysteresis-debounced by the monitor), take the LOWEST
+        class first (oldest first within a class), and are bounded per
+        iteration by the policy's quota so one breached evaluation
+        can't mass-evict the queue."""
+        now = time.perf_counter()
+        for rank, queue in enumerate(self._queues):
+            if not queue:
+                continue
+            survivors: deque = deque()
+            for f in queue:
+                if self.admission.overdue(
+                    f.t_submit, f.req.deadline_s, now
+                ):
+                    self._shed(f, "deadline", now, done)
+                else:
+                    survivors.append(f)
+            self._queues[rank] = survivors
+        quota = (
+            self.admission.shed_quota(self.slo.breached())
+            if self.slo is not None
+            else 0
+        )
+        rank = 0
+        while quota > 0 and rank < len(self._queues):
+            queue = self._queues[rank]
+            if queue:
+                self._shed(queue.popleft(), "slo", now, done)
+                quota -= 1
+            else:
+                rank += 1
 
     def _ship_out(self, inflight, first_token, t_wave: float,
                   now: float, done: list) -> None:
@@ -425,16 +575,30 @@ class ContinuousBatchingScheduler:
         """One scheduling iteration; returns retired :class:`Completion`s
         (possibly empty).  No-op when idle."""
         done: list = []
+        # 0. shed pass: deadline-overdue waiters and (while a
+        # configured SLO is breached) lowest-class waiters answer
+        # "shed" BEFORE admission spends arena capacity on them.
+        if self.admission is not None:
+            self._shed_pass(done)
         # 1. admission: pack a wave of waiters into free slots + free
         # blocks under the cache-aware budget (cost = padded UNCACHED
         # suffix — resident prefixes are free), then prefill the whole
-        # wave batched.  engine.admit returning None is backpressure
-        # (slots or blocks exhausted); retirement below frees both.
+        # wave batched.  Waves drain the highest-priority rank first
+        # (rank order is class order; FIFO inside a rank).
+        # engine.admit returning None is backpressure (slots or blocks
+        # exhausted); retirement below frees both.
         spent = 0
         wave = []
         adopted = []  # decode role: shipped requests admitted this pass
-        while self._waiting:
-            head = self._waiting[0]
+        while True:
+            queue = None
+            for q in reversed(self._queues):  # highest rank first
+                if q:
+                    queue = q
+                    break
+            if queue is None:
+                break
+            head = queue[0]
             req = head.req
             if head.ship is not None:
                 # Shipped intake: the prompt's KV arrives on the wire,
@@ -461,7 +625,6 @@ class ContinuousBatchingScheduler:
                     if self.engine.slots.free_count < 1
                     else "no_blocks"
                 )
-                head = self._waiting[0]
                 head.sheds += 1
                 head.shed_reason = reason
                 shed_key = (req.request_id, reason)
@@ -472,10 +635,10 @@ class ContinuousBatchingScheduler:
                         trace.instant(REQ_SHED, {
                             "rid": req.request_id,
                             "reason": reason,
-                            "waiting": len(self._waiting),
+                            "waiting": self.waiting_count,
                         })
                 break
-            inflight = self._waiting.popleft()
+            inflight = queue.popleft()
             if inflight.ship is not None:
                 inflight.slot = admitted
                 adopted.append(inflight)
@@ -672,7 +835,7 @@ class ContinuousBatchingScheduler:
                         break
         # Iteration-sampled load gauges, recorded as timer distributions
         # so the server's p50/p99 surface covers them too.
-        depth = float(len(self._waiting))
+        depth = float(self.waiting_count)
         self.registry.timer(reglib.SERVE_QUEUE_DEPTH).record(depth)
         self.registry.timer(reglib.SERVE_SLOT_OCCUPANCY).record(
             self.engine.slots.occupancy
@@ -689,6 +852,24 @@ class ContinuousBatchingScheduler:
         self.registry.gauge(reglib.SERVE_BLOCK_FRAGMENTATION).set(
             self.engine.fragmentation()
         )
+        if self.admission is not None:
+            engaged = False
+            if self._gate is not None:
+                engaged = self._gate.update(
+                    blocks_free=int(self.engine.blocks_free),
+                    queue_depth=int(depth),
+                )
+                # Episodes are transitions counted by the gate; mirror
+                # the delta into the counter (inc-only contract).
+                new = self._gate.episodes - self._gate_episodes_seen
+                if new > 0:
+                    self.registry.counter(
+                        reglib.SERVE_BACKPRESSURE_ENGAGED
+                    ).inc(new)
+                    self._gate_episodes_seen = self._gate.episodes
+            self.registry.gauge(reglib.SERVE_BACKPRESSURE).set(
+                1.0 if engaged else 0.0
+            )
         return done
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> list:

@@ -38,6 +38,19 @@ periodic registry snapshots + offered/served counts to
 ``scripts/serving_report.py`` merges all of it — per-request
 waterfalls, SLO verdicts, throughput timeline — across replicas.
 
+**Overload controls** (ISSUE 19), attached per replica and still
+jax-free: an :class:`~.admission.AdmissionPolicy` gives requests
+priority classes plus deadline- and SLO-driven shedding (a shed
+request still resolves, ``finish_reason="shed"`` — clients always
+hear back, never a silent drop), a
+:class:`~.admission.BackpressureGate` pauses intake before the KV
+arena exhausts (file-queue replicas stop *claiming* while engaged, so
+the backlog stays visible to peers and the autoscaler instead of
+hoarded here), and ``fleet_file`` mirrors the autoscale controller's
+fleet-membership transitions into this replica's own registry
+(``serve/fleet_size`` gauge + scale counters) so
+``--serving-report`` audits scale events from replica artifacts.
+
 Run as ``python -m distributed_tensorflow_models_tpu.serving.server``
 the module becomes one file-queue replica for ``scripts/serve_drill.py``:
 it claims request files from a shared directory by atomic rename (two
@@ -61,6 +74,7 @@ from typing import Optional
 from distributed_tensorflow_models_tpu.resilience.preemption import (
     PreemptionListener,
 )
+from distributed_tensorflow_models_tpu.serving import admission as admlib
 from distributed_tensorflow_models_tpu.serving import shipping as shiplib
 from distributed_tensorflow_models_tpu.telemetry import registry as reglib
 from distributed_tensorflow_models_tpu.telemetry import slo as slolib
@@ -124,6 +138,56 @@ class ServeHandle:
         self._event.set()
 
 
+class FleetSizeWatcher:
+    """Mirror the autoscale controller's ``fleet_size.json`` into one
+    replica's registry.
+
+    The controller (``launch.FleetAutoscaler``) is the only writer of
+    the file (atomic rename); each replica started with ``--fleet-file``
+    polls it from its claim loop and records the membership transitions
+    it OBSERVES — ``serve/fleet_size`` gauge plus ``serve/scale_up`` /
+    ``serve/scale_down`` counters.  Keeping the counters replica-side
+    (not only in the controller's ``scale_events.jsonl``) puts the
+    scale family into ``serving_stats_p<i>.json``, where
+    ``check_metrics_schema --serving-report`` enforces it
+    full-set-or-absent like the other gated families."""
+
+    __slots__ = ("path", "registry", "_last")
+
+    def __init__(self, path: str, registry: reglib.MetricsRegistry):
+        self.path = path
+        self.registry = registry
+        self._last: Optional[int] = None
+        # Pre-create the trio so even a replica that never sees a
+        # transition reports zeros, not absences.
+        registry.gauge(reglib.SERVE_FLEET_SIZE)
+        registry.counter(reglib.SERVE_SCALE_UP)
+        registry.counter(reglib.SERVE_SCALE_DOWN)
+
+    def poll(self) -> Optional[int]:
+        """Read the file; record any size transition.  A missing or
+        torn file is "no news" (the controller writes tmp+rename, so
+        torn reads only happen before its first decision)."""
+        try:
+            with open(self.path) as f:
+                size = int(json.load(f)["size"])
+        except (OSError, ValueError, KeyError):
+            return self._last
+        if size != self._last:
+            self.registry.gauge(reglib.SERVE_FLEET_SIZE).set(float(size))
+            if self._last is not None:
+                if size > self._last:
+                    self.registry.counter(reglib.SERVE_SCALE_UP).inc(
+                        size - self._last
+                    )
+                else:
+                    self.registry.counter(reglib.SERVE_SCALE_DOWN).inc(
+                        self._last - size
+                    )
+            self._last = size
+        return size
+
+
 class LMServer:
     """Request queue + one serving worker thread over one engine.
 
@@ -154,6 +218,9 @@ class LMServer:
         role: str = "monolithic",
         handoff_dir: Optional[str] = None,
         ship_chunk_bytes: int = 1 << 20,
+        admission: Optional[admlib.AdmissionPolicy] = None,
+        backpressure: Optional[admlib.BackpressureGate] = None,
+        fleet_file: Optional[str] = None,
     ):
         # Disaggregated serving (serving/shipping.py): a "prefill"
         # server runs admission + the prefill program and publishes
@@ -226,6 +293,22 @@ class LMServer:
                 interval_s=timeseries_interval_s,
                 max_rows=timeseries_max_rows,
             )
+        # Overload controls (ISSUE 19).  Validated here, on the caller's
+        # thread — the scheduler would reject the combination too, but
+        # only after the worker built an engine.
+        if backpressure is not None and admission is None:
+            raise ValueError(
+                "backpressure gating needs an admission policy"
+            )
+        self.admission = admission
+        self.backpressure = backpressure
+        # Worker mirrors the scheduler's backpressure gate into this
+        # event each loop pass; the claim loop reads it cross-thread.
+        self._paused = threading.Event()
+        self._fleet_watch = (
+            FleetSizeWatcher(fleet_file, self.registry)
+            if fleet_file else None
+        )
         self._queue: queue.Queue = queue.Queue()
         self._ids = itertools.count()
         self._draining = threading.Event()
@@ -239,6 +322,23 @@ class LMServer:
         return self._draining.is_set() or (
             self._listener is not None and self._listener.preempted
         )
+
+    @property
+    def intake_paused(self) -> bool:
+        """True while the scheduler's backpressure gate is engaged.
+        File-queue replicas check this before claiming: a paused
+        replica leaves requests on the shared queue for peers (or a
+        recruited replica) instead of hoarding work its arena can't
+        admit.  Event-mediated: the worker thread mirrors the gate
+        after every scheduler pass."""
+        return self._paused.is_set()
+
+    def poll_fleet(self) -> Optional[int]:
+        """Mirror the controller's fleet_size.json into this registry
+        (no-op without ``fleet_file``); returns the last seen size."""
+        if self._fleet_watch is None:
+            return None
+        return self._fleet_watch.poll()
 
     def start(self) -> None:
         if self._thread is not None:
@@ -281,6 +381,8 @@ class LMServer:
         seed: Optional[int] = None,
         rng=None,
         request_id: Optional[int] = None,
+        priority: str = "",
+        deadline_s: Optional[float] = None,
     ) -> ServeHandle:
         """Enqueue one request; returns its :class:`ServeHandle`.
 
@@ -288,6 +390,11 @@ class LMServer:
         bit-identity tests pass the same key to a solo ``generate()``)
         or a ``seed``, from which the worker derives the conventional
         per-request key ``fold_in(key(seed), request_id)``.
+
+        ``priority`` names an admission class ("" = the policy's
+        default; ignored without a policy) and ``deadline_s`` bounds
+        queue wait — a request still waiting that long past submit is
+        shed with ``finish_reason="shed"`` instead of served late.
         """
         if self.role == "decode":
             raise ValueError(
@@ -313,6 +420,11 @@ class LMServer:
                     "eos_id": eos_id,
                     "seed": seed,
                     "rng": rng,
+                    "priority": str(priority),
+                    "deadline_s": (
+                        float(deadline_s) if deadline_s is not None
+                        else None
+                    ),
                 },
             )
         )
@@ -484,6 +596,8 @@ class LMServer:
                     top_p=spec["top_p"],
                     eos_id=spec["eos_id"],
                     rng=rng,
+                    priority=spec["priority"],
+                    deadline_s=spec["deadline_s"],
                 )
             )
             pending[handle.request_id] = handle
@@ -578,6 +692,8 @@ class LMServer:
                     self._make_ship_callback(engine)
                     if self.role == "prefill" else None
                 ),
+                admission=self.admission,
+                backpressure=self.backpressure,
             )
         except BaseException as e:  # noqa: BLE001 — surface via drain()
             self._fatal = e
@@ -609,6 +725,10 @@ class LMServer:
                     self.drain_grace_s,
                 )
             self._pull(sched, pending)
+            if sched.intake_paused:
+                self._paused.set()
+            else:
+                self._paused.clear()
             if self._ts_writer is not None:
                 self._ts_writer.maybe_write()  # rate-limited internally
             if sched.has_work:
@@ -811,6 +931,33 @@ def _replica_main(args) -> int:
     resp_dir = os.path.join(args.queue_dir, "resp")
     os.makedirs(claimed_dir, exist_ok=True)
     os.makedirs(resp_dir, exist_ok=True)
+    admission = None
+    if args.priority_classes:
+        admission = admlib.AdmissionPolicy(
+            tuple(
+                c.strip() for c in args.priority_classes.split(",")
+                if c.strip()
+            ),
+            default=args.default_class or None,
+            shed_on_slo=tuple(args.shed_on_slo),
+            max_shed_per_step=args.max_shed_per_step,
+        )
+    gate = None
+    if (
+        args.backpressure_engage_blocks is not None
+        or args.backpressure_engage_queue is not None
+    ):
+        if admission is None:
+            raise SystemExit(
+                "backpressure flags need --priority-classes (the gate "
+                "rides on the admission-enabled scheduler)"
+            )
+        gate = admlib.BackpressureGate(
+            engage_blocks_free=args.backpressure_engage_blocks,
+            release_blocks_free=args.backpressure_release_blocks,
+            engage_queue_depth=args.backpressure_engage_queue,
+            release_queue_depth=args.backpressure_release_queue,
+        )
     listener = PreemptionListener(signals=(signal.SIGTERM,))
     listener.install()
     server = LMServer(
@@ -828,6 +975,9 @@ def _replica_main(args) -> int:
         role=role,
         handoff_dir=handoff_dir if role == "prefill" else None,
         ship_chunk_bytes=args.ship_chunk_bytes,
+        admission=admission,
+        backpressure=gate,
+        fleet_file=args.fleet_file,
     )
     server.start()
     outstanding: dict = {}  # request_id -> (handle, request name)
@@ -866,6 +1016,7 @@ def _replica_main(args) -> int:
                     "tokens": comp.tokens,
                     "finish_reason": comp.finish_reason,
                     "ttft_s": comp.ttft_s,
+                    "tpot_s": comp.tpot_s,
                     "replica": replica,
                 },
             )
@@ -880,11 +1031,18 @@ def _replica_main(args) -> int:
         if listener.preempted:
             exit_reason = "preempted"
             break
+        server.poll_fleet()  # no-op without --fleet-file
         # Claim backpressure: never hold more than two arenas' worth of
         # unresolved work.  Claim-ahead would hoard requests a peer
         # replica could be serving — and everything hoarded becomes
-        # drain debt when this replica is SIGTERM'd.
-        can_claim = len(outstanding) < 2 * args.max_slots
+        # drain debt when this replica is SIGTERM'd.  The scheduler's
+        # arena/queue gate pauses claiming the same way: while engaged,
+        # requests stay on the shared queue where peers (and the
+        # autoscaler's backlog signal) can still see them.
+        can_claim = (
+            len(outstanding) < 2 * args.max_slots
+            and not server.intake_paused
+        )
         if role == "decode":
             # A decode replica's intake is the handoff directory: claim
             # a bundle by atomic rename (exactly-once across peers),
@@ -927,6 +1085,8 @@ def _replica_main(args) -> int:
                         eos_id=spec.get("eos_id"),
                         seed=spec.get("seed"),
                         request_id=spec["request_id"],
+                        priority=spec.get("priority", ""),
+                        deadline_s=spec.get("deadline_s"),
                     )
                     outstanding[spec["request_id"]] = (handle, name)
                 except ServerDraining:
@@ -1096,6 +1256,53 @@ def main(argv=None) -> int:
         help="request-trace ring capacity; per-request lifecycle spans "
         "cost ~3 + tokens/decode_burst events per request, size the "
         "ring to cover the window a post-mortem needs",
+    )
+    p.add_argument(
+        "--priority-classes", default="",
+        help="comma list of admission classes ordered lowest→highest "
+        "priority, e.g. 'batch,standard,interactive' (empty = "
+        "admission off: plain FIFO, no shedding)",
+    )
+    p.add_argument(
+        "--default-class", default="",
+        help="class assumed for requests that name none (default: the "
+        "middle of --priority-classes)",
+    )
+    p.add_argument(
+        "--shed-on-slo", action="append", default=[],
+        help="SLO name (repeatable) whose breach authorizes shedding "
+        "the lowest-priority queued requests; must match an --slo name",
+    )
+    p.add_argument(
+        "--max-shed-per-step", type=int, default=1,
+        help="SLO-shed quota per scheduler step — paces load-shedding "
+        "so one breached window can't empty the queue",
+    )
+    p.add_argument(
+        "--backpressure-engage-blocks", type=int, default=None,
+        help="pause intake when arena blocks_free <= this (pair with "
+        "--backpressure-release-blocks; needs --priority-classes)",
+    )
+    p.add_argument(
+        "--backpressure-release-blocks", type=int, default=None,
+        help="resume intake only once blocks_free > this (must exceed "
+        "the engage threshold — the hysteresis band)",
+    )
+    p.add_argument(
+        "--backpressure-engage-queue", type=int, default=None,
+        help="pause intake when scheduler queue depth >= this (pair "
+        "with --backpressure-release-queue)",
+    )
+    p.add_argument(
+        "--backpressure-release-queue", type=int, default=None,
+        help="resume intake only once queue depth < this (must be "
+        "below the engage threshold)",
+    )
+    p.add_argument(
+        "--fleet-file", default=None,
+        help="autoscale controller's fleet_size.json: poll it and "
+        "mirror membership transitions into this replica's "
+        "serve/fleet_size + serve/scale_up|down metrics",
     )
     p.add_argument(
         "--stall-prefill-ms", type=float, default=0.0,

@@ -218,6 +218,33 @@ SERVE_FLEET_PREFIX_MISSES = "serve/fleet_prefix_misses"  # counter
 # calls the other program never compiles it).
 SERVE_COMPILED_PREFILL = "serve/compiled_prefill"  # gauge
 SERVE_COMPILED_DECODE = "serve/compiled_decode"  # gauge
+# Overload protection (ISSUE 19; serving/admission.py wired through the
+# scheduler).  SUBMITTED / SHED are per-priority-class families keyed
+# ``serve/submitted/<class>`` and ``serve/shed/<class>`` — submitted
+# counts intake by class, shed counts requests answered with
+# ``finish_reason="shed"`` (a shed is a RESPONSE, never a silent drop,
+# so submitted − shed − live = streams actually served).  Both families
+# are pre-created per configured class when an AdmissionPolicy is
+# attached and absent otherwise (full-set-or-absent, class-name-paired
+# like the slo_* families; enforced by check_metrics_schema
+# --serving-report).  BACKPRESSURE is the intake gate's live state
+# (0/1) and BACKPRESSURE_ENGAGED its engage-episode counter
+# (transitions, not samples — a 10 s pause is one episode), created
+# with the admission family.
+SERVE_SUBMITTED = "serve/submitted"  # counter family: /<class>
+SERVE_SHED = "serve/shed"  # counter family: /<class>
+SERVE_BACKPRESSURE = "serve/backpressure"  # gauge (0/1)
+SERVE_BACKPRESSURE_ENGAGED = "serve/backpressure_engaged"  # counter
+# Closed-loop autoscale (ISSUE 19; launch.py::FleetAutoscaler writes
+# fleet_size.json + scale_events.jsonl, each replica mirrors what it
+# observes).  FLEET_SIZE is the replica-observed live fleet size;
+# SCALE_UP / SCALE_DOWN count observed membership transitions.  The
+# trio exists only when the server was pointed at a controller-managed
+# fleet file (--fleet-file) — full-set-or-absent, mirroring the spec_*
+# contract.
+SERVE_FLEET_SIZE = "serve/fleet_size"  # gauge
+SERVE_SCALE_UP = "serve/scale_up"  # counter
+SERVE_SCALE_DOWN = "serve/scale_down"  # counter
 
 
 class Counter:

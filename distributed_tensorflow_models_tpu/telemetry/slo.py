@@ -163,7 +163,10 @@ class RollingWindow:
 
 
 class _SLOState:
-    __slots__ = ("spec", "window", "breached", "breach_streak", "ok_streak")
+    __slots__ = (
+        "spec", "window", "breached", "breach_streak", "ok_streak",
+        "last_margin",
+    )
 
     def __init__(self, spec: SLOSpec, max_samples: int):
         self.spec = spec
@@ -171,6 +174,7 @@ class _SLOState:
         self.breached = False
         self.breach_streak = 0
         self.ok_streak = 0
+        self.last_margin = spec.threshold  # pre-eval: full headroom
 
 
 class SLOMonitor:
@@ -273,6 +277,7 @@ class SLOMonitor:
             margin = (
                 spec.threshold if observed is None else spec.threshold - observed
             )
+            state.last_margin = margin
             self.registry.gauge(f"{reglib.SERVE_SLO_MARGIN}/{spec.name}").set(margin)
             breaching = observed is not None and observed > spec.threshold
             if breaching:
@@ -303,3 +308,10 @@ class SLOMonitor:
     def breached(self) -> Tuple[str, ...]:
         """Names of SLOs currently in breach state."""
         return tuple(s.spec.name for s in self._states if s.breached)
+
+    def margins(self) -> Dict[str, float]:
+        """Last evaluated margin (threshold − observed) per SLO name —
+        the headroom signal admission shedding and the fleet autoscaler
+        consume without re-sorting any window (negative = out of SLO,
+        and how negative is how far out)."""
+        return {s.spec.name: s.last_margin for s in self._states}

@@ -336,6 +336,23 @@ SERVING_ROLES = ("monolithic", "prefill", "decode")
 # must pin (n, 0), a decode replica (0, n)), so both gauges are part of
 # the unconditional full set.
 SERVING_COMPILED_GAUGES = ("serve/compiled_prefill", "serve/compiled_decode")
+# Admission / overload keys (serving/admission.py + the scheduler):
+# present ONLY when the scheduler ran with an AdmissionPolicy, which
+# pre-creates serve/submitted/<class> AND serve/shed/<class> for every
+# configured class — so the contract is name-paired full-set-or-absent,
+# exactly like the SLO family.  The backpressure gauge and its engage
+# counter are likewise a pair, and only ever appear on an
+# admission-enabled report (the gate rides on the admission scheduler).
+SERVING_SUBMITTED_PREFIX = "serve/submitted/"
+SERVING_SHED_PREFIX = "serve/shed/"
+SERVING_BACKPRESSURE_GAUGE = "serve/backpressure"
+SERVING_BACKPRESSURE_ENGAGED = "serve/backpressure_engaged"
+# Autoscale keys: a replica started with --fleet-file pre-creates the
+# whole trio and mirrors the controller's fleet_size.json transitions
+# into it; fleets without a scale controller report none of them.
+SERVING_SCALE_KEYS = (
+    "serve/fleet_size", "serve/scale_up", "serve/scale_down",
+)
 
 
 def check_serving_report(report) -> list[str]:
@@ -463,6 +480,62 @@ def check_serving_report(report) -> list[str]:
                 f"SLO {name!r} has a margin gauge but no "
                 f"serve/slo_breach/{name} counter"
             )
+    # Admission section: submitted/shed class names must pair up (the
+    # policy pre-creates both counters per configured class; a widowed
+    # class key is a writer regression, never light load).
+    sub_names = {
+        k[len(SERVING_SUBMITTED_PREFIX):]
+        for k in snap
+        if k.startswith(SERVING_SUBMITTED_PREFIX)
+    }
+    shed_names = {
+        k[len(SERVING_SHED_PREFIX):]
+        for k in snap
+        if k.startswith(SERVING_SHED_PREFIX)
+    }
+    for name in sorted(sub_names - shed_names):
+        errors.append(
+            f"priority class {name!r} has a submitted counter but no "
+            f"{SERVING_SHED_PREFIX}{name} counter"
+        )
+    for name in sorted(shed_names - sub_names):
+        errors.append(
+            f"priority class {name!r} has a shed counter but no "
+            f"{SERVING_SUBMITTED_PREFIX}{name} counter"
+        )
+    # Backpressure: gauge + engage counter together, and only on an
+    # admission-enabled report; the gauge is binary.
+    has_bp_gauge = SERVING_BACKPRESSURE_GAUGE in snap
+    has_bp_counter = SERVING_BACKPRESSURE_ENGAGED in snap
+    if has_bp_gauge != has_bp_counter:
+        errors.append(
+            f"backpressure keys must appear together: "
+            f"{SERVING_BACKPRESSURE_GAUGE!r} "
+            f"{'present' if has_bp_gauge else 'missing'}, "
+            f"{SERVING_BACKPRESSURE_ENGAGED!r} "
+            f"{'present' if has_bp_counter else 'missing'}"
+        )
+    if has_bp_gauge and not sub_names:
+        errors.append(
+            "backpressure keys present without any "
+            "serve/submitted/<class> counters (the gate rides on an "
+            "admission-enabled scheduler)"
+        )
+    if has_bp_gauge and snap.get(SERVING_BACKPRESSURE_GAUGE) not in (
+        0, 0.0, 1, 1.0
+    ):
+        errors.append(
+            f"backpressure gauge must be 0 or 1, got "
+            f"{snap.get(SERVING_BACKPRESSURE_GAUGE)!r}"
+        )
+    # Autoscale section: the fleet_size gauge and both scale counters
+    # are pre-created together by --fleet-file — full trio or none.
+    scale_present = [k for k in SERVING_SCALE_KEYS if k in snap]
+    if scale_present and len(scale_present) != len(SERVING_SCALE_KEYS):
+        errors.append(
+            f"partial autoscale key set {scale_present} "
+            f"(expected all of {list(SERVING_SCALE_KEYS)} together)"
+        )
     return errors
 
 

@@ -48,6 +48,27 @@ while the fleet runs):
   drain correctness on the decode role, zero dropped or duplicated
   responses, streams byte-identical to D1's.
 
+Overload arms (``--no-overload`` skips) certify admission control
+under deliberate overload (a prefill stall behind an unmeetable
+queue-depth SLO): every shed request must still be ANSWERED — a real
+``finish_reason="shed"`` response, never a silent drop — sheds must
+take the lowest priority class first, and the TTFT SLO the shedding
+protects must verdict PASS in the very report whose queue-depth SLO
+reads FAIL.  A backpressure arm re-runs the burst with the queue-depth
+gate on instead: intake must PAUSE (engage episodes counted in the
+stats) and every request is still served in full, exactly once.
+
+The autoscale arm (``--no-autoscale`` skips) drives a 1-replica fleet
+through a bursty spike-then-trickle trace under a closed-loop
+:class:`~distributed_tensorflow_models_tpu.launch.FleetAutoscaler`:
+the spike must recruit a replica, the lull must drain one mid-stream
+(SIGTERM → drain → exit 0), every scale decision leaves a
+``scale_events.jsonl`` row plus a ``flight_autoscale_<k>.json`` dump,
+the replicas mirror the fleet-size transitions into their own stats,
+and every surviving stream is byte-identical to an unresized reference
+run of the same trace — scaling is a capacity knob, never a token
+knob.
+
 The parent process never imports jax (safe on a login host); all device
 work happens in the spawned replicas.  Exit 0 when every check passes.
 
@@ -73,6 +94,7 @@ if _REPO not in sys.path:  # runnable as a script from anywhere
     sys.path.insert(0, _REPO)
 
 from distributed_tensorflow_models_tpu import launch  # noqa: E402
+from distributed_tensorflow_models_tpu.serving import admission as admlib  # noqa: E402
 from distributed_tensorflow_models_tpu.serving import replay as replaylib  # noqa: E402
 
 PORT = 9871
@@ -443,10 +465,13 @@ def _fleet_trace(n_pairs: int) -> list[list]:
     return [first, dup]
 
 
-def _pace(queue_dir: str, phases: list[list]) -> None:
+def _pace(queue_dir: str, phases: list[list],
+          reports: list | None = None) -> None:
     """Parent-thread replayer: emit each phase open-loop while
     launch_local blocks on the fleet, waiting for the previous phase's
-    responses between phases, then publish DONE."""
+    responses between phases, then publish DONE.  Each phase's
+    :class:`~...serving.replay.ReplayReport` lands in ``reports`` (when
+    given) so the arm can surface offered-vs-achieved pacing."""
     resp_dir = os.path.join(queue_dir, "resp")
     for i, phase in enumerate(phases):
         if i:
@@ -461,9 +486,11 @@ def _pace(queue_dir: str, phases: list[list]) -> None:
                 if want <= have:
                     break
                 time.sleep(0.05)
-        replaylib.replay(
+        rep = replaylib.replay(
             phase, lambda r: replaylib.write_request(queue_dir, r)
         )
+        if reports is not None:
+            reports.append(rep)
     done = os.path.join(queue_dir, "DONE")
     with open(done + ".tmp", "w") as f:
         f.write("done\n")
@@ -765,6 +792,536 @@ def check_disagg_report(
     return errors
 
 
+# -- overload / backpressure / autoscale arms ------------------------------
+# The overload arm's shed driver is a deliberately unmeetable
+# queue-depth SLO: the claim-ahead window (2 * max-slots) keeps ~4
+# waiters queued behind 1s prefill-stall waves, so depth-p50 sits well
+# above 1 and the breach latches early and for the whole run.  The
+# TTFT SLO is the one shedding PROTECTS — generous enough that every
+# ADMITTED request meets it even on the stalled replica — so the same
+# report must show qdepth FAIL and ttft PASS.  Warmup 4 skips exactly
+# the first prefill wave's samples on both keys (compile time).
+OVERLOAD_CLASSES = ("batch", "standard", "interactive")
+OVERLOAD_STALL_MS = 1000.0
+OVERLOAD_DEADLINES = 4  # trailing batch requests carry a 10ms deadline
+OVERLOAD_ARGV = (
+    "--stall-prefill-ms", str(OVERLOAD_STALL_MS),
+    "--priority-classes", ",".join(OVERLOAD_CLASSES),
+    "--shed-on-slo", "qdepth",
+    "--max-shed-per-step", "1",
+    "--slo", "qdepth=serve/queue_depth:p50<1@60s",
+    "--slo", "ttft=serve/ttft_s:p99<30@60s",
+    "--slo-warmup", "4",
+    "--slo-breach-after", "1",
+    "--timeseries-interval-s", "0.5",
+)
+BACKPRESSURE_ARGV = (
+    "--stall-prefill-ms", "300",
+    "--priority-classes", ",".join(OVERLOAD_CLASSES),
+    "--backpressure-engage-queue", "3",
+    "--backpressure-release-queue", "1",
+)
+AUTOSCALE_SPIKE = 20
+AUTOSCALE_TRICKLE = 10
+
+
+def _fleet_env() -> dict[str, str]:
+    return {
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
+            "PYTHONPATH", ""
+        ),
+    }
+
+
+def _audit_exactly_once(
+    queue_dir: str, specs: dict[int, dict], errors: list[str], label: str
+) -> dict[int, dict]:
+    """Shared claim/response ledger: every request claimed exactly once
+    and answered exactly once.  Returns responses by request_id."""
+    claimed_dir = os.path.join(queue_dir, "claimed")
+    claims: dict[int, list[str]] = {}
+    for name in (
+        os.listdir(claimed_dir) if os.path.isdir(claimed_dir) else []
+    ):
+        rid = int(name.split("-")[1].split(".")[0])
+        claims.setdefault(rid, []).append(name)
+    for rid, names in sorted(claims.items()):
+        if len(names) > 1:
+            errors.append(f"{label}: request {rid} claimed twice: {names}")
+    unclaimed = [
+        n for n in os.listdir(queue_dir)
+        if n.startswith("req-") and n.endswith(".json")
+    ]
+    if unclaimed:
+        errors.append(
+            f"{label}: requests never claimed: {sorted(unclaimed)}"
+        )
+    resp_dir = os.path.join(queue_dir, "resp")
+    responses: dict[int, dict] = {}
+    for name in os.listdir(resp_dir) if os.path.isdir(resp_dir) else []:
+        if name.endswith(".json"):
+            with open(os.path.join(resp_dir, name)) as f:
+                responses[int(name.split("-")[1].split(".")[0])] = (
+                    json.load(f)
+                )
+    missing = sorted(set(specs) - set(responses))
+    extra = sorted(set(responses) - set(specs))
+    if missing:
+        errors.append(
+            f"{label}: dropped responses (work lost): {missing}"
+        )
+    if extra:
+        errors.append(f"{label}: responses for unknown requests: {extra}")
+    return responses
+
+
+def _overload_trace(n: int) -> list:
+    """Pre-queued burst with a lowest-class-heavy mix: classes cycle
+    batch, standard, batch, interactive — half the offered load is
+    sheddable before anything standard-class is touched.  The LAST
+    ``OVERLOAD_DEADLINES`` batch requests carry a 10ms TTFT deadline:
+    claimed mid-run behind the stall waves, they are guaranteed
+    deadline sheds riding alongside the SLO-driven ones."""
+    cycle = ("batch", "standard", "batch", "interactive")
+    reqs = replaylib.preset_trace("uniform", n, seed=23)
+    for i, r in enumerate(reqs):
+        r.priority = cycle[i % len(cycle)]
+    left = OVERLOAD_DEADLINES
+    for r in reversed(reqs):
+        if left and r.priority == "batch":
+            r.deadline_s = 0.01
+            left -= 1
+    return reqs
+
+
+def run_overload_arm(scratch: str, n: int, *, port: int) -> list[str]:
+    """Deliberate overload against a 1-replica admission-enabled fleet:
+    every shed request still gets a response, sheds take the lowest
+    class first, per-class counters balance the response-side ledger,
+    and the protected TTFT SLO verdicts PASS while the shed-driving
+    queue-depth SLO verdicts FAIL."""
+    errors: list[str] = []
+    queue_dir = os.path.join(scratch, "queue")
+    workdir = os.path.join(scratch, "wd")
+    os.makedirs(queue_dir, exist_ok=True)
+    os.makedirs(workdir, exist_ok=True)
+    trace = _overload_trace(n)
+    specs = {r.request_id: r.spec() for r in trace}
+    for r in trace:
+        replaylib.write_request(queue_dir, r)
+    with open(os.path.join(queue_dir, "DONE"), "w") as f:
+        f.write("done\n")
+
+    argv = [
+        sys.executable, "-m",
+        "distributed_tensorflow_models_tpu.serving.server",
+        "--queue-dir", queue_dir, "--workdir", workdir,
+        "--max-slots", "4", "--prefill-chunk", "8",
+        "--drain-grace-s", "60",
+        "--timeout", "240",
+    ] + list(OVERLOAD_ARGV)
+    codes = launch.launch_local(
+        1, argv, port=port, timeout=420.0, extra_env=_fleet_env()
+    )
+    if launch.aggregate_exit_codes(codes) != 0:
+        errors.append(f"overload: fleet exit codes {codes}")
+
+    responses = _audit_exactly_once(queue_dir, specs, errors, "overload")
+    shed = {
+        rid: r for rid, r in responses.items()
+        if r.get("finish_reason") == "shed"
+    }
+    served = {rid: r for rid, r in responses.items() if rid not in shed}
+    for rid, resp in sorted(shed.items()):
+        if resp["tokens"]:
+            errors.append(
+                f"overload: shed request {rid} carries tokens "
+                f"{resp['tokens']} — a shed response is an empty stream"
+            )
+    for rid, resp in sorted(served.items()):
+        want = specs[rid]["max_new_tokens"]
+        if len(resp["tokens"]) != want:
+            errors.append(
+                f"overload: request {rid}: {len(resp['tokens'])} tokens, "
+                f"expected {want}"
+            )
+    if not shed:
+        errors.append(
+            "overload: nothing shed — the arm never actually overloaded"
+        )
+    if not served:
+        errors.append(
+            "overload: everything shed — no admitted traffic to protect"
+        )
+
+    shed_by_class: dict[str, int] = {}
+    for rid in shed:
+        cls = specs[rid].get("priority") or "standard"
+        shed_by_class[cls] = shed_by_class.get(cls, 0) + 1
+    class_totals: dict[str, int] = {}
+    for spec in specs.values():
+        cls = spec.get("priority") or "standard"
+        class_totals[cls] = class_totals.get(cls, 0) + 1
+    print(
+        f"  overload: {len(shed)} shed / {len(served)} served, "
+        f"sheds by class {shed_by_class}"
+    )
+    if shed_by_class.get("batch", 0) < 1:
+        errors.append(
+            "overload: no batch-class shed — the lowest class sheds first"
+        )
+    if shed_by_class.get("interactive", 0) > shed_by_class.get("batch", 0):
+        errors.append(
+            f"overload: interactive shed more than batch "
+            f"({shed_by_class}) — priority order inverted"
+        )
+
+    stats_path = os.path.join(workdir, "serving_stats_p0.json")
+    for path, flag in (
+        (os.path.join(workdir, "flight_recorder_p0.json"),
+         "--flight-recorder"),
+        (stats_path, "--serving-report"),
+        (os.path.join(workdir, "timeseries_p0.jsonl"), "--timeseries"),
+    ):
+        if not os.path.exists(path):
+            errors.append(f"overload: missing artifact {path}")
+        else:
+            _schema_check(path, flag, errors)
+    if os.path.exists(stats_path):
+        with open(stats_path) as f:
+            snap = json.load(f)["metrics"]
+        # Counters mirror the response-side ledger exactly: shed +
+        # served == answered, per class.
+        for cls in OVERLOAD_CLASSES:
+            got = snap.get(f"serve/shed/{cls}", 0.0)
+            if int(got) != shed_by_class.get(cls, 0):
+                errors.append(
+                    f"overload: serve/shed/{cls} counter {got:g} != "
+                    f"{shed_by_class.get(cls, 0)} shed responses"
+                )
+            got = snap.get(f"serve/submitted/{cls}", 0.0)
+            if int(got) != class_totals.get(cls, 0):
+                errors.append(
+                    f"overload: serve/submitted/{cls} counter {got:g} != "
+                    f"{class_totals.get(cls, 0)} requests of that class"
+                )
+
+    report_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "serving_report.py")
+    proc = subprocess.run(
+        [sys.executable, report_py, workdir, "--json"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        errors.append(f"overload: serving_report failed: {proc.stderr}")
+        return errors
+    report = json.loads(proc.stdout)
+    verdicts = {row["slo"]: row["verdict"] for row in report["slo"]}
+    if verdicts.get("qdepth") != "FAIL":
+        errors.append(
+            f"overload: queue-depth SLO verdict "
+            f"{verdicts.get('qdepth')!r}, expected FAIL (the shed driver)"
+        )
+    if verdicts.get("ttft") != "PASS":
+        errors.append(
+            f"overload: TTFT SLO verdict {verdicts.get('ttft')!r}, "
+            "expected PASS — shedding failed to protect admitted traffic"
+        )
+    rows = {
+        r["class"]: r
+        for r in report.get("admission", {}).get("classes", [])
+        if int(r["proc"]) == 0
+    }
+    if set(rows) != set(OVERLOAD_CLASSES):
+        errors.append(
+            f"overload: report admission table has classes "
+            f"{sorted(rows)}, expected {sorted(OVERLOAD_CLASSES)}"
+        )
+    return errors
+
+
+def run_backpressure_arm(scratch: str, n: int, *, port: int) -> list[str]:
+    """The same style of burst with the queue-depth backpressure gate
+    on and NO shed policy: intake must pause (engage episodes counted)
+    instead of shedding, and every request is still answered in full,
+    exactly once — backpressure defers work, it never discards it."""
+    errors: list[str] = []
+    queue_dir = os.path.join(scratch, "queue")
+    workdir = os.path.join(scratch, "wd")
+    os.makedirs(queue_dir, exist_ok=True)
+    os.makedirs(workdir, exist_ok=True)
+    trace = replaylib.preset_trace("uniform", n, seed=27)
+    specs = {r.request_id: r.spec() for r in trace}
+    for r in trace:
+        replaylib.write_request(queue_dir, r)
+    with open(os.path.join(queue_dir, "DONE"), "w") as f:
+        f.write("done\n")
+
+    argv = [
+        sys.executable, "-m",
+        "distributed_tensorflow_models_tpu.serving.server",
+        "--queue-dir", queue_dir, "--workdir", workdir,
+        "--max-slots", "4", "--prefill-chunk", "8",
+        "--drain-grace-s", "60",
+        "--timeout", "240",
+    ] + list(BACKPRESSURE_ARGV)
+    codes = launch.launch_local(
+        1, argv, port=port, timeout=420.0, extra_env=_fleet_env()
+    )
+    if launch.aggregate_exit_codes(codes) != 0:
+        errors.append(f"backpressure: fleet exit codes {codes}")
+
+    responses = _audit_exactly_once(
+        queue_dir, specs, errors, "backpressure"
+    )
+    for rid, resp in sorted(responses.items()):
+        want = specs[rid]["max_new_tokens"]
+        if resp.get("finish_reason") == "shed":
+            errors.append(
+                f"backpressure: request {rid} shed — the gate must "
+                "defer intake, never shed (no shed policy configured)"
+            )
+        elif len(resp["tokens"]) != want:
+            errors.append(
+                f"backpressure: request {rid}: {len(resp['tokens'])} "
+                f"tokens, expected {want}"
+            )
+
+    stats_path = os.path.join(workdir, "serving_stats_p0.json")
+    if not os.path.exists(stats_path):
+        errors.append(f"backpressure: missing artifact {stats_path}")
+        return errors
+    _schema_check(stats_path, "--serving-report", errors)
+    with open(stats_path) as f:
+        snap = json.load(f)["metrics"]
+    episodes = snap.get("serve/backpressure_engaged", 0.0)
+    print(f"  backpressure: {episodes:g} engage episode(s)")
+    if episodes < 1:
+        errors.append(
+            "backpressure: gate never engaged — the burst should have "
+            "crossed the depth-3 engage threshold"
+        )
+    if snap.get("serve/backpressure") != 0.0:
+        errors.append(
+            f"backpressure: gauge {snap.get('serve/backpressure')!r} at "
+            "drain, expected 0.0 (released once the queue emptied)"
+        )
+    shed_total = sum(
+        v for k, v in snap.items() if k.startswith("serve/shed/")
+    )
+    if shed_total:
+        errors.append(
+            f"backpressure: {shed_total:g} sheds counted with no shed "
+            "policy configured"
+        )
+    return errors
+
+
+def _autoscale_phases() -> list[list]:
+    """Bursty two-phase autoscale trace: a dense spike (backlog far
+    above the policy's up threshold, recruiting a replica) then a
+    sparse trickle long enough for the down-streak to drain one
+    mid-stream.  The pacer gates the trickle on the spike's responses,
+    so the lull the controller sees is a real lull."""
+    spike = replaylib.preset_trace("uniform", AUTOSCALE_SPIKE, seed=29)
+    replaylib.stamp_arrivals(spike, replaylib.bursty_arrivals(
+        AUTOSCALE_SPIKE, seed=290, lull_gap_s=0.4, spike_gap_s=0.015,
+        lull_s=0.5, spike_s=60.0,
+    ))
+    trickle = replaylib.preset_trace(
+        "uniform", AUTOSCALE_TRICKLE, seed=31, first_id=AUTOSCALE_SPIKE
+    )
+    replaylib.stamp_arrivals(trickle, replaylib.open_loop_arrivals(
+        AUTOSCALE_TRICKLE, seed=310, mean_gap_s=1.0,
+    ))
+    return [spike, trickle]
+
+
+def run_autoscale_arm(
+    scratch: str, *, port: int, controller_on: bool
+) -> tuple[list[str], dict[int, dict]]:
+    """One paced spike + trickle run.  With ``controller_on`` a
+    FleetAutoscaler resizes the fleet mid-stream (scale-up AND
+    scale-down asserted, each with its forensic trail); without it the
+    run is the unresized byte-identity reference."""
+    errors: list[str] = []
+    label = "autoscale" if controller_on else "autoscale-ref"
+    queue_dir = os.path.join(scratch, "queue")
+    workdir = os.path.join(scratch, "wd")
+    os.makedirs(queue_dir, exist_ok=True)
+    os.makedirs(workdir, exist_ok=True)
+    phases = _autoscale_phases()
+    reqs = [r for phase in phases for r in phase]
+    specs = {r.request_id: r.spec() for r in reqs}
+
+    reports: list = []
+    pacer = threading.Thread(
+        target=_pace, args=(queue_dir, phases, reports), daemon=True
+    )
+    pacer.start()
+    argv = [
+        sys.executable, "-m",
+        "distributed_tensorflow_models_tpu.serving.server",
+        "--queue-dir", queue_dir, "--workdir", workdir,
+        "--max-slots", "4", "--prefill-chunk", "8",
+        "--drain-grace-s", "60",
+        "--timeseries-interval-s", "0.25",
+        "--timeout", "240",
+    ]
+    controller = None
+    if controller_on:
+        argv += ["--fleet-file", os.path.join(workdir, "fleet_size.json")]
+        controller = launch.FleetAutoscaler(
+            workdir, queue_dir=queue_dir, poll_interval_s=0.3,
+            policy=admlib.AutoscalePolicy(
+                min_replicas=1, max_replicas=2,
+                up_backlog=3.0, down_backlog=1.0,
+                up_after=2, down_after=4, cooldown=8,
+            ),
+        )
+    try:
+        codes = launch.launch_local(
+            1, argv, port=port, timeout=420.0, extra_env=_fleet_env(),
+            scale_controller=controller,
+        )
+    finally:
+        pacer.join(timeout=60)
+    if pacer.is_alive():
+        errors.append(f"{label}: replayer still pacing after fleet exit")
+    if launch.aggregate_exit_codes(codes) != 0:
+        errors.append(
+            f"{label}: fleet exit codes {codes} (a drained victim must "
+            "exit 0)"
+        )
+
+    responses = _audit_exactly_once(queue_dir, specs, errors, label)
+    for rid, resp in sorted(responses.items()):
+        want = specs[rid]["max_new_tokens"]
+        if len(resp["tokens"]) != want:
+            errors.append(
+                f"{label}: request {rid}: {len(resp['tokens'])} tokens, "
+                f"expected {want}"
+            )
+    for rep in reports:
+        print(
+            f"  {label} pacing: offered {rep.offered_qps:.1f} qps, "
+            f"achieved {rep.achieved_qps:.1f} qps, "
+            f"error {rep.pacing_error * 100:+.1f}%"
+        )
+    if not controller_on:
+        return errors, responses
+
+    # -- scale-event forensics ---------------------------------------------
+    events: list[dict] = []
+    ev_path = os.path.join(workdir, "scale_events.jsonl")
+    if os.path.exists(ev_path):
+        with open(ev_path) as f:
+            for line in f:
+                if line.strip():
+                    events.append(json.loads(line))
+    ups = [e for e in events if e["event"] == "scale_up"]
+    downs = [e for e in events if e["event"] == "scale_down"]
+    by_replica: dict[int, int] = {}
+    for resp in responses.values():
+        by_replica[resp["replica"]] = by_replica.get(resp["replica"], 0) + 1
+    print(
+        f"  autoscale: {len(ups)} scale_up / {len(downs)} scale_down, "
+        f"responses by replica {by_replica}"
+    )
+    if not ups:
+        errors.append(
+            "autoscale: the spike never recruited a replica "
+            "(no scale_up event)"
+        )
+    if not downs:
+        errors.append(
+            "autoscale: the lull never drained a replica "
+            "(no scale_down event)"
+        )
+    if controller.events != len(events):
+        errors.append(
+            f"autoscale: controller counted {controller.events} events, "
+            f"the journal has {len(events)}"
+        )
+    for k in range(len(events)):
+        path = os.path.join(workdir, f"flight_autoscale_{k}.json")
+        if not os.path.exists(path):
+            errors.append(
+                f"autoscale: scale event {k} left no flight record"
+            )
+        else:
+            _schema_check(path, "--flight-recorder", errors)
+    if ups and not any(i >= 1 and n > 0 for i, n in by_replica.items()):
+        errors.append(
+            "autoscale: the recruited replica served nothing — the "
+            "scale-up added no capacity"
+        )
+
+    # Every replica ever spawned (initial + one per scale_up) drained
+    # cleanly enough to leave schema-valid artifacts.
+    for i in range(1 + len(ups)):
+        for path, flag in (
+            (os.path.join(workdir, f"flight_recorder_p{i}.json"),
+             "--flight-recorder"),
+            (os.path.join(workdir, f"serving_stats_p{i}.json"),
+             "--serving-report"),
+            (os.path.join(workdir, f"timeseries_p{i}.jsonl"),
+             "--timeseries"),
+        ):
+            if not os.path.exists(path):
+                errors.append(f"autoscale: missing artifact {path}")
+            else:
+                _schema_check(path, flag, errors)
+
+    # Replica 0 outlives both membership changes and must have mirrored
+    # them off the fleet file into its own registry.
+    stats_path = os.path.join(workdir, "serving_stats_p0.json")
+    if os.path.exists(stats_path):
+        with open(stats_path) as f:
+            snap = json.load(f)["metrics"]
+        if snap.get("serve/scale_up", 0.0) < 1:
+            errors.append(
+                "autoscale: replica 0 never mirrored the scale-up "
+                "(serve/scale_up counter is zero)"
+            )
+        if snap.get("serve/scale_down", 0.0) < 1:
+            errors.append(
+                "autoscale: replica 0 never mirrored the scale-down "
+                "(serve/scale_down counter is zero)"
+            )
+        if snap.get("serve/fleet_size") != 1.0:
+            errors.append(
+                f"autoscale: serve/fleet_size gauge "
+                f"{snap.get('serve/fleet_size')!r} at drain, expected "
+                "1.0 after the lull's scale-down"
+            )
+
+    # The report renders the scale timeline against throughput.
+    report_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "serving_report.py")
+    proc = subprocess.run(
+        [sys.executable, report_py, workdir, "--json"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        errors.append(f"autoscale: serving_report failed: {proc.stderr}")
+        return errors, responses
+    report = json.loads(proc.stdout)
+    timeline = report.get("scale_events", [])
+    if len(timeline) != len(events):
+        errors.append(
+            f"autoscale: report timeline has {len(timeline)} scale "
+            f"events, the journal has {len(events)}"
+        )
+    if any("t_rel_s" not in e for e in timeline):
+        errors.append(
+            "autoscale: report scale events missing the t_rel_s "
+            "throughput correlation stamp"
+        )
+    return errors, responses
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=24)
@@ -793,6 +1350,15 @@ def main(argv=None) -> int:
     p.add_argument(
         "--no-disagg", action="store_true",
         help="skip the disaggregated prefill/decode arms (D1-D3)",
+    )
+    p.add_argument(
+        "--no-overload", action="store_true",
+        help="skip the overload arms (priority shedding + backpressure)",
+    )
+    p.add_argument(
+        "--no-autoscale", action="store_true",
+        help="skip the closed-loop autoscale arm and its unresized "
+        "byte-identity reference run",
     )
     args = p.parse_args(argv)
 
@@ -974,6 +1540,55 @@ def main(argv=None) -> int:
                         f"request {rid}: stream changed under decode "
                         f"failover: {d3_resp[rid]['tokens']} vs "
                         f"{d1_resp[rid]['tokens']}"
+                    )
+        if not args.no_overload:
+            # Overload arm: deliberate overload (stall + unmeetable
+            # queue-depth SLO) must shed lowest-class requests as REAL
+            # responses while the protected TTFT SLO stays PASS;
+            # the backpressure arm must instead pause intake and still
+            # answer everything in full.
+            print(
+                f"  overload arm: {OVERLOAD_STALL_MS:.0f}ms stall, "
+                f"classes {','.join(OVERLOAD_CLASSES)}, shed on qdepth"
+            )
+            errors += run_overload_arm(
+                os.path.join(scratch, "overload"), args.requests,
+                port=PORT + 70,
+            )
+            print("  backpressure arm: queue gate engage 3 / release 1")
+            errors += run_backpressure_arm(
+                os.path.join(scratch, "backpressure"), 16,
+                port=PORT + 75,
+            )
+        if not args.no_autoscale:
+            # Autoscale arm: the spike must recruit a replica and the
+            # lull must drain one mid-stream, with full forensics and
+            # zero dropped/duplicated responses; every stream must be
+            # byte-identical to the unresized reference run.
+            print(
+                f"  autoscale arm: {AUTOSCALE_SPIKE}-request spike + "
+                f"{AUTOSCALE_TRICKLE}-request trickle, fleet 1 <-> 2"
+            )
+            auto_errors, auto_resp = run_autoscale_arm(
+                os.path.join(scratch, "autoscale"), port=PORT + 80,
+                controller_on=True,
+            )
+            errors += auto_errors
+            print(
+                "  autoscale reference: unresized 1-replica fleet, "
+                "same trace"
+            )
+            ref_errors, ref_resp = run_autoscale_arm(
+                os.path.join(scratch, "autoscale-ref"), port=PORT + 84,
+                controller_on=False,
+            )
+            errors += ref_errors
+            for rid in sorted(set(auto_resp) & set(ref_resp)):
+                if auto_resp[rid]["tokens"] != ref_resp[rid]["tokens"]:
+                    errors.append(
+                        f"request {rid}: stream changed across the "
+                        f"resize: {auto_resp[rid]['tokens']} vs "
+                        f"{ref_resp[rid]['tokens']}"
                     )
         failed = bool(errors)
         if errors:

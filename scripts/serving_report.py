@@ -6,6 +6,12 @@ the directory holding ``flight_recorder_p*.json``,
 ``serving_stats_p*.json``, and optionally ``timeseries_p*.jsonl`` — and
 it answers the three production questions:
 
+0. **Who was shed, and did shedding work?**  A per-priority-class
+   admission table (``serve/submitted/<class>`` vs ``serve/shed/<class>``
+   counters per replica, plus backpressure engage episodes) and the
+   autoscale timeline: every ``scale_events.jsonl`` decision with the
+   gauge values that triggered it, time-aligned against the throughput
+   timeline so a recruit shows up next to the spike it answered.
 1. **Where did each request's latency go?**  Per-request waterfalls
    rebuilt from the scheduler's ``serve/req/*`` lifecycle events
    (grouped by ``args["rid"]``): queue-wait, prefill (with prefix-cache
@@ -61,6 +67,10 @@ BREACH_INSTANT = "serve/slo_breach"
 RECOVERY_INSTANT = "serve/slo_recovered"
 SLO_BREACH_PREFIX = "serve/slo_breach/"
 SLO_MARGIN_PREFIX = "serve/slo_margin/"
+SUBMITTED_PREFIX = "serve/submitted/"
+SHED_PREFIX = "serve/shed/"
+BACKPRESSURE_GAUGE = "serve/backpressure"
+BACKPRESSURE_ENGAGED = "serve/backpressure_engaged"
 
 # |queue + prefill − ttft| must close within this (absolute floor;
 # scaled tolerance below for long requests).
@@ -108,6 +118,80 @@ def load_timeseries(workdir: str) -> dict[int, list]:
             print(f"warning: unreadable {path}: {e}", file=sys.stderr)
             continue
         out[int(m.group(1))] = rows
+    return out
+
+
+def load_scale_events(workdir: str) -> list[dict]:
+    """Autoscale decisions from ``scale_events.jsonl`` (the
+    ``launch.FleetAutoscaler`` trail); [] when the run never scaled."""
+    path = os.path.join(workdir, "scale_events.jsonl")
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    print(
+                        f"warning: skipping torn row in {path}",
+                        file=sys.stderr,
+                    )
+    except OSError:
+        return []
+    return events
+
+
+def admission_summary(stats: dict[int, dict]) -> dict:
+    """Per-replica, per-priority-class submitted/shed counts plus the
+    backpressure state, from the stats reports' admission family
+    (absent entirely on fleets running without an admission policy)."""
+    classes: list[dict] = []
+    backpressure: dict[int, dict] = {}
+    for proc in sorted(stats):
+        metrics = stats[proc].get("metrics", {})
+        for key in sorted(metrics):
+            if not key.startswith(SUBMITTED_PREFIX):
+                continue
+            cls = key[len(SUBMITTED_PREFIX):]
+            shed = metrics.get(f"{SHED_PREFIX}{cls}", 0)
+            classes.append(
+                {
+                    "proc": proc,
+                    "class": cls,
+                    "submitted": metrics[key],
+                    "shed": shed,
+                }
+            )
+        if BACKPRESSURE_GAUGE in metrics:
+            backpressure[proc] = {
+                "engaged_now": bool(metrics[BACKPRESSURE_GAUGE]),
+                "episodes": metrics.get(BACKPRESSURE_ENGAGED, 0),
+            }
+    return {"classes": classes, "backpressure": backpressure}
+
+
+def align_scale_events(
+    scale_events: list[dict], timeseries: dict[int, list]
+) -> list[dict]:
+    """Stamp each scale event with ``t_rel_s`` — seconds since the
+    earliest timeseries row's wall clock — so the timeline reads
+    side-by-side with the throughput series (whose t also starts at
+    the run's first sample)."""
+    wall0 = None
+    for rows in timeseries.values():
+        for row in rows:
+            tw = row.get("ts_wall")
+            if tw is not None and (wall0 is None or tw < wall0):
+                wall0 = tw
+    out = []
+    for e in scale_events:
+        e = dict(e)
+        if wall0 is not None and "ts_wall" in e:
+            e["t_rel_s"] = e["ts_wall"] - wall0
+        out.append(e)
     return out
 
 
@@ -295,6 +379,7 @@ def build_report(
     procs = fleet_report.load_artifacts(workdir)
     events = fleet_report.merged_events(procs)
     stats = load_stats(workdir)
+    timeseries = load_timeseries(workdir)
     waterfalls = build_waterfalls(events, tolerance_s)
     attributed = [w for w in waterfalls if w["attributed"]]
     sheds = [e for e in events if e["name"] == REQ_SHED]
@@ -317,8 +402,12 @@ def build_report(
             {"proc": e["proc"], "t": e["t"], **(e.get("args") or {})}
             for e in sheds
         ],
+        "admission": admission_summary(stats),
+        "scale_events": align_scale_events(
+            load_scale_events(workdir), timeseries
+        ),
         "slo": slo_verdicts(stats, events),
-        "throughput": throughput_timeline(load_timeseries(workdir)),
+        "throughput": throughput_timeline(timeseries),
         "stats": {
             proc: stats[proc].get("metrics", {}) for proc in sorted(stats)
         },
@@ -389,12 +478,28 @@ def format_report(report: dict) -> str:
                 f"{w['finish_reason'] or '?':<6} {cache:>6} {ok}{shed}"
             )
     if report["sheds"]:
-        lines.append(f"sheds: {len(report['sheds'])} backpressure instant(s)")
+        lines.append(f"sheds: {len(report['sheds'])} shed instant(s)")
         for s in report["sheds"][:10]:
+            cls = f" class={s['cls']}" if s.get("cls") else ""
             lines.append(
                 f"  p{s['proc']} rid={s.get('rid')} "
-                f"reason={s.get('reason')} waiting={s.get('waiting')}"
+                f"reason={s.get('reason')}{cls} waiting={s.get('waiting')}"
             )
+    adm = report.get("admission") or {}
+    if adm.get("classes"):
+        lines.append("admission (per priority class):")
+        lines.append("  proc  class         submitted      shed")
+        for row in adm["classes"]:
+            lines.append(
+                f"  p{row['proc']}    {row['class']:<12} "
+                f"{row['submitted']:>9.0f} {row['shed']:>9.0f}"
+            )
+    for proc, bp in sorted((adm.get("backpressure") or {}).items()):
+        lines.append(
+            f"  backpressure p{proc}: {bp['episodes']:.0f} engage "
+            f"episode(s), {'ENGAGED' if bp['engaged_now'] else 'released'} "
+            "at drain"
+        )
     ship_stats = [
         (proc, m) for proc, m in sorted(report["stats"].items())
         if any(str(k).startswith("serve/ship_") for k in m)
@@ -452,6 +557,25 @@ def format_report(report: dict) -> str:
             )
     else:
         lines.append("throughput: no timeseries_p*.jsonl rows")
+    if report.get("scale_events"):
+        lines.append(
+            f"autoscale: {len(report['scale_events'])} scale event(s) "
+            "(t aligned with the throughput timeline)"
+        )
+        for e in report["scale_events"]:
+            t = (
+                f"+{e['t_rel_s']:.1f}s" if "t_rel_s" in e else "t=?"
+            )
+            breached = e.get("slo_breached") or []
+            lines.append(
+                f"  {t:>8} {e.get('event', '?'):<10} "
+                f"{e.get('from_size', '?')} -> {e.get('to_size', '?')}  "
+                f"backlog={e.get('backlog', 0):.0f} "
+                f"(unclaimed {e.get('unclaimed', 0)}, in-flight "
+                f"{e.get('offered', 0):.0f}-{e.get('served', 0):.0f}) "
+                f"blocks_free={e.get('blocks_free')} "
+                f"slo_breached={breached if breached else '[]'}"
+            )
     return "\n".join(lines)
 
 

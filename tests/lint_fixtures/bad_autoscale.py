@@ -1,0 +1,13 @@
+"""Known-bad: wall-clock shed decision, unreaped monitor thread."""
+import threading
+import time
+
+
+def overdue(t_submit, deadline_s):
+    return (time.time() - t_submit) > deadline_s
+
+
+def start_monitor(tick):
+    t = threading.Thread(target=tick)
+    t.start()
+    return t

@@ -1,0 +1,18 @@
+"""Known-good twins: explicit stamps in, monotonic clock, reaped thread."""
+import threading
+import time
+
+
+def overdue(t_submit, deadline_s, now):
+    return (now - t_submit) > deadline_s
+
+
+def monotonic_now():
+    return time.perf_counter()
+
+
+def run_monitor(tick):
+    t = threading.Thread(target=tick, daemon=True)
+    t.start()
+    t.join(timeout=5.0)
+    return t

@@ -89,6 +89,8 @@ BAD_EXPECT = {
     "bad_shipping.py": {("int32-wire", 8),
                         ("int32-wire", 9),
                         ("resource-lifecycle", 13)},
+    "bad_autoscale.py": {("determinism-hazard", 7),
+                         ("thread-discipline", 11)},
 }
 
 GOOD_FILES = [
@@ -109,6 +111,7 @@ GOOD_FILES = [
     "good_lifecycle.py",
     "good_serving_obs.py",
     "good_shipping.py",
+    "good_autoscale.py",
 ]
 
 

@@ -1,0 +1,590 @@
+"""Overload tier (ISSUE 19): admission, shedding, backpressure,
+autoscale — the pure decision logic plus the file-queue protocol
+pieces, all jax-free:
+
+- :class:`AdmissionPolicy` — class ordering, default resolution,
+  deadline math, SLO shed quota, constructor validation;
+- :class:`BackpressureGate` — engage/release hysteresis band, episode
+  counting, signal pairing validation;
+- :class:`AutoscalePolicy` — consecutive-evaluation streaks, cooldown
+  (streaks reset while the last decision settles), min/max clamps, the
+  dead band between thresholds;
+- replay presets and arrival processes — one named parameterization
+  shared by bench and drills, seeded determinism for Poisson / bursty /
+  diurnal offsets, the pacing report's offered-vs-achieved accounting;
+- exactly-once across a scripted scale-down — the claim/unclaim/
+  respond protocol helpers replayed by hand: a drained victim's
+  unclaimed work is re-served by the survivor, no response lost, none
+  duplicated;
+- :class:`FleetSizeWatcher` — replicas mirror the controller's
+  commitments as gauge + counters, first observation is not a
+  transition;
+- :class:`FleetAutoscaler` — artifact folding into backlog, the
+  forensic trail per decision, and the no-flap contract: after a
+  scale-down the fleet file tracks the DECISION even while the
+  draining victim is still live.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_tensorflow_models_tpu import launch
+from distributed_tensorflow_models_tpu.serving import admission as admlib
+from distributed_tensorflow_models_tpu.serving import replay as replaylib
+from distributed_tensorflow_models_tpu.serving.server import (
+    FleetSizeWatcher,
+    _claim_one,
+    _unclaim,
+    _write_response,
+)
+from distributed_tensorflow_models_tpu.telemetry import registry as reglib
+
+
+# -- AdmissionPolicy -------------------------------------------------------
+
+
+def test_admission_rank_orders_lowest_to_highest():
+    pol = admlib.AdmissionPolicy(("batch", "standard", "interactive"))
+    assert pol.rank("batch") < pol.rank("standard") < pol.rank(
+        "interactive"
+    )
+
+
+def test_admission_default_is_middle_class_unless_given():
+    assert admlib.AdmissionPolicy(("a", "b", "c")).default == "b"
+    assert admlib.AdmissionPolicy(("a", "b", "c", "d")).default == "b"
+    assert admlib.AdmissionPolicy(("only",)).default == "only"
+    pol = admlib.AdmissionPolicy(("a", "b"), default="a")
+    assert pol.default == "a"
+
+
+def test_admission_resolve_maps_unset_to_default_and_validates():
+    pol = admlib.AdmissionPolicy(("lo", "hi"))
+    assert pol.resolve(None) == pol.default
+    assert pol.resolve("") == pol.default
+    assert pol.resolve("hi") == "hi"
+    with pytest.raises(ValueError, match="unknown priority class"):
+        pol.resolve("vip")
+    with pytest.raises(ValueError, match="unknown priority class"):
+        pol.rank("vip")
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {"classes": ()},  # empty
+        {"classes": ("a", "a")},  # duplicate
+        {"classes": ("a", "")},  # empty name
+        {"classes": ("a", "b/c")},  # slash becomes a metric-key hazard
+        {"classes": ("a",), "default": "b"},  # default not a member
+        {"classes": ("a",), "max_shed_per_step": 0},
+    ],
+)
+def test_admission_ctor_rejects(kwargs):
+    classes = kwargs.pop("classes")
+    with pytest.raises(ValueError):
+        admlib.AdmissionPolicy(classes, **kwargs)
+
+
+def test_admission_overdue_is_strict_deadline_math():
+    pol = admlib.AdmissionPolicy()
+    assert not pol.overdue(10.0, None, 1e9)  # no deadline: never
+    assert not pol.overdue(10.0, 2.0, 12.0)  # exactly at: not yet
+    assert pol.overdue(10.0, 2.0, 12.001)
+    assert not pol.overdue(10.0, 2.0, 11.0)
+
+
+def test_admission_shed_quota_gated_on_configured_slo_names():
+    pol = admlib.AdmissionPolicy(
+        shed_on_slo=("qdepth",), max_shed_per_step=3
+    )
+    assert pol.shed_quota([]) == 0
+    assert pol.shed_quota(["ttft"]) == 0  # breach of an unlisted SLO
+    assert pol.shed_quota(["ttft", "qdepth"]) == 3
+    # No shed_on_slo configured: breaches never shed.
+    assert admlib.AdmissionPolicy().shed_quota(["qdepth"]) == 0
+
+
+# -- BackpressureGate ------------------------------------------------------
+
+
+def test_backpressure_queue_hysteresis_band_and_episodes():
+    gate = admlib.BackpressureGate(
+        engage_queue_depth=3, release_queue_depth=1
+    )
+    assert not gate.update(blocks_free=99, queue_depth=2)
+    assert gate.update(blocks_free=99, queue_depth=3)  # engage AT
+    # Inside the band (release < depth < engage): stays engaged.
+    assert gate.update(blocks_free=99, queue_depth=2)
+    assert not gate.update(blocks_free=99, queue_depth=1)  # release AT
+    assert gate.update(blocks_free=99, queue_depth=5)
+    assert gate.episodes == 2  # transitions, not samples
+
+
+def test_backpressure_blocks_signal_and_joint_release():
+    gate = admlib.BackpressureGate(
+        engage_blocks_free=2, release_blocks_free=5,
+        engage_queue_depth=10, release_queue_depth=4,
+    )
+    assert gate.update(blocks_free=2, queue_depth=0)  # blocks trip it
+    # Release needs BOTH signals recovered.
+    assert gate.update(blocks_free=6, queue_depth=5)
+    assert not gate.update(blocks_free=6, queue_depth=4)
+    assert gate.episodes == 1
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {},  # no signal at all
+        {"engage_blocks_free": 2},  # unpaired
+        {"engage_queue_depth": 3},  # unpaired
+        {"engage_blocks_free": 2, "release_blocks_free": 2},  # no band
+        {"engage_queue_depth": 3, "release_queue_depth": 3},  # no band
+        {"engage_queue_depth": 3, "release_queue_depth": 4},  # inverted
+    ],
+)
+def test_backpressure_ctor_rejects(kwargs):
+    with pytest.raises(ValueError):
+        admlib.BackpressureGate(**kwargs)
+
+
+# -- AutoscalePolicy -------------------------------------------------------
+
+
+def _policy(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("up_backlog", 4.0)
+    kw.setdefault("down_backlog", 1.0)
+    kw.setdefault("up_after", 2)
+    kw.setdefault("down_after", 3)
+    kw.setdefault("cooldown", 2)
+    return admlib.AutoscalePolicy(**kw)
+
+
+def test_autoscale_up_needs_consecutive_evidence():
+    pol = _policy(cooldown=0)
+    assert pol.observe(replicas=1, backlog=10.0) == 0
+    # A calm evaluation resets the streak.
+    assert pol.observe(replicas=1, backlog=2.0) == 0
+    assert pol.observe(replicas=1, backlog=10.0) == 0
+    assert pol.observe(replicas=1, backlog=10.0) == 1
+
+
+def test_autoscale_down_needs_longer_streak_and_respects_min():
+    pol = _policy(cooldown=0)
+    for _ in range(2):
+        assert pol.observe(replicas=2, backlog=0.0) == 0
+    assert pol.observe(replicas=2, backlog=0.0) == -1
+    # At the floor the same evidence decides nothing.
+    for _ in range(6):
+        assert pol.observe(replicas=1, backlog=0.0) == 0
+
+
+def test_autoscale_cooldown_skips_and_resets_streaks():
+    pol = _policy(cooldown=2)
+    pol.observe(replicas=1, backlog=10.0)
+    assert pol.observe(replicas=1, backlog=10.0) == 1
+    # Two cooldown evaluations: skipped outright, streaks zeroed.
+    assert pol.observe(replicas=2, backlog=30.0) == 0
+    assert pol.observe(replicas=2, backlog=30.0) == 0
+    # Evidence must re-accumulate from scratch after cooldown.
+    assert pol.observe(replicas=2, backlog=30.0) == 0
+    assert pol.observe(replicas=2, backlog=30.0) == 1
+
+
+def test_autoscale_band_between_thresholds_resets_both_streaks():
+    pol = _policy(cooldown=0)
+    pol.observe(replicas=1, backlog=10.0)
+    pol.observe(replicas=1, backlog=2.0)  # in the band: up streak dies
+    assert pol.observe(replicas=1, backlog=10.0) == 0
+    for _ in range(2):
+        pol.observe(replicas=2, backlog=0.0)
+    pol.observe(replicas=2, backlog=3.0)  # band: down streak dies
+    assert pol.observe(replicas=2, backlog=0.0) == 0
+
+
+def test_autoscale_slo_breach_counts_as_high_load():
+    pol = _policy(cooldown=0)
+    assert pol.observe(replicas=1, backlog=0.0, slo_breached=True) == 0
+    assert pol.observe(replicas=1, backlog=0.0, slo_breached=True) == 1
+
+
+def test_autoscale_max_clamp_does_not_consume_the_streak_reset():
+    pol = _policy(max_replicas=2, cooldown=0)
+    for _ in range(10):
+        assert pol.observe(replicas=2, backlog=100.0) == 0
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {"min_replicas": 0},
+        {"min_replicas": 3, "max_replicas": 2},
+        {"up_backlog": 1.0, "down_backlog": 1.0},  # no band
+        {"up_after": 0},
+        {"down_after": 0},
+        {"cooldown": -1},
+    ],
+)
+def test_autoscale_ctor_rejects(kwargs):
+    with pytest.raises(ValueError):
+        admlib.AutoscalePolicy(**kwargs)
+
+
+def test_autoscale_observe_rejects_dead_fleet():
+    with pytest.raises(ValueError):
+        _policy().observe(replicas=0, backlog=1.0)
+
+
+# -- replay presets and arrival processes ----------------------------------
+
+
+def test_preset_params_smoke_overrides_full_shape():
+    full = replaylib.preset_params("shared_prefix")
+    smoke = replaylib.preset_params("shared_prefix", smoke=True)
+    assert full["shared_len"] > smoke["shared_len"]
+    assert "smoke" not in full and "smoke" not in smoke
+    with pytest.raises(ValueError, match="unknown trace preset"):
+        replaylib.preset_params("nope")
+
+
+def test_preset_trace_is_seed_deterministic():
+    a = replaylib.preset_trace("uniform", 6, seed=7)
+    b = replaylib.preset_trace("uniform", 6, seed=7)
+    assert [r.spec() for r in a] == [r.spec() for r in b]
+    c = replaylib.preset_trace("uniform", 6, seed=8)
+    assert [r.spec() for r in a] != [r.spec() for r in c]
+
+
+def test_preset_trace_uniform_and_interference_need_explicit_n():
+    with pytest.raises(ValueError, match="explicit n"):
+        replaylib.preset_trace("uniform", seed=1)
+    with pytest.raises(ValueError, match="explicit n"):
+        replaylib.preset_trace("interference", seed=1)
+    # The request-carrying presets default their own n.
+    assert replaylib.preset_trace("shared_prefix", seed=1)
+    assert replaylib.preset_trace("long_context", seed=1)
+
+
+def test_arrival_processes_are_seeded_and_monotonic():
+    for make in (
+        lambda s: replaylib.open_loop_arrivals(
+            32, seed=s, mean_gap_s=0.01
+        ),
+        lambda s: replaylib.bursty_arrivals(
+            32, seed=s, lull_gap_s=0.1, spike_gap_s=0.001,
+            lull_s=0.2, spike_s=0.3,
+        ),
+        lambda s: replaylib.diurnal_arrivals(
+            32, seed=s, mean_gap_s=0.01, period_s=1.0,
+        ),
+    ):
+        a, b, c = make(5), make(5), make(6)
+        assert a == b
+        assert a != c
+        assert all(x < y for x, y in zip(a, a[1:]))
+
+
+def test_bursty_arrivals_spike_is_denser_than_lull():
+    offs = replaylib.bursty_arrivals(
+        400, seed=3, lull_gap_s=0.5, spike_gap_s=0.005,
+        lull_s=1.0, spike_s=1.0,
+    )
+    period = 2.0
+
+    def rate(phase):
+        inside = [
+            t for t in offs
+            if (phase == "lull") == ((t % period) < 1.0)
+        ]
+        return len(inside)
+
+    assert rate("spike") > 10 * rate("lull")
+
+
+def test_bursty_and_diurnal_validate_shapes():
+    with pytest.raises(ValueError, match="below lull_gap_s"):
+        replaylib.bursty_arrivals(
+            4, seed=1, lull_gap_s=0.1, spike_gap_s=0.1,
+            lull_s=1.0, spike_s=1.0,
+        )
+    with pytest.raises(ValueError, match="phase lengths"):
+        replaylib.bursty_arrivals(
+            4, seed=1, lull_gap_s=0.2, spike_gap_s=0.1,
+            lull_s=0.0, spike_s=1.0,
+        )
+    with pytest.raises(ValueError, match="peak_to_trough"):
+        replaylib.diurnal_arrivals(
+            4, seed=1, mean_gap_s=0.1, period_s=1.0, peak_to_trough=0.5,
+        )
+    with pytest.raises(ValueError, match="period_s"):
+        replaylib.diurnal_arrivals(
+            4, seed=1, mean_gap_s=0.1, period_s=0.0,
+        )
+
+
+def test_spec_carries_priority_and_deadline_only_when_set():
+    plain = replaylib.ReplayRequest(request_id=1, prompt=[1],
+                                    max_new_tokens=2)
+    assert "priority" not in plain.spec()
+    assert "deadline_s" not in plain.spec()
+    tagged = replaylib.ReplayRequest(
+        request_id=2, prompt=[1], max_new_tokens=2,
+        priority="interactive", deadline_s=0.5,
+    )
+    spec = tagged.spec()
+    assert spec["priority"] == "interactive"
+    assert spec["deadline_s"] == 0.5
+
+
+def test_replay_report_offered_vs_achieved_accounting():
+    reqs = replaylib.stamp_arrivals(
+        replaylib.uniform_mix(5, seed=1), [0.0, 0.0, 0.0, 0.0, 0.0]
+    )
+    rep = replaylib.replay(reqs, lambda r: None)
+    assert rep.emitted == 5
+    assert rep.offered_duration_s == 0.0
+    assert rep.pacing_error == 0.0  # zero-length trace: defined as 0
+    # Synthetic report: a "10 QPS" trace that took 1.5x the schedule.
+    slow = replaylib.ReplayReport(
+        emitted=10, offered_duration_s=1.0, achieved_duration_s=1.5,
+        max_lag_s=0.5, mean_lag_s=0.1,
+    )
+    assert slow.offered_qps == pytest.approx(10.0)
+    assert slow.achieved_qps == pytest.approx(10.0 / 1.5)
+    assert slow.pacing_error == pytest.approx(0.5)
+
+
+# -- exactly-once across a scripted scale-down -----------------------------
+
+
+def _queue(tmp_path, n):
+    queue_dir = str(tmp_path / "queue")
+    claimed = os.path.join(queue_dir, "claimed")
+    resp = os.path.join(queue_dir, "resp")
+    os.makedirs(claimed)
+    os.makedirs(resp)
+    for req in replaylib.preset_trace("uniform", n, seed=11):
+        replaylib.write_request(queue_dir, req)
+    return queue_dir, claimed, resp
+
+
+def test_exactly_once_across_scripted_scale_down(tmp_path):
+    """Replay the drill's protocol by hand: replica 1 claims some
+    requests, is 'drained' mid-flight (its unserved claims go back to
+    the queue exactly like the SIGTERM path), and replica 0 finishes
+    the queue.  Every request gets exactly one response; the victim's
+    un-responded claims are re-served, never duplicated."""
+    queue_dir, claimed, resp = _queue(tmp_path, 8)
+    victim_claims = []
+    for _ in range(4):
+        got = _claim_one(queue_dir, claimed, replica=1)
+        assert got is not None
+        victim_claims.append(got)
+    # The victim answers ONE request, then drains: the rest unclaim.
+    name, spec = victim_claims[0]
+    _write_response(resp, spec["request_id"], {
+        "request_id": spec["request_id"], "tokens": [1], "replica": 1,
+    })
+    os.remove(os.path.join(claimed, f"{name}.p1"))
+    for name, _ in victim_claims[1:]:
+        _unclaim(queue_dir, claimed, name, replica=1)
+    # Survivor drains everything left (returned + never-claimed).
+    served = 0
+    while True:
+        got = _claim_one(queue_dir, claimed, replica=0)
+        if got is None:
+            break
+        name, spec = got
+        _write_response(resp, spec["request_id"], {
+            "request_id": spec["request_id"], "tokens": [0], "replica": 0,
+        })
+        os.remove(os.path.join(claimed, f"{name}.p0"))
+        served += 1
+    assert served == 7
+    responses = sorted(
+        int(f.split("-")[1].split(".")[0]) for f in os.listdir(resp)
+    )
+    assert responses == list(range(8))  # all answered, none twice
+    assert os.listdir(claimed) == []  # no claim leaked
+    assert not [
+        f for f in os.listdir(queue_dir) if f.startswith("req-")
+    ]
+
+
+def test_claim_race_loser_skips_without_error(tmp_path):
+    queue_dir, claimed, _ = _queue(tmp_path, 1)
+    assert _claim_one(queue_dir, claimed, replica=0) is not None
+    assert _claim_one(queue_dir, claimed, replica=1) is None
+
+
+# -- FleetSizeWatcher ------------------------------------------------------
+
+
+def _write_fleet(path, size):
+    with open(path, "w") as f:
+        json.dump({"size": size, "ts_wall": 0.0}, f)
+
+
+def test_fleet_watcher_first_observation_is_not_a_transition(tmp_path):
+    path = str(tmp_path / "fleet_size.json")
+    reg = reglib.MetricsRegistry()
+    w = FleetSizeWatcher(path, reg)
+    # Missing file: no news, but the trio is pre-created at zero.
+    assert w.poll() is None
+    snap = reg.snapshot()
+    assert snap[reglib.SERVE_FLEET_SIZE] == 0.0
+    assert snap[reglib.SERVE_SCALE_UP] == 0.0
+    _write_fleet(path, 2)
+    assert w.poll() == 2
+    snap = reg.snapshot()
+    assert snap[reglib.SERVE_FLEET_SIZE] == 2.0
+    assert snap[reglib.SERVE_SCALE_UP] == 0.0  # joining != scaling
+    assert snap[reglib.SERVE_SCALE_DOWN] == 0.0
+
+
+def test_fleet_watcher_mirrors_up_and_down_transitions(tmp_path):
+    path = str(tmp_path / "fleet_size.json")
+    reg = reglib.MetricsRegistry()
+    w = FleetSizeWatcher(path, reg)
+    _write_fleet(path, 1)
+    w.poll()
+    _write_fleet(path, 3)
+    w.poll()
+    w.poll()  # unchanged file: no double count
+    _write_fleet(path, 2)
+    w.poll()
+    snap = reg.snapshot()
+    assert snap[reglib.SERVE_FLEET_SIZE] == 2.0
+    assert snap[reglib.SERVE_SCALE_UP] == 2.0  # 1 -> 3
+    assert snap[reglib.SERVE_SCALE_DOWN] == 1.0  # 3 -> 2
+
+
+def test_fleet_watcher_torn_file_is_no_news(tmp_path):
+    path = str(tmp_path / "fleet_size.json")
+    reg = reglib.MetricsRegistry()
+    w = FleetSizeWatcher(path, reg)
+    _write_fleet(path, 2)
+    assert w.poll() == 2
+    with open(path, "w") as f:
+        f.write("{torn")
+    assert w.poll() == 2  # keeps the last good observation
+
+
+# -- FleetAutoscaler -------------------------------------------------------
+
+
+def _ts_row(workdir, replica, **fields):
+    row = {"ts_wall": 0.0, "t_rel_s": 0.0, **fields}
+    with open(
+        os.path.join(workdir, f"timeseries_p{replica}.jsonl"), "a"
+    ) as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _controller(tmp_path, **policy_kw):
+    workdir = str(tmp_path / "wd")
+    queue_dir = str(tmp_path / "queue")
+    os.makedirs(workdir, exist_ok=True)
+    os.makedirs(queue_dir, exist_ok=True)
+    ctl = launch.FleetAutoscaler(
+        workdir,
+        queue_dir=queue_dir,
+        poll_interval_s=0.0,
+        policy=admlib.AutoscalePolicy(**policy_kw),
+    )
+    return ctl, workdir, queue_dir
+
+
+def test_autoscaler_signals_fold_artifacts_into_backlog(tmp_path):
+    ctl, workdir, queue_dir = _controller(
+        tmp_path, min_replicas=1, max_replicas=4,
+        up_backlog=4.0, down_backlog=1.0,
+    )
+    _ts_row(workdir, 0, offered=10.0, served=6.0, **{
+        "serve/blocks_free": 3.0, "serve/slo_margin/ttft": -0.5,
+    })
+    _ts_row(workdir, 1, offered=4.0, served=4.0, **{
+        "serve/blocks_free": 9.0,
+    })
+    for req in replaylib.preset_trace("uniform", 2, seed=1, first_id=50):
+        replaylib.write_request(queue_dir, req)
+    sig = ctl.signals([0, 1])
+    assert sig["unclaimed"] == 2
+    assert sig["backlog"] == pytest.approx(2 + (14.0 - 10.0))
+    assert sig["blocks_free"] == 3.0  # fleet minimum
+    assert sig["slo_breached"] == ["ttft"]
+    assert set(sig["per_replica"]) == {0, 1}
+
+
+def test_autoscaler_decision_leaves_forensic_trail(tmp_path):
+    ctl, workdir, _ = _controller(
+        tmp_path, min_replicas=1, max_replicas=4,
+        up_backlog=2.0, down_backlog=0.5, up_after=2, down_after=2,
+        cooldown=0,
+    )
+    _ts_row(workdir, 0, offered=50.0, served=0.0)
+    assert ctl.decide([0]) == 0  # first qualifying evaluation
+    assert ctl.decide([0]) == 1  # second: scale up
+    assert ctl.events == 1
+    with open(os.path.join(workdir, "scale_events.jsonl")) as f:
+        rows = [json.loads(line) for line in f]
+    assert len(rows) == 1
+    assert rows[0]["event"] == "scale_up"
+    assert rows[0]["from_size"] == 1 and rows[0]["to_size"] == 2
+    assert rows[0]["backlog"] == 50.0
+    flight = os.path.join(workdir, "flight_autoscale_0.json")
+    with open(flight) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "autoscale_scale_up"
+    with open(os.path.join(workdir, "fleet_size.json")) as f:
+        assert json.load(f)["size"] == 2
+
+
+def test_autoscaler_fleet_file_tracks_decisions_not_liveness(tmp_path):
+    """The no-flap contract: after a scale-down decision the victim
+    stays live for a few monitor ticks while it drains.  Those ticks
+    must NOT rewrite fleet_size.json back to observed liveness — the
+    replicas mirror the file, and a liveness echo would fabricate a
+    scale_up/scale_down pair no decision ever made."""
+    ctl, workdir, _ = _controller(
+        tmp_path, min_replicas=1, max_replicas=4,
+        up_backlog=4.0, down_backlog=1.0, up_after=2, down_after=2,
+        cooldown=0,
+    )
+    fleet_file = os.path.join(workdir, "fleet_size.json")
+    # Initial commitment comes from liveness (no decision yet).
+    assert ctl.decide([0, 1]) == 0
+    with open(fleet_file) as f:
+        assert json.load(f)["size"] == 2
+    # Idle fleet: two qualifying evaluations -> scale down to 1.
+    assert ctl.decide([0, 1]) == -1
+    with open(fleet_file) as f:
+        committed = json.load(f)
+    assert committed["size"] == 1
+    # Victim still live while draining: the file must not move.
+    for _ in range(4):
+        ctl.decide([0, 1])
+    with open(fleet_file) as f:
+        assert json.load(f) == committed
+
+
+def test_autoscaler_rate_limit_skips_between_polls(tmp_path):
+    workdir = str(tmp_path / "wd")
+    os.makedirs(workdir)
+    ctl = launch.FleetAutoscaler(
+        workdir, poll_interval_s=3600.0,
+        policy=admlib.AutoscalePolicy(
+            up_backlog=0.5, down_backlog=0.1, up_after=1, cooldown=0,
+        ),
+    )
+    _ts_row(workdir, 0, offered=50.0, served=0.0)
+    first = ctl.decide([0])
+    # Inside the poll interval every tick is a no-op, however loaded.
+    assert ctl.decide([0]) == 0
+    assert ctl.decide([0]) == 0
+    assert first == 1  # the first tick evaluated (and decided)

@@ -41,6 +41,7 @@ from distributed_tensorflow_models_tpu.serving.drafter import (
     NO_DRAFT,
     NgramDrafter,
 )
+from distributed_tensorflow_models_tpu.serving import admission as admlib
 from distributed_tensorflow_models_tpu.serving.scheduler import (
     ContinuousBatchingScheduler,
     Request,
@@ -1063,11 +1064,21 @@ def test_server_lifecycle_and_drain_artifacts(tmp_path):
     only on a spec-on server (full-set-or-absent contract).  Runs with
     an (unbreachable) SLO attached and the time-series writer on for
     the same reason: serve/slo_* is full-set-or-absent, and coverage of
-    SERVE_SLO_BREACH / SERVE_SLO_MARGIN needs a monitor present."""
+    SERVE_SLO_BREACH / SERVE_SLO_MARGIN needs a monitor present.  Same
+    again for the overload tier (ISSUE 19): admission, a backpressure
+    gate (thresholds far out of reach) and a fleet-size watch are
+    attached so serve/submitted/<class>, serve/shed/<class>,
+    serve/backpressure* and the serve/fleet_size + scale trio all
+    appear (as zeros) — quiet features, not absent families."""
     srv = LMServer(
         _factory(spec_tokens=2), workdir=str(tmp_path), process_index=0,
         slo_specs=["serve/ttft_s:p99<60@60s"],
         timeseries_interval_s=0.01,
+        admission=admlib.AdmissionPolicy(),
+        backpressure=admlib.BackpressureGate(
+            engage_queue_depth=10_000, release_queue_depth=100,
+        ),
+        fleet_file=str(tmp_path / "fleet_size.json"),
     )
     with pytest.raises(RuntimeError):
         srv.submit([1, 2], 2)  # not started
@@ -1335,8 +1346,10 @@ def test_disagg_stream_identity_and_role_pins(tmp_path):
 
     # Both stats reports are schema-clean, and the prefill one closes
     # the disagg side of the declared-coverage tiling (serve/ship_* and
-    # serve/fleet_prefix_* NOT excused here; spec/slo are owned by
-    # test_server_lifecycle_and_drain_artifacts).
+    # serve/fleet_prefix_* NOT excused here; spec/slo and the overload
+    # families — submitted/shed classes, backpressure pair, fleet_size
+    # + scale trio — are owned by
+    # test_server_lifecycle_and_drain_artifacts, which runs them on).
     registry_py = os.path.join(
         os.path.dirname(SCHEMA_LINT), "..",
         "distributed_tensorflow_models_tpu", "telemetry", "registry.py",
@@ -1351,7 +1364,12 @@ def test_disagg_stream_identity_and_role_pins(tmp_path):
     proc = subprocess.run(
         [sys.executable, SCHEMA_LINT, str(wd / "serving_stats_p0.json"),
          "--declared-coverage", registry_py, "--only-prefix", "serve/",
-         "--allow-missing", "serve/spec_", "--allow-missing", "serve/slo_"],
+         "--allow-missing", "serve/spec_", "--allow-missing", "serve/slo_",
+         "--allow-missing", "serve/submitted",
+         "--allow-missing", "serve/shed",
+         "--allow-missing", "serve/backpressure",
+         "--allow-missing", "serve/fleet_size",
+         "--allow-missing", "serve/scale_"],
         capture_output=True, text=True,
     )
     assert proc.returncode == 0, proc.stderr + proc.stdout
