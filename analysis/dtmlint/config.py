@@ -28,6 +28,7 @@ JAX_FREE_ROOTS = (
     f"{PACKAGE}/serving/server.py",
     f"{PACKAGE}/serving/replay.py",
     f"{PACKAGE}/serving/admission.py",
+    f"{PACKAGE}/serving/deploy.py",
     f"{PACKAGE}/telemetry/slo.py",
     f"{PACKAGE}/telemetry/timeseries.py",
 )
@@ -61,6 +62,12 @@ DETERMINISM_SCOPE = (
     # unreplayable from the flight record.
     f"{PACKAGE}/serving/admission.py",
     f"{PACKAGE}/telemetry/slo.py",
+    # Continuous deployment (ISSUE 20): canary routing is a seeded
+    # rid-hash and every gate / promote / rollback decision is pure
+    # arithmetic over timestamps the server passes in — a clock read
+    # here would make the deploy timeline unreplayable and could route
+    # the same rid to different versions on different replicas.
+    f"{PACKAGE}/serving/deploy.py",
 )
 
 METRIC_REGISTRY = f"{PACKAGE}/telemetry/registry.py"

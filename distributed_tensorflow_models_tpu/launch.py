@@ -668,6 +668,7 @@ def supervise_local(
     port: int = DEFAULT_PORT,
     resize_to: int | None = None,
     auto_resize: bool = False,
+    follow_checkpoints: str | None = None,
     **launch_kwargs,
 ) -> int:
     """``launch_local`` under the fleet restart loop: a fleet torn down
@@ -713,6 +714,14 @@ def supervise_local(
     scheduler telemetry — no failure, no relaunch, no dropped work.
     The controller object is reused across relaunches, so its
     hysteresis state and scale-event numbering survive a restart.
+
+    Continuous deployment (ISSUE 20): ``follow_checkpoints=<dir>``
+    appends ``--follow-checkpoints <dir>`` to every child's argv —
+    serving replicas (including ones the autoscaler recruits
+    mid-run, which clone the same argv) then follow the trainer's
+    checkpoint directory, gating/canarying/promoting new weights
+    live instead of waiting for a relaunch to pick them up.  The
+    flag rides the argv so a fleet restart keeps following too.
     """
     import time
 
@@ -720,6 +729,8 @@ def supervise_local(
 
     if resize_to is not None and resize_to < 1:
         raise ValueError(f"resize_to must be >= 1, got {resize_to}")
+    if follow_checkpoints:
+        argv = list(argv) + ["--follow-checkpoints", follow_checkpoints]
     attempt = 0
     cur_procs = num_processes
     while True:
@@ -877,6 +888,14 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="seconds between autoscaler evaluations",
     )
     parser.add_argument(
+        "--follow-checkpoints",
+        default=None,
+        help="localhost mode: append '--follow-checkpoints DIR' to "
+        "every child's argv — serving replicas then live-adopt the "
+        "trainer's newly fleet-valid checkpoints (gate, canary, "
+        "SLO-verdict promote/rollback) with no restart or recompile",
+    )
+    parser.add_argument(
         "--heartbeat-timeout",
         type=float,
         default=None,
@@ -942,6 +961,7 @@ def main(argv: Sequence[str] | None = None) -> int:
                 port=int(port_str),
                 resize_to=args.resize_to,
                 auto_resize=args.auto_resize,
+                follow_checkpoints=args.follow_checkpoints,
                 cpu_devices_per_process=args.cpu_devices_per_process,
                 heartbeat_timeout=args.heartbeat_timeout,
                 term_grace_s=args.term_grace,
@@ -952,6 +972,10 @@ def main(argv: Sequence[str] | None = None) -> int:
                 "--resize-to/--auto-resize only apply to the restart "
                 "loop; add --max-restarts N"
             )
+        if args.follow_checkpoints:
+            command = list(command) + [
+                "--follow-checkpoints", args.follow_checkpoints,
+            ]
         codes = launch_local(
             args.num_processes,
             command,

@@ -220,6 +220,20 @@ class InferenceEngine:
             )
         self.model = model
         self.params = params
+        # Weight-version ledger (continuous deployment).  ``version`` is
+        # the primary weight version (checkpoint step; 0 = boot weights)
+        # and ``self.params`` always aliases its tree.  A canary is a
+        # second live version serving a routed traffic slice; every slot
+        # is pinned at admission to the version it was routed to and
+        # keeps those exact weights until it retires — that pin is what
+        # makes an in-flight stream byte-identical to a solo generate()
+        # with its admitted weights, no matter when a swap lands.
+        # Retired versions are pruned once no slot references them.
+        self.version = 0
+        self.canary_version: Optional[int] = None
+        self._versions: dict = {0: params}
+        self._slot_version: dict = {}  # slot -> version pinned at admit
+        self._deploy_active = False  # ever canaried: per-version metrics on
         self.max_slots = int(max_slots)
         self.prefill_chunk = int(prefill_chunk)
         self.decode_burst = int(decode_burst)
@@ -424,7 +438,8 @@ class InferenceEngine:
         return self.padded_suffix(plen, self._usable_cached_len(plen, depth))
 
     def admit(self, request_id: int, prompt,
-              max_new_tokens: int) -> Optional[tuple]:
+              max_new_tokens: int, *,
+              version: Optional[int] = None) -> Optional[tuple]:
         """Two-resource admission: claim a slot AND the request's whole
         block reservation, reusing the longest resident prefix.  Returns
         ``(slot, cached_len)`` or None (no slot / not enough blocks even
@@ -434,6 +449,11 @@ class InferenceEngine:
         ``max_new_tokens`` — the caller validated at submit.  The
         reservation covers prompt + max_new rounded up to whole pages,
         so the request can never run out of blocks mid-decode.
+
+        ``version`` pins the slot to a live weight version (the
+        scheduler's canary routing decision); None — or a version that
+        stopped being live between routing and admission — falls back
+        to the primary.
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = len(prompt)
@@ -485,6 +505,9 @@ class InferenceEngine:
         self._lengths[slot] = cached
         self._slot_blocks[slot] = blocks
         self._slot_cached[slot] = cached
+        if version is None or version not in self._versions:
+            version = self.version
+        self._slot_version[slot] = version
         return slot, cached
 
     def release(self, slot: int) -> int:
@@ -497,7 +520,99 @@ class InferenceEngine:
         self._tables[slot] = 0
         self._lengths[slot] = 0
         self._views_fresh[slot] = False
+        self._slot_version.pop(slot, None)
+        self._prune_versions()
         return request_id
+
+    # -- weight-version hot-swap (continuous deployment) --------------------
+    #
+    # The compiled prefill/decode programs take the weight tree as
+    # argument 0, which is NOT donated (only the pool / views are), so
+    # rebinding the tree between dispatches swaps weights without
+    # touching any buffer a program owns — and because the deploy gate
+    # proved aval equality up front, the jit cache hits the existing
+    # executables: compile_counts() is pinned across every swap.  All
+    # mutators run on the scheduler's worker thread, so a swap can only
+    # land between bursts.
+
+    def slot_version(self, slot: int) -> int:
+        """The weight version ``slot`` was admitted under."""
+        return self._slot_version.get(slot, self.version)
+
+    def params_for(self, version: int):
+        return self._versions[version]
+
+    def live_versions(self) -> tuple:
+        """Versions some live structure still references (ascending)."""
+        return tuple(sorted(self._versions))
+
+    def _prune_versions(self) -> None:
+        keep = {self.version}
+        if self.canary_version is not None:
+            keep.add(self.canary_version)
+        keep.update(self._slot_version.values())
+        for vid in [v for v in self._versions if v not in keep]:
+            del self._versions[vid]
+
+    def install_canary(self, version: int, params) -> None:
+        """Stage a gated candidate as the canary version.  ``params``
+        must already have passed the deploy gate (finite, aval-equal to
+        the live tree) — this method moves it to device and makes it
+        routable, nothing more."""
+        if version <= self.version:
+            raise ValueError(
+                f"candidate version {version} is not newer than the "
+                f"primary {self.version}"
+            )
+        if self.canary_version is not None:
+            raise ValueError(
+                f"canary {self.canary_version} still in flight"
+            )
+        # One up-front transfer: dispatching host arrays would re-ship
+        # the tree to the device on every burst.  Leaves are normalised
+        # against the LIVE tree's placement because jit keys on
+        # committed-ness and sharding, not just avals: checkpoint
+        # restores hand back device-committed arrays while boot-time
+        # init params are uncommitted, and that one-bit difference
+        # would retrace both programs on the first canary burst.
+        def _match(live, new):
+            new = jnp.asarray(new, dtype=live.dtype)
+            if getattr(live, "committed", False):
+                return jax.device_put(new, live.sharding)
+            if getattr(new, "committed", False):
+                # Host round-trip is the only way to shed committed-ness;
+                # once per candidate, on weights that just came off disk.
+                return jnp.asarray(np.asarray(jax.device_get(new)),
+                                   dtype=live.dtype)
+            return jax.device_put(new)
+
+        self._versions[version] = jax.tree_util.tree_map(
+            _match, self.params, params
+        )
+        self.canary_version = version
+        self._deploy_active = True
+
+    def promote_canary(self) -> int:
+        """Make the canary the primary; returns the old primary version.
+        The old weights stay live until the last slot pinned to them
+        retires (release() prunes)."""
+        if self.canary_version is None:
+            raise ValueError("no canary to promote")
+        old = self.version
+        self.version = self.canary_version
+        self.params = self._versions[self.version]
+        self.canary_version = None
+        self._prune_versions()
+        return old
+
+    def rollback_canary(self) -> None:
+        """Withdraw the canary from routing.  Slots already pinned to it
+        finish on its weights (the byte-identity contract holds for
+        rolled-back traffic too); the tree is pruned when they retire."""
+        if self.canary_version is None:
+            raise ValueError("no canary to roll back")
+        self.canary_version = None
+        self._prune_versions()
 
     # -- KV page shipping (disaggregated prefill/decode) -------------------
     #
@@ -628,6 +743,9 @@ class InferenceEngine:
         self._slot_blocks[slot] = fresh
         self._slot_cached[slot] = 0
         self._views_fresh[slot] = True
+        # Shipped requests decode on the primary at adoption time; the
+        # pin keeps them there across any later swap (byte-identity).
+        self._slot_version[slot] = self.version
         return slot
 
     def _fleet_extend(self, matchable: list) -> None:
@@ -891,72 +1009,88 @@ class InferenceEngine:
         into the prefix cache — never earlier, so a same-burst twin
         cannot match blocks that are still being filled.  Returns
         ``{slot: first_token}``."""
-        lanes, c = self.prefill_lanes, self.prefill_chunk
         out = {}
+        # Partition by the slots' pinned weight versions: each group
+        # dispatches the SAME compiled program with its own weight tree
+        # (aval-equal by the deploy gate, so no version ever retraces).
+        # With no deploy attached every slot pins the boot version and
+        # this degenerates to the single-group PR 12 path.
+        byver: dict = {}
+        for item in items:
+            byver.setdefault(self.slot_version(item[0]), []).append(item)
         with self.registry.span(reglib.SERVE_PREFILL):
-            for g in range(0, len(items), lanes):
-                plans = []
-                for slot, prompt, kd0, t, k, p in items[g:g + lanes]:
-                    prompt = np.asarray(prompt, np.int32).reshape(-1)
-                    lo0 = self._slot_cached.get(slot, 0)
-                    bounds = [
-                        (lo, min(lo + c, len(prompt)))
-                        for lo in range(lo0, len(prompt), c)
-                    ]
-                    plans.append((slot, prompt, kd0, t, k, p, bounds))
-                for w in range(max(len(pl[6]) for pl in plans)):
-                    tables = np.zeros((lanes, self._bps), np.int32)
-                    tokens = np.zeros((lanes, c), np.int32)
-                    starts = np.zeros((lanes,), np.int32)
-                    keydata = np.zeros(
-                        (lanes,) + self._key_shape, self._key_dtype
-                    )
-                    temperature = np.zeros((lanes,), np.float32)
-                    top_k = np.zeros((lanes,), np.int32)
-                    top_p = np.ones((lanes,), np.float32)
-                    last = np.zeros((lanes,), np.int32)
-                    for i, (slot, prompt, kd0, t, k, p, bounds) in (
-                        enumerate(plans)
-                    ):
-                        if w >= len(bounds):
-                            continue  # inert lane
-                        lo, hi = bounds[w]
-                        tables[i] = self._tables[slot]
-                        tokens[i, : hi - lo] = prompt[lo:hi]
-                        starts[i] = lo
-                        keydata[i] = np.asarray(
-                            kd0, self._key_dtype
-                        ).reshape(self._key_shape)
-                        temperature[i] = t
-                        top_k[i] = k
-                        top_p[i] = p
-                        last[i] = hi - lo - 1
-                    self.pool, toks = self._prefill_j(
-                        self.params, self.pool, jnp.asarray(tables),
-                        jnp.asarray(tokens), jnp.asarray(starts),
-                        jnp.asarray(keydata), jnp.asarray(temperature),
-                        jnp.asarray(top_k), jnp.asarray(top_p),
-                        jnp.asarray(last),
-                    )
-                    toks = np.asarray(toks)
-                    for i, (slot, *_rest, bounds) in enumerate(plans):
-                        if w == len(bounds) - 1:
-                            out[slot] = int(toks[i])
-                for slot, prompt, *_rest in plans:
-                    self._lengths[slot] = len(prompt)
-                    self._views_fresh[slot] = True
-                    if self.prefix_cache is not None:
-                        pages = self._matchable(prompt)
-                        if pages:
-                            self.prefix_cache.insert(
-                                pages,
-                                [int(b) for b in
-                                 self._tables[slot][:len(pages)]],
-                            )
-                            self._sync_eviction_counter()
-                            if self.fleet_cache is not None:
-                                self._fleet_advertise(slot, pages)
+            for vid in sorted(byver):
+                self._prefill_group(
+                    self._versions.get(vid, self.params), byver[vid], out
+                )
         return out
+
+    def _prefill_group(self, vparams, items: list, out: dict) -> None:
+        """Prefill one weight-version's items (the PR 12 group loop,
+        dispatching with that version's tree)."""
+        lanes, c = self.prefill_lanes, self.prefill_chunk
+        for g in range(0, len(items), lanes):
+            plans = []
+            for slot, prompt, kd0, t, k, p in items[g:g + lanes]:
+                prompt = np.asarray(prompt, np.int32).reshape(-1)
+                lo0 = self._slot_cached.get(slot, 0)
+                bounds = [
+                    (lo, min(lo + c, len(prompt)))
+                    for lo in range(lo0, len(prompt), c)
+                ]
+                plans.append((slot, prompt, kd0, t, k, p, bounds))
+            for w in range(max(len(pl[6]) for pl in plans)):
+                tables = np.zeros((lanes, self._bps), np.int32)
+                tokens = np.zeros((lanes, c), np.int32)
+                starts = np.zeros((lanes,), np.int32)
+                keydata = np.zeros(
+                    (lanes,) + self._key_shape, self._key_dtype
+                )
+                temperature = np.zeros((lanes,), np.float32)
+                top_k = np.zeros((lanes,), np.int32)
+                top_p = np.ones((lanes,), np.float32)
+                last = np.zeros((lanes,), np.int32)
+                for i, (slot, prompt, kd0, t, k, p, bounds) in (
+                    enumerate(plans)
+                ):
+                    if w >= len(bounds):
+                        continue  # inert lane
+                    lo, hi = bounds[w]
+                    tables[i] = self._tables[slot]
+                    tokens[i, : hi - lo] = prompt[lo:hi]
+                    starts[i] = lo
+                    keydata[i] = np.asarray(
+                        kd0, self._key_dtype
+                    ).reshape(self._key_shape)
+                    temperature[i] = t
+                    top_k[i] = k
+                    top_p[i] = p
+                    last[i] = hi - lo - 1
+                self.pool, toks = self._prefill_j(
+                    vparams, self.pool, jnp.asarray(tables),
+                    jnp.asarray(tokens), jnp.asarray(starts),
+                    jnp.asarray(keydata), jnp.asarray(temperature),
+                    jnp.asarray(top_k), jnp.asarray(top_p),
+                    jnp.asarray(last),
+                )
+                toks = np.asarray(toks)
+                for i, (slot, *_rest, bounds) in enumerate(plans):
+                    if w == len(bounds) - 1:
+                        out[slot] = int(toks[i])
+            for slot, prompt, *_rest in plans:
+                self._lengths[slot] = len(prompt)
+                self._views_fresh[slot] = True
+                if self.prefix_cache is not None:
+                    pages = self._matchable(prompt)
+                    if pages:
+                        self.prefix_cache.insert(
+                            pages,
+                            [int(b) for b in
+                             self._tables[slot][:len(pages)]],
+                        )
+                        self._sync_eviction_counter()
+                        if self.fleet_cache is not None:
+                            self._fleet_advertise(slot, pages)
 
     def decode_step(self, lanes: dict) -> dict:
         """One batched decode dispatch.  ``lanes`` maps slot ->
@@ -981,20 +1115,41 @@ class InferenceEngine:
         — so zero-match traffic pays the drafter's host lookups and
         nothing else.  Returns ``{slot: [token, ...]}``.  Inactive
         slots run as inert sentinel lanes — the program shape never
-        depends on how many requests are live."""
-        verify = False
-        if self.spec_tokens:
-            for lane in lanes.values():
-                if len(lane) > 5 and lane[5] is not None and (
-                    np.asarray(lane[5]) >= 0
-                ).any():
-                    verify = True
-                    break
-        if verify:
-            return self._verify_dispatch(lanes)
-        return self._burst_dispatch(lanes)
+        depends on how many requests are live.
 
-    def _burst_dispatch(self, lanes: dict) -> dict:
+        With a canary in flight, lanes pinned to different weight
+        versions split into per-version dispatches of the SAME compiled
+        program (aval-equal trees — no retrace).  Lanes outside the
+        dispatching version ride along as riders: real table row and
+        real length so their garbage writes land at positions at or
+        past their write head (overwritten by their own version's
+        dispatch before any read — the module's right-padding soundness
+        argument), outputs discarded, host lengths untouched."""
+        byver: dict = {}
+        for slot in lanes:
+            byver.setdefault(self.slot_version(slot), []).append(slot)
+        out: dict = {}
+        for vid in sorted(byver):
+            group = {s: lanes[s] for s in byver[vid]}
+            extra = tuple(s for s in lanes if s not in group)
+            verify = False
+            if self.spec_tokens:
+                for lane in group.values():
+                    if len(lane) > 5 and lane[5] is not None and (
+                        np.asarray(lane[5]) >= 0
+                    ).any():
+                        verify = True
+                        break
+            if verify:
+                out.update(self._verify_dispatch(group, vid, extra))
+            else:
+                out.update(self._burst_dispatch(group, vid, extra))
+        return out
+
+    def _burst_dispatch(
+        self, lanes: dict, vid: Optional[int] = None,
+        extra_slots: tuple = (),
+    ) -> dict:
         s, k = self.max_slots, self.decode_burst
         tables = np.zeros((s, self._bps), np.int32)
         lengths = np.zeros((s,), np.int32)
@@ -1022,12 +1177,23 @@ class InferenceEngine:
             # fresh slot not decoded yet keeps its flag for later).
             if self._views_fresh[slot]:
                 refresh[slot] = True
+        for slot in extra_slots:
+            # Rider lanes (pinned to another weight version): real row
+            # + real length keep their garbage writes at or past the
+            # write head; refresh stays False (re-adopting a decoded
+            # lane from the pool would destroy its decoded-suffix K/V).
+            tables[slot] = self._tables[slot]
+            lengths[slot] = self._lengths[slot]
+        vparams = (
+            self._versions.get(vid, self.params)
+            if vid is not None else self.params
+        )
         # Explicit timing, not registry.span: the dispatch loop stays
         # free of contextmanager enters/exits, and the trace event gets
         # dispatch-kind args the generic span can't carry.
         t0 = time.perf_counter()
         self._views, nxt = self._decode_j(
-            self.params, self._views, self.pool,
+            vparams, self._views, self.pool,
             jnp.asarray(refresh), jnp.asarray(tables),
             jnp.asarray(lengths), jnp.asarray(tokens),
             jnp.asarray(drafts), jnp.asarray(keydata),
@@ -1049,7 +1215,10 @@ class InferenceEngine:
             slot: [int(nxt[i, slot]) for i in range(k)] for slot in lanes
         }
 
-    def _verify_dispatch(self, lanes: dict) -> dict:
+    def _verify_dispatch(
+        self, lanes: dict, vid: Optional[int] = None,
+        extra_slots: tuple = (),
+    ) -> dict:
         """Speculative verify: one width-``spec_tokens+1`` apply per
         lane through the one decode entry point, then host-side
         accepted-prefix truncation + length rollback.  A lane's
@@ -1086,9 +1255,18 @@ class InferenceEngine:
                 drafts[slot, : dr.shape[0]] = dr
             if self._views_fresh[slot]:
                 refresh[slot] = True
+        for slot in extra_slots:
+            # Rider lanes: see _burst_dispatch — real row + length, no
+            # refresh, no draft (row stays -1), output discarded.
+            tables[slot] = self._tables[slot]
+            lengths[slot] = self._lengths[slot]
+        vparams = (
+            self._versions.get(vid, self.params)
+            if vid is not None else self.params
+        )
         t0 = time.perf_counter()
         self._views, cand = self._decode_j(
-            self.params, self._views, self.pool,
+            vparams, self._views, self.pool,
             jnp.asarray(refresh), jnp.asarray(tables),
             jnp.asarray(lengths), jnp.asarray(tokens),
             jnp.asarray(drafts), jnp.asarray(keydata),
@@ -1130,6 +1308,13 @@ class InferenceEngine:
             self.registry.timer(reglib.SERVE_SPEC_ACCEPTANCE_RATE).record(
                 accepted / drafted
             )
+            if self._deploy_active and vid is not None:
+                # Per-version acceptance split (dispatches are already
+                # version-partitioned, so the group rate IS the
+                # version's rate).
+                self.registry.timer(
+                    f"{reglib.SERVE_VERSION_ACCEPTANCE}/{vid}"
+                ).record(accepted / drafted)
         self.registry.timer(
             reglib.SERVE_SPEC_TOKENS_PER_DISPATCH
         ).record(emitted / len(lanes))

@@ -149,6 +149,11 @@ class Completion:
     # alone — the registry timers fold compile-era samples into their
     # percentiles, which a small trace cannot rank past.
     tpot_s: float = 0.0
+    # Weight version the request was ADMITTED under (checkpoint step;
+    # 0 = boot weights).  The engine pins the slot to it, so the token
+    # stream is byte-identical to a solo generate() with that version's
+    # weights regardless of swaps landing mid-flight.
+    version: int = 0
 
 
 class _InFlight:
@@ -157,7 +162,7 @@ class _InFlight:
     __slots__ = (
         "req", "slot", "keydata", "tokens", "pos", "t_submit", "ttft_s",
         "t_last", "drafter", "cached_len", "sheds", "shed_reason",
-        "ship", "cls",
+        "ship", "cls", "version",
     )
 
     def __init__(self, req, slot, keydata, t_submit):
@@ -175,6 +180,7 @@ class _InFlight:
         self.shed_reason = ""  # last shed reason ("no_slot" | "no_blocks")
         self.ship = None  # shipped-arrival facts dict (decode role only)
         self.cls = ""  # resolved priority class (admission policy only)
+        self.version = 0  # weight version pinned at admission (deploy)
 
 
 class ContinuousBatchingScheduler:
@@ -198,6 +204,7 @@ class ContinuousBatchingScheduler:
         ship=None,
         admission=None,
         backpressure=None,
+        deploy=None,
     ):
         if role not in ("monolithic", "prefill", "decode"):
             raise ValueError(
@@ -267,6 +274,15 @@ class ContinuousBatchingScheduler:
         self.admission = admission
         self._gate = backpressure
         self._gate_episodes_seen = 0
+        # Continuous deployment (serving/deploy.py CheckpointFollower):
+        # admission asks it which weight version each request is routed
+        # to (deterministic rid hash), _emit feeds it candidate latency
+        # samples, and per-version serve/version/* metric families are
+        # recorded — full-set-per-version, created at a version's first
+        # sighting.  Without a follower the scheduler is byte-for-byte
+        # the PR 19 scheduler and creates NONE of the version metrics.
+        self.deploy = deploy
+        self._version_metrics_seen: set = set()
         if admission is not None:
             for cls in admission.classes:
                 self.registry.counter(f"{reglib.SERVE_SUBMITTED}/{cls}")
@@ -408,6 +424,19 @@ class ContinuousBatchingScheduler:
 
     # -- the iteration -----------------------------------------------------
 
+    def _version_metrics(self, vid: int) -> None:
+        """Create a version's FULL metric set at first sighting —
+        full-set-per-version: every vid that appears in an artifact
+        carries all five stats (check_metrics_schema enforces)."""
+        if vid in self._version_metrics_seen:
+            return
+        self._version_metrics_seen.add(vid)
+        self.registry.counter(f"{reglib.SERVE_VERSION_REQUESTS}/{vid}")
+        self.registry.counter(f"{reglib.SERVE_VERSION_TOKENS}/{vid}")
+        self.registry.counter(f"{reglib.SERVE_VERSION_SHED}/{vid}")
+        self.registry.timer(f"{reglib.SERVE_VERSION_TTFT}/{vid}")
+        self.registry.timer(f"{reglib.SERVE_VERSION_TPOT}/{vid}")
+
     def _emit(self, inflight, token: int, now: float) -> bool:
         """Record one generated token; True when the request is done."""
         inflight.tokens.append(token)
@@ -415,6 +444,12 @@ class ContinuousBatchingScheduler:
         if inflight.drafter is not None:
             inflight.drafter.append(token)
         self.registry.counter(reglib.SERVE_TOKENS).inc()
+        deploy = self.deploy
+        if deploy is not None:
+            self._version_metrics(inflight.version)
+            self.registry.counter(
+                f"{reglib.SERVE_VERSION_TOKENS}/{inflight.version}"
+            ).inc()
         if inflight.pos == 1:
             inflight.ttft_s = now - inflight.t_submit
             self.registry.timer(reglib.SERVE_TTFT).record(
@@ -422,11 +457,26 @@ class ContinuousBatchingScheduler:
             )
             if self.slo is not None:
                 self.slo.observe(reglib.SERVE_TTFT, inflight.ttft_s, now)
+            if deploy is not None:
+                self.registry.timer(
+                    f"{reglib.SERVE_VERSION_TTFT}/{inflight.version}"
+                ).record(inflight.ttft_s)
+                deploy.observe_sample(
+                    inflight.version, reglib.SERVE_TTFT,
+                    inflight.ttft_s, now,
+                )
         else:
             tpot = now - inflight.t_last
             self.registry.timer(reglib.SERVE_TPOT).record(tpot)
             if self.slo is not None:
                 self.slo.observe(reglib.SERVE_TPOT, tpot, now)
+            if deploy is not None:
+                self.registry.timer(
+                    f"{reglib.SERVE_VERSION_TPOT}/{inflight.version}"
+                ).record(tpot)
+                deploy.observe_sample(
+                    inflight.version, reglib.SERVE_TPOT, tpot, now
+                )
         inflight.t_last = now
         req = inflight.req
         return (
@@ -447,12 +497,15 @@ class ContinuousBatchingScheduler:
         self.registry.counter(reglib.SERVE_COMPLETED).inc()
         trace = self.registry.trace
         if trace.enabled:
-            trace.instant(REQ_DONE, {
+            args = {
                 "rid": inflight.req.request_id,
                 "reason": reason,
                 "tokens": inflight.pos,
                 "ttft_s": inflight.ttft_s,
-            })
+            }
+            if self.deploy is not None:
+                args["v"] = inflight.version
+            trace.instant(REQ_DONE, args)
         decode_steps = max(0, inflight.pos - 1)
         done.append(
             Completion(
@@ -466,6 +519,7 @@ class ContinuousBatchingScheduler:
                     / decode_steps
                     if decode_steps > 0 else 0.0
                 ),
+                version=inflight.version,
             )
         )
 
@@ -479,6 +533,15 @@ class ContinuousBatchingScheduler:
         cls = inflight.cls
         if cls:
             self.registry.counter(f"{reglib.SERVE_SHED}/{cls}").inc()
+        if self.deploy is not None:
+            # The version the request WOULD have run under (the routing
+            # is pure, so shed attribution replays like admission).
+            vid = self.deploy.route(str(inflight.req.request_id))
+            inflight.version = vid
+            self._version_metrics(vid)
+            self.registry.counter(
+                f"{reglib.SERVE_VERSION_SHED}/{vid}"
+            ).inc()
         self.registry.counter(reglib.SERVE_COMPLETED).inc()
         trace = self.registry.trace
         if trace.enabled:
@@ -501,6 +564,7 @@ class ContinuousBatchingScheduler:
                 finish_reason="shed",
                 ttft_s=0.0,
                 decode_steps=0,
+                version=inflight.version,
             )
         )
 
@@ -612,8 +676,16 @@ class ContinuousBatchingScheduler:
                 cost = self.engine.peek_prefill_cost(req.prompt)
                 if wave and spent + cost > self.max_prefill_tokens:
                     break
+                # Deploy routing at admission time: deterministic rid
+                # hash picks primary vs canary; the engine pins the
+                # slot so the choice survives any later swap.
+                version = (
+                    self.deploy.route(str(req.request_id))
+                    if self.deploy is not None else None
+                )
                 admitted = self.engine.admit(
-                    req.request_id, req.prompt, req.max_new_tokens
+                    req.request_id, req.prompt, req.max_new_tokens,
+                    version=version,
                 )
             if admitted is None:
                 # Backpressure: note the shed on the blocked head-of-line
@@ -641,11 +713,25 @@ class ContinuousBatchingScheduler:
             inflight = queue.popleft()
             if inflight.ship is not None:
                 inflight.slot = admitted
+                inflight.version = self.engine.slot_version(admitted)
+                if self.deploy is not None:
+                    self._version_metrics(inflight.version)
+                    self.registry.counter(
+                        f"{reglib.SERVE_VERSION_REQUESTS}/"
+                        f"{inflight.version}"
+                    ).inc()
                 adopted.append(inflight)
                 continue
             slot, cached_len = admitted
             inflight.slot = slot
             inflight.cached_len = cached_len
+            inflight.version = self.engine.slot_version(slot)
+            if self.deploy is not None:
+                self._version_metrics(inflight.version)
+                self.registry.counter(
+                    f"{reglib.SERVE_VERSION_REQUESTS}/"
+                    f"{inflight.version}"
+                ).inc()
             if self.engine.spec_tokens and self.role != "prefill":
                 if self._drafter_factory is not None:
                     inflight.drafter = self._drafter_factory(req)

@@ -75,6 +75,7 @@ from distributed_tensorflow_models_tpu.resilience.preemption import (
     PreemptionListener,
 )
 from distributed_tensorflow_models_tpu.serving import admission as admlib
+from distributed_tensorflow_models_tpu.serving import deploy as deploylib
 from distributed_tensorflow_models_tpu.serving import shipping as shiplib
 from distributed_tensorflow_models_tpu.telemetry import registry as reglib
 from distributed_tensorflow_models_tpu.telemetry import slo as slolib
@@ -221,6 +222,15 @@ class LMServer:
         admission: Optional[admlib.AdmissionPolicy] = None,
         backpressure: Optional[admlib.BackpressureGate] = None,
         fleet_file: Optional[str] = None,
+        follow_checkpoints: Optional[str] = None,
+        follow_poll_s: float = 0.25,
+        follow_process_count: int = 1,
+        canary_fraction: float = 0.25,
+        canary_warmup: int = 8,
+        promote_after: int = 6,
+        rollback_after: int = 2,
+        deploy_seed: int = 0,
+        deploy_slo_specs=None,
     ):
         # Disaggregated serving (serving/shipping.py): a "prefill"
         # server runs admission + the prefill program and publishes
@@ -309,6 +319,23 @@ class LMServer:
             FleetSizeWatcher(fleet_file, self.registry)
             if fleet_file else None
         )
+        # Continuous deployment (ISSUE 20): when follow_checkpoints
+        # names a trainer checkpoint dir, the worker attaches a
+        # :class:`~.deploy.CheckpointFollower` once the engine exists.
+        # Candidates are gated (fsck + finite + avals-match) BEFORE any
+        # weight touches the engine, and swaps land between scheduler
+        # steps on the single worker thread — a burst boundary by
+        # construction, never mid-dispatch.
+        self._follow_checkpoints = follow_checkpoints
+        self._follow_poll_s = float(follow_poll_s)
+        self._follow_process_count = int(follow_process_count)
+        self._canary_fraction = float(canary_fraction)
+        self._canary_warmup = int(canary_warmup)
+        self._promote_after = int(promote_after)
+        self._rollback_after = int(rollback_after)
+        self._deploy_seed = int(deploy_seed)
+        self._deploy_slo_specs = list(deploy_slo_specs or [])
+        self._follower: Optional[deploylib.CheckpointFollower] = None
         self._queue: queue.Queue = queue.Queue()
         self._ids = itertools.count()
         self._draining = threading.Event()
@@ -682,6 +709,24 @@ class LMServer:
             )
 
             self._engine = engine
+            follower = None
+            if self._follow_checkpoints:
+                follower = deploylib.CheckpointFollower(
+                    self._follow_checkpoints,
+                    engine,
+                    workdir=self.workdir or ".",
+                    process_index=self.process_index,
+                    registry=self.registry,
+                    process_count=self._follow_process_count,
+                    canary_fraction=self._canary_fraction,
+                    seed=self._deploy_seed,
+                    canary_warmup=self._canary_warmup,
+                    promote_after=self._promote_after,
+                    rollback_after=self._rollback_after,
+                    slo_specs=self._deploy_slo_specs,
+                    poll_interval_s=self._follow_poll_s,
+                )
+                self._follower = follower
             sched = ContinuousBatchingScheduler(
                 engine,
                 max_prefill_tokens=self._max_prefill_tokens,
@@ -694,6 +739,7 @@ class LMServer:
                 ),
                 admission=self.admission,
                 backpressure=self.backpressure,
+                deploy=follower,
             )
         except BaseException as e:  # noqa: BLE001 — surface via drain()
             self._fatal = e
@@ -731,6 +777,13 @@ class LMServer:
                 self._paused.clear()
             if self._ts_writer is not None:
                 self._ts_writer.maybe_write()  # rate-limited internally
+            if follower is not None and not draining:
+                # Between sched.step() calls = a burst boundary: no
+                # dispatch is in flight, so a swap can never tear a
+                # request's weights.  Clock reads stay HERE — deploy.py
+                # sits inside dtm-lint's determinism scope and only
+                # ever receives timestamps.
+                follower.poll(time.perf_counter(), time.time())
             if sched.has_work:
                 for comp in sched.step():
                     handle = pending.pop(comp.request_id, None)
@@ -868,6 +921,27 @@ def _drill_engine_factory(args, role: str = "monolithic"):
                 return real_prefill(items)
 
             engine.prefill_batch = throttled_prefill
+        stall_version = getattr(args, "stall_version", None)
+        stall_version_ms = getattr(args, "stall_canary_ms", 0.0)
+        if stall_version is not None and stall_version_ms:
+            # Deploy-drill fault injection: stall only the waves that
+            # carry the named weight version.  While that version
+            # canaries, its routed fraction's TTFT regresses and the
+            # per-version SLO monitor breaches; primary traffic keeps
+            # its latency, proving the rollback verdict is attributed
+            # to the candidate, not the fleet.
+            vic = int(stall_version)
+            real_prefill = engine.prefill_batch
+
+            def version_stalled_prefill(items):
+                if any(
+                    engine.slot_version(item[0]) == vic
+                    for item in items
+                ):
+                    time.sleep(stall_version_ms / 1000.0)
+                return real_prefill(items)
+
+            engine.prefill_batch = version_stalled_prefill
         return engine
 
     return build
@@ -978,6 +1052,15 @@ def _replica_main(args) -> int:
         admission=admission,
         backpressure=gate,
         fleet_file=args.fleet_file,
+        follow_checkpoints=args.follow_checkpoints,
+        follow_poll_s=args.follow_poll_s,
+        follow_process_count=args.follow_process_count,
+        canary_fraction=args.canary_fraction,
+        canary_warmup=args.canary_warmup,
+        promote_after=args.promote_after,
+        rollback_after=args.rollback_after,
+        deploy_seed=args.deploy_seed,
+        deploy_slo_specs=args.deploy_slo or args.slo,
     )
     server.start()
     outstanding: dict = {}  # request_id -> (handle, request name)
@@ -1018,6 +1101,11 @@ def _replica_main(args) -> int:
                     "ttft_s": comp.ttft_s,
                     "tpot_s": comp.tpot_s,
                     "replica": replica,
+                    # The weight version this request was pinned to at
+                    # admission — the deploy drill replays each
+                    # surviving stream against a solo generate() with
+                    # exactly this checkpoint's params.
+                    "version": getattr(comp, "version", 0),
                 },
             )
             del outstanding[rid]
@@ -1309,6 +1397,63 @@ def main(argv=None) -> int:
         help="fault injection: sleep this long before every prefill "
         "wave (serve_drill.py's SLO arm uses it to force a TTFT "
         "breach)",
+    )
+    p.add_argument(
+        "--follow-checkpoints", default=None,
+        help="trainer checkpoint directory to follow for continuous "
+        "deployment: newly fleet-valid steps are gated (fsck + finite "
+        "+ avals-match), canaried on a deterministic traffic fraction, "
+        "and promoted or rolled back on SLO verdicts — all without a "
+        "restart or recompile",
+    )
+    p.add_argument(
+        "--follow-poll-s", type=float, default=0.25,
+        help="checkpoint-follower scan/evaluate cadence",
+    )
+    p.add_argument(
+        "--follow-process-count", type=int, default=1,
+        help="trainer process count the fleet-valid sidecar check "
+        "expects (1 = single-process trainer, no sidecars)",
+    )
+    p.add_argument(
+        "--canary-fraction", type=float, default=0.25,
+        help="deterministic (seeded, rid-hashed) traffic fraction "
+        "routed to a canarying candidate version",
+    )
+    p.add_argument(
+        "--canary-warmup", type=int, default=8,
+        help="canary-routed samples observed before SLO verdicts "
+        "count toward promotion (breach evidence accrues even during "
+        "warmup — a bad candidate never hides behind it)",
+    )
+    p.add_argument(
+        "--promote-after", type=int, default=6,
+        help="consecutive clean canary evaluations before promotion",
+    )
+    p.add_argument(
+        "--rollback-after", type=int, default=2,
+        help="consecutive breached canary evaluations before rollback",
+    )
+    p.add_argument(
+        "--deploy-seed", type=int, default=0,
+        help="seed for the rid-hash canary router (replicas sharing a "
+        "seed make identical routing decisions)",
+    )
+    p.add_argument(
+        "--deploy-slo", action="append", default=[],
+        help="SLO spec (repeatable, same grammar as --slo) evaluated "
+        "against the CANARY version's own samples (default: reuse "
+        "--slo specs)",
+    )
+    p.add_argument(
+        "--stall-version", type=int, default=None,
+        help="fault injection: stall prefill waves carrying this "
+        "weight version (pair with --stall-canary-ms; the deploy "
+        "drill uses it to force an SLO-breach rollback)",
+    )
+    p.add_argument(
+        "--stall-canary-ms", type=float, default=0.0,
+        help="how long each stalled --stall-version wave sleeps",
     )
     p.add_argument(
         "--self-sigterm-after", type=int, default=0,

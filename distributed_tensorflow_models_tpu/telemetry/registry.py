@@ -245,6 +245,37 @@ SERVE_BACKPRESSURE_ENGAGED = "serve/backpressure_engaged"  # counter
 SERVE_FLEET_SIZE = "serve/fleet_size"  # gauge
 SERVE_SCALE_UP = "serve/scale_up"  # counter
 SERVE_SCALE_DOWN = "serve/scale_down"  # counter
+# Continuous deployment (ISSUE 20; serving/deploy.py follows the
+# trainer's checkpoints into the live engine).  The deploy family
+# exists only when a CheckpointFollower is attached
+# (--follow-checkpoints) and is full-set-or-absent, mirroring the
+# scale trio: SWAPS counts weight versions promoted into the primary
+# slot (hot-swap — zero recompiles, the compiled pins prove it),
+# ROLLBACKS counts canaried candidates withdrawn on SLO breach, and
+# REJECTED counts candidates the gate refused BEFORE they touched a
+# live program (torn / non-finite / aval-drifted — each leaves a
+# flight record + deploy_events.jsonl line).  VERSION_ACTIVE /
+# VERSION_CANARY are the replica's live commitments (checkpoint step
+# ids; canary −1 = none).  The per-version families are keyed
+# ``serve/version/<stat>/<vid>`` — requests / tokens / shed counters
+# plus ttft_s / tpot_s timers — so a canary's latency distribution is
+# separable from the primary's in the same artifact; for every vid
+# observed the five stats appear together (full-set-per-version,
+# enforced by check_metrics_schema --serving-report).
+SERVE_DEPLOY_SWAPS = "serve/deploy_swaps"  # counter
+SERVE_DEPLOY_ROLLBACKS = "serve/deploy_rollbacks"  # counter
+SERVE_DEPLOY_REJECTED = "serve/deploy_rejected_candidates"  # counter
+SERVE_VERSION_ACTIVE = "serve/version/active"  # gauge (step id)
+SERVE_VERSION_CANARY = "serve/version/canary"  # gauge (step id | -1)
+SERVE_VERSION_REQUESTS = "serve/version/requests"  # counter family: /<vid>
+SERVE_VERSION_TOKENS = "serve/version/tokens"  # counter family: /<vid>
+SERVE_VERSION_SHED = "serve/version/shed"  # counter family: /<vid>
+SERVE_VERSION_TTFT = "serve/version/ttft_s"  # timer family: /<vid>
+SERVE_VERSION_TPOT = "serve/version/tpot_s"  # timer family: /<vid>
+# Spec-decode acceptance split per version — present only when BOTH
+# deploy and speculation are on (conditional like serve/spec_*, so it
+# sits outside the five-stat per-version full set).
+SERVE_VERSION_ACCEPTANCE = "serve/version/acceptance_rate"  # timer: /<vid>
 
 
 class Counter:

@@ -148,7 +148,9 @@ SLO_MARGIN_PREFIX = "serve/slo_margin/"
 
 
 def _serve_negative_ok(key: str) -> bool:
-    return key.startswith(SLO_MARGIN_PREFIX)
+    # Margins go negative on breach; the canary gauge idles at -1
+    # (deploy.NO_CANARY) between canaries by contract.
+    return key.startswith(SLO_MARGIN_PREFIX) or key == "serve/version/canary"
 # Restart-MTTR gauges TelemetryHook injects together (README
 # "Performance"); a partial set on a row is a writer bug, like the sets
 # above.  Values are overlapped wall readings — non-negative seconds.
@@ -353,6 +355,26 @@ SERVING_BACKPRESSURE_ENGAGED = "serve/backpressure_engaged"
 SERVING_SCALE_KEYS = (
     "serve/fleet_size", "serve/scale_up", "serve/scale_down",
 )
+# Continuous-deployment keys (serving/deploy.py): a replica started
+# with --follow-checkpoints pre-creates the swap/rollback/reject
+# counters and both version gauges at follower construction — full set
+# or none.  Per-version splits (serve/version/<stat>/<vid>) are created
+# five-at-a-time at a version's first routing, so every sighted vid
+# must carry the whole five-stat set; serve/version/acceptance_rate/
+# <vid> is speculation-conditional (like serve/spec_*) and deliberately
+# outside the set.
+SERVING_DEPLOY_COUNTERS = (
+    "serve/deploy_swaps", "serve/deploy_rollbacks",
+    "serve/deploy_rejected_candidates",
+)
+SERVING_DEPLOY_GAUGES = ("serve/version/active", "serve/version/canary")
+SERVING_VERSION_COUNTER_PREFIXES = (
+    "serve/version/requests/", "serve/version/tokens/",
+    "serve/version/shed/",
+)
+SERVING_VERSION_TIMER_PREFIXES = (
+    "serve/version/ttft_s/", "serve/version/tpot_s/",
+)
 
 
 def check_serving_report(report) -> list[str]:
@@ -536,6 +558,51 @@ def check_serving_report(report) -> list[str]:
             f"partial autoscale key set {scale_present} "
             f"(expected all of {list(SERVING_SCALE_KEYS)} together)"
         )
+    # Deploy section: counters + version gauges pre-created together by
+    # --follow-checkpoints — full set or none (the canary gauge's -1
+    # idle value already passed the negativity sweep by allowlist).
+    deploy_keys = SERVING_DEPLOY_COUNTERS + SERVING_DEPLOY_GAUGES
+    deploy_present = [k for k in deploy_keys if k in snap]
+    if deploy_present and len(deploy_present) != len(deploy_keys):
+        errors.append(
+            f"partial deploy key set {deploy_present} "
+            f"(expected all of {list(deploy_keys)} together)"
+        )
+    # Per-version splits: every sighted vid carries the whole five-stat
+    # set (requests/tokens/shed counters + ttft/tpot timers) — the
+    # scheduler creates them five-at-a-time at first routing, so a
+    # widowed vid key is a writer regression, never light load.
+    vids: set = set()
+    for prefix in SERVING_VERSION_COUNTER_PREFIXES:
+        vids |= {k[len(prefix):] for k in snap if k.startswith(prefix)}
+    for prefix in SERVING_VERSION_TIMER_PREFIXES:
+        vids |= {
+            k[len(prefix):-len("/count")]
+            for k in snap
+            if k.startswith(prefix) and k.endswith("/count")
+        }
+    if vids and not deploy_present:
+        errors.append(
+            f"per-version keys for versions {sorted(vids)} without the "
+            "deploy counter/gauge family"
+        )
+    for vid in sorted(vids):
+        for prefix in SERVING_VERSION_COUNTER_PREFIXES:
+            if f"{prefix}{vid}" not in snap:
+                errors.append(
+                    f"version {vid}: counter {prefix}{vid} missing"
+                )
+        for prefix in SERVING_VERSION_TIMER_PREFIXES:
+            if f"{prefix}{vid}/count" not in snap:
+                errors.append(
+                    f"version {vid}: timer {prefix}{vid} missing "
+                    "(no /count)"
+                )
+            if f"{prefix}{vid}/p99_s" not in snap:
+                errors.append(
+                    f"version {vid}: p99 expansion {prefix}{vid}/p99_s "
+                    "missing"
+                )
     return errors
 
 

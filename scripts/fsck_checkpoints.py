@@ -28,7 +28,20 @@ the next run's ``latest_step`` is the newest *valid* step again — run it
 after a crash leaves damage, or when the restore-hardening log told you
 to.  ``--json`` emits the machine-readable report instead.
 
-No jax/orbax import: safe on a login host against live training dirs.
+``--serving-candidate STEP`` answers a different question: is this
+step adoptable by a live serving fleet?  It runs the exact pre-swap
+gate ``serving/deploy.py`` applies — fleet-valid structure (fsck +
+every process's dataset sidecar), finite weights, and (with
+``--expected-signature``, a JSON ``[path, shape, dtype]`` list as
+emitted by this mode or ``deploy.tree_signature``) avals-match against
+the serving config.  Exit 0 = adoptable (usable as a deploy
+pre-gate), 1 = rejected, with the reasons on stdout and a
+``structural`` marker distinguishing "save may still be landing"
+(retryable) from final NaN/aval rejections.
+
+No jax/orbax import on the default path: safe on a login host against
+live training dirs.  ``--serving-candidate`` restores the weight tree
+and therefore imports orbax, function-level, only behind that flag.
 """
 
 from __future__ import annotations
@@ -63,7 +76,21 @@ def main(argv=None) -> int:
         "so latest_step becomes the newest valid step",
     )
     p.add_argument("--json", action="store_true", help="emit the raw report")
+    p.add_argument(
+        "--serving-candidate", type=int, default=None, metavar="STEP",
+        help="run the serving deploy pre-gate on this step (fleet-valid "
+        "+ finite + avals vs --expected-signature); exit 0 = adoptable",
+    )
+    p.add_argument(
+        "--expected-signature", default=None, metavar="SIG_JSON",
+        help="with --serving-candidate: JSON [path, shape, dtype] list "
+        "the candidate's weight tree must match exactly (produce one "
+        "by running --serving-candidate WITHOUT this flag, or from a "
+        "live engine via serving.deploy.tree_signature)",
+    )
     args = p.parse_args(argv)
+    if args.expected_signature and args.serving_candidate is None:
+        p.error("--expected-signature needs --serving-candidate")
 
     ckpt_dir = args.path
     nested = os.path.join(args.path, "checkpoints")
@@ -72,6 +99,53 @@ def main(argv=None) -> int:
     if not os.path.isdir(ckpt_dir):
         print(f"error: no checkpoint directory at {ckpt_dir}", file=sys.stderr)
         return 2
+
+    if args.serving_candidate is not None:
+        # Deploy pre-gate mode: the same admission the live follower
+        # applies, runnable standalone (CI, an operator's shell, or a
+        # deploy pipeline's gate step before pointing a fleet at it).
+        from distributed_tensorflow_models_tpu.serving import (  # noqa: E402
+            deploy as deploylib,
+        )
+
+        expected = None
+        if args.expected_signature:
+            with open(args.expected_signature) as f:
+                expected = tuple(
+                    (path, tuple(shape), dtype)
+                    for path, shape, dtype in json.load(f)
+                )
+        params, reasons, structural = deploylib.gate_candidate(
+            ckpt_dir, args.serving_candidate,
+            process_count=args.process_count,
+            expected_signature=expected,
+        )
+        verdict = {
+            "step": args.serving_candidate,
+            "adoptable": not reasons,
+            "reasons": reasons,
+            "structural": structural,
+        }
+        if params is not None and expected is None:
+            # No reference to compare against: emit the candidate's own
+            # signature, reusable verbatim as --expected-signature input.
+            verdict["signature"] = [
+                [path, list(shape), dtype]
+                for path, shape, dtype in deploylib.tree_signature(params)
+            ]
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            if verdict["adoptable"]:
+                print(f"step {args.serving_candidate}: ADOPTABLE")
+            else:
+                kind = "structural (retryable)" if structural else "final"
+                print(
+                    f"step {args.serving_candidate}: REJECTED ({kind})"
+                )
+                for reason in reasons:
+                    print(f"    {reason}")
+        return 0 if verdict["adoptable"] else 1
 
     report = fsck.fsck_checkpoints(ckpt_dir, args.process_count)
     repaired = []

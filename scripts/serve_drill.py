@@ -69,6 +69,18 @@ and every surviving stream is byte-identical to an unresized reference
 run of the same trace — scaling is a capacity knob, never a token
 knob.
 
+The deploy arm (``--no-deploy`` skips) certifies continuous deployment
+end to end: a staged "trainer" publishes checkpoints at cadence while a
+1-replica fleet runs with ``--follow-checkpoints`` — two good steps
+hot-swap in live (canary → promote, ZERO recompiles: the compiled
+program counters must not move), a NaN-poisoned step and a torn step
+are rejected BEFORE touching the engine (each with a flight record), a
+good-weights-but-slow step (per-version prefill stall) canaries,
+breaches its TTFT SLO and rolls back — all with zero dropped or
+duplicated responses, and every response byte-identical to a solo
+generate() under the weights of the version it was ADMITTED to (the
+version stamp each response carries).
+
 The parent process never imports jax (safe on a login host); all device
 work happens in the spawned replicas.  Exit 0 when every check passes.
 
@@ -95,6 +107,7 @@ if _REPO not in sys.path:  # runnable as a script from anywhere
 
 from distributed_tensorflow_models_tpu import launch  # noqa: E402
 from distributed_tensorflow_models_tpu.serving import admission as admlib  # noqa: E402
+from distributed_tensorflow_models_tpu.serving import deploy as deploylib  # noqa: E402
 from distributed_tensorflow_models_tpu.serving import replay as replaylib  # noqa: E402
 
 PORT = 9871
@@ -1322,6 +1335,511 @@ def run_autoscale_arm(
     return errors, responses
 
 
+# -- deploy arm ------------------------------------------------------------
+# The staged timeline: (step, expected terminal event, reason marker).
+# Steps 2 and 4 are good weights (promote); 6 is NaN-poisoned (final
+# semantic reject); 7 is a torn layout (structural reject after the
+# retry polls); 9 restores clean but its canary traffic is stalled
+# via --stall-version, breaching the deploy SLO (rollback).
+DEPLOY_TIMELINE = (
+    (2, "promote", None),
+    (4, "promote", None),
+    (6, "reject", "non-finite"),
+    (7, "reject", "fsck"),
+    (9, "rollback", None),
+)
+DEPLOY_FRACTION = 0.5
+DEPLOY_SEED = 0
+DEPLOY_WARMUP = 2
+DEPLOY_STALL_MS = 2500.0
+DEPLOY_SLO = f"cttft=serve/ttft_s:p99<{SLO_THRESHOLD_S}@30s"
+DEPLOY_PHASE = 8  # requests per timeline phase (extended per routing)
+
+
+def _deploy_model_and_engine():
+    """The replica's built-in drill model (see server._drill_engine_
+    factory: params from seed 0) plus an engine/scheduler pair — child
+    helper only, imports jax."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_models_tpu.models import get_model
+
+    model = get_model(
+        "transformer_lm", vocab_size=64, num_layers=2, num_heads=2,
+        d_model=32, d_ff=64, max_len=64, dropout_rate=0.0,
+        dtype=jnp.float32, attn_impl="reference",
+    )
+    dummy = jnp.zeros((1, 4), jnp.int32)
+
+    def init(seed):
+        return model.init(jax.random.key(seed), dummy)["params"]
+
+    return model, init
+
+
+def _deploy_helper_main(mode: str, spec_path: str) -> int:
+    """Child-process entry (the parent stays jax-free).
+
+    ``build-staging`` plays the trainer: one orbax save per timeline
+    step into a staging dir (the parent publishes them at cadence by
+    atomic rename), candidate weights seeded by step id so every
+    version decodes differently.  ``solo-ref`` computes byte-identity
+    references: for each version, restore its weights and run every
+    request that version answered through a fresh engine."""
+    with open(spec_path) as f:
+        spec = json.load(f)
+    model, init = _deploy_model_and_engine()
+    if mode == "build-staging":
+        import jax
+        import numpy as np
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        for entry in spec["steps"]:
+            step = int(entry["step"])
+            params = init(step)
+            if entry.get("poison"):
+                params = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x) * np.float32("nan"), params
+                )
+            step_dir = os.path.join(spec["staging"], str(step))
+            os.makedirs(step_dir, exist_ok=True)
+            ckptr.save(os.path.join(step_dir, "state"), {"params": params})
+            ckptr.wait_until_finished()
+            with open(
+                os.path.join(step_dir, "_CHECKPOINT_METADATA"), "w"
+            ) as f:
+                f.write("{}")
+            side = os.path.join(
+                spec["staging"], "dataset_states", str(step)
+            )
+            os.makedirs(side, exist_ok=True)
+            with open(os.path.join(side, "p0.json"), "w") as f:
+                json.dump({"step": step, "process_count": 1}, f)
+        return 0
+    if mode == "solo-ref":
+        import numpy as np
+
+        from distributed_tensorflow_models_tpu.serving.engine import (
+            InferenceEngine,
+        )
+        from distributed_tensorflow_models_tpu.serving.scheduler import (
+            ContinuousBatchingScheduler,
+            Request,
+        )
+
+        out: dict[str, list[int]] = {}
+        for ver, reqs in sorted(spec["versions"].items()):
+            vid = int(ver)
+            if vid == 0:
+                params = init(0)
+            else:
+                import orbax.checkpoint as ocp
+
+                params = ocp.StandardCheckpointer().restore(
+                    os.path.join(spec["ckpt_dir"], str(vid), "state")
+                )["params"]
+            eng = InferenceEngine(
+                model, params, max_slots=4, prefill_chunk=8
+            )
+            sched = ContinuousBatchingScheduler(eng)
+            for r in reqs:
+                sched.submit(Request(
+                    request_id=int(r["request_id"]),
+                    prompt=np.asarray(r["prompt"], np.int32),
+                    max_new_tokens=int(r["max_new_tokens"]),
+                ))
+            while sched.has_work:
+                for comp in sched.step():
+                    out[str(comp.request_id)] = [
+                        int(t) for t in comp.tokens
+                    ]
+        with open(spec["out"], "w") as f:
+            json.dump(out, f)
+        return 0
+    print(f"unknown --deploy-helper mode {mode!r}", file=sys.stderr)
+    return 2
+
+
+def _deploy_phase_reqs(first_id: int, *, min_canary: int) -> list[dict]:
+    """One phase of greedy requests (greedy so solo references need no
+    sampling-key bookkeeping).  Routing is a pure rid-hash, so the
+    parent PRE-COMPUTES the canary share and extends the phase until at
+    least ``min_canary`` rids would route to a canary — warmup can then
+    never starve deterministically."""
+    specs: list[dict] = []
+    canary = 0
+    rid = first_id
+    while len(specs) < DEPLOY_PHASE or canary < min_canary:
+        if deploylib.rid_fraction(DEPLOY_SEED, str(rid)) < DEPLOY_FRACTION:
+            canary += 1
+        prompt = [(5 + 3 * rid + j) % 64 for j in range(4 + rid % 4)]
+        specs.append({
+            "request_id": rid, "prompt": prompt,
+            "max_new_tokens": 5 + rid % 3,
+            "temperature": 0.0, "top_k": 0, "top_p": 1.0,
+        })
+        rid += 1
+    return specs
+
+
+def _emit_paced(queue_dir: str, specs: list[dict],
+                gap_s: float = 0.04) -> None:
+    for spec in specs:
+        path = os.path.join(queue_dir, f"req-{spec['request_id']}.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(spec, f)
+        os.replace(path + ".tmp", path)
+        time.sleep(gap_s)
+
+
+def _wait_responses(queue_dir: str, want: set[int],
+                    timeout_s: float) -> bool:
+    resp_dir = os.path.join(queue_dir, "resp")
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        have = {
+            int(n.split("-")[1].split(".")[0])
+            for n in os.listdir(resp_dir) if n.endswith(".json")
+        } if os.path.isdir(resp_dir) else set()
+        if want <= have:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _wait_deploy_event(workdir: str, event: str, step: int,
+                       timeout_s: float) -> bool:
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        for row in deploylib.load_deploy_events(workdir):
+            if row.get("event") == event and row.get("step") == step:
+                return True
+        time.sleep(0.05)
+    return False
+
+
+def _publish_step(staging: str, ckpt_dir: str, step: int) -> None:
+    """Atomic-rename a staged step (sidecars FIRST, so the step is
+    fleet-valid from the instant the follower can see it)."""
+    side_src = os.path.join(staging, "dataset_states", str(step))
+    if os.path.isdir(side_src):
+        dst_base = os.path.join(ckpt_dir, "dataset_states")
+        os.makedirs(dst_base, exist_ok=True)
+        os.replace(side_src, os.path.join(dst_base, str(step)))
+    os.replace(
+        os.path.join(staging, str(step)), os.path.join(ckpt_dir, str(step))
+    )
+
+
+def _deploy_trainer(queue_dir: str, workdir: str, ckpt_dir: str,
+                    staging: str, phases: list[list[dict]],
+                    errors: list[str]) -> None:
+    """Parent-thread trainer-and-pacer: warm the fleet (first-dispatch
+    compile time must not contaminate canary TTFT windows), then walk
+    the timeline — publish a step, let its canary (if any) start, offer
+    a phase of traffic, and wait for the step's terminal verdict —
+    publishing DONE at the end."""
+    _emit_paced(queue_dir, phases[0])
+    if not _wait_responses(
+        queue_dir, {s["request_id"] for s in phases[0]}, 180.0
+    ):
+        errors.append("deploy: warmup phase never fully answered")
+    for (step, event, _), phase in zip(DEPLOY_TIMELINE, phases[1:]):
+        _publish_step(staging, ckpt_dir, step)
+        if event in ("promote", "rollback"):
+            # Gate traffic on the canary actually existing, so every
+            # phase rid routes against it (pure-hash determinism).
+            if not _wait_deploy_event(workdir, "canary_start", step, 60.0):
+                errors.append(f"deploy: step {step} canary never started")
+                break
+        _emit_paced(queue_dir, phase)
+        if not _wait_deploy_event(workdir, event, step, 120.0):
+            errors.append(
+                f"deploy: no {event} for step {step} within 120s"
+            )
+            break
+    done = os.path.join(queue_dir, "DONE")
+    with open(done + ".tmp", "w") as f:
+        f.write("done\n")
+    os.replace(done + ".tmp", done)
+
+
+def run_deploy_arm(scratch: str, *, port: int) -> list[str]:
+    """Continuous-deployment drill: live hot-swaps, pre-swap rejects,
+    and an SLO-gated rollback against one followed checkpoint dir."""
+    errors: list[str] = []
+    queue_dir = os.path.join(scratch, "queue")
+    workdir = os.path.join(scratch, "wd")
+    ckpt_dir = os.path.join(scratch, "ckpts")
+    staging = os.path.join(scratch, "staging")
+    for d in (queue_dir, workdir, ckpt_dir, staging):
+        os.makedirs(d, exist_ok=True)
+
+    # Stage every candidate in a child (the parent never imports jax);
+    # step 7's torn layout needs no weights — fabricate it here.
+    helper_spec = os.path.join(scratch, "staging_spec.json")
+    with open(helper_spec, "w") as f:
+        json.dump({
+            "staging": staging,
+            "steps": [
+                {"step": step, "poison": reason == "non-finite"}
+                for step, _, reason in DEPLOY_TIMELINE
+                if reason != "fsck"
+            ],
+        }, f)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--deploy-helper", "build-staging", "--helper-spec", helper_spec],
+        capture_output=True, text=True,
+        env={**os.environ, **_fleet_env()},
+    )
+    if proc.returncode != 0:
+        errors.append(f"deploy: staging builder failed: {proc.stderr}")
+        return errors
+    torn_dir = os.path.join(staging, "7", "state")
+    os.makedirs(torn_dir, exist_ok=True)
+    for name in ("_CHECKPOINT_METADATA", os.path.join("state", "_METADATA")):
+        with open(os.path.join(staging, "7", name), "w") as f:
+            f.write("{}")
+    # no state/manifest.ocdbt: the torn-write signature
+
+    # Phases: warmup + one per timeline step.  Promote/rollback phases
+    # are extended until the rid-hash guarantees enough canary traffic.
+    phases: list[list[dict]] = []
+    next_id = 0
+    phases.append(_deploy_phase_reqs(next_id, min_canary=0))  # warmup
+    next_id += len(phases[-1])
+    for _, event, _reason in DEPLOY_TIMELINE:
+        need = DEPLOY_WARMUP + 1 if event in ("promote", "rollback") else 0
+        phases.append(_deploy_phase_reqs(next_id, min_canary=need))
+        next_id += len(phases[-1])
+    specs = {s["request_id"]: s for phase in phases for s in phase}
+
+    trainer = threading.Thread(
+        target=_deploy_trainer,
+        args=(queue_dir, workdir, ckpt_dir, staging, phases, errors),
+        daemon=True,
+    )
+    trainer.start()
+    argv = [
+        sys.executable, "-m",
+        "distributed_tensorflow_models_tpu.serving.server",
+        "--queue-dir", queue_dir, "--workdir", workdir,
+        "--max-slots", "4", "--prefill-chunk", "8",
+        "--drain-grace-s", "60",
+        "--follow-checkpoints", ckpt_dir,
+        "--follow-poll-s", "0.1",
+        "--canary-fraction", str(DEPLOY_FRACTION),
+        "--canary-warmup", str(DEPLOY_WARMUP),
+        "--promote-after", "2",
+        "--rollback-after", "1",
+        "--deploy-seed", str(DEPLOY_SEED),
+        "--deploy-slo", DEPLOY_SLO,
+        "--stall-version", "9",
+        "--stall-canary-ms", str(DEPLOY_STALL_MS),
+        "--timeseries-interval-s", "0.5",
+        "--timeout", "240",
+    ]
+    try:
+        codes = launch.launch_local(
+            1, argv, port=port, timeout=420.0, extra_env=_fleet_env()
+        )
+    finally:
+        trainer.join(timeout=60)
+    if trainer.is_alive():
+        errors.append("deploy: trainer thread still running after exit")
+    if launch.aggregate_exit_codes(codes) != 0:
+        errors.append(f"deploy: fleet exit codes {codes}")
+
+    responses = _audit_exactly_once(queue_dir, specs, errors, "deploy")
+    for rid, resp in sorted(responses.items()):
+        want = specs[rid]["max_new_tokens"]
+        if len(resp["tokens"]) != want:
+            errors.append(
+                f"deploy: request {rid}: {len(resp['tokens'])} tokens, "
+                f"expected {want}"
+            )
+        if "version" not in resp:
+            errors.append(f"deploy: request {rid} has no version stamp")
+
+    # -- deploy journal: the exact staged timeline -------------------------
+    events = deploylib.load_deploy_events(workdir)
+    by_kind: dict[str, list[dict]] = {}
+    for row in events:
+        by_kind.setdefault(row["event"], []).append(row)
+    promoted = [r["step"] for r in by_kind.get("promote", [])]
+    if promoted != [2, 4]:
+        errors.append(f"deploy: promotes {promoted}, expected [2, 4]")
+    rolled = [r["step"] for r in by_kind.get("rollback", [])]
+    if rolled != [9]:
+        errors.append(f"deploy: rollbacks {rolled}, expected [9]")
+    started = [r["step"] for r in by_kind.get("canary_start", [])]
+    if started != [2, 4, 9]:
+        errors.append(f"deploy: canary starts {started}, expected [2,4,9]")
+    rejects = {r["step"]: r for r in by_kind.get("reject", [])}
+    if sorted(rejects) != [6, 7]:
+        errors.append(
+            f"deploy: rejects {sorted(rejects)}, expected [6, 7]"
+        )
+    for step, _, marker in DEPLOY_TIMELINE:
+        if marker and step in rejects and not any(
+            marker in reason for reason in rejects[step].get("reasons", [])
+        ):
+            errors.append(
+                f"deploy: step {step} reject reasons "
+                f"{rejects[step].get('reasons')} carry no {marker!r}"
+            )
+    for row in by_kind.get("rollback", []):
+        if not row.get("breached"):
+            errors.append(
+                "deploy: rollback row records no breached SLOs — the "
+                "rollback must be SLO-evidenced, not spurious"
+            )
+
+    # -- stats: swap/reject counters, version gauges, compile pins ---------
+    stats_path = os.path.join(workdir, "serving_stats_p0.json")
+    for path, flag in (
+        (os.path.join(workdir, "flight_recorder_p0.json"),
+         "--flight-recorder"),
+        (stats_path, "--serving-report"),
+        (os.path.join(workdir, "timeseries_p0.jsonl"), "--timeseries"),
+    ):
+        if not os.path.exists(path):
+            errors.append(f"deploy: missing artifact {path}")
+        else:
+            _schema_check(path, flag, errors)
+    vids_served: set[int] = set()
+    if os.path.exists(stats_path):
+        with open(stats_path) as f:
+            snap = json.load(f)["metrics"]
+        for key, want in (
+            ("serve/deploy_swaps", 2.0),
+            ("serve/deploy_rollbacks", 1.0),
+            ("serve/deploy_rejected_candidates", 2.0),
+            ("serve/version/active", 4.0),
+            ("serve/version/canary", -1.0),
+        ):
+            if snap.get(key) != want:
+                errors.append(
+                    f"deploy: {key} = {snap.get(key)!r}, expected {want}"
+                )
+        # ZERO recompiles across two hot-swaps and a rollback: still
+        # exactly one prefill and one decode program.
+        pins = (
+            snap.get("serve/compiled_prefill"),
+            snap.get("serve/compiled_decode"),
+        )
+        if pins != (1.0, 1.0):
+            errors.append(
+                f"deploy: compiled (prefill, decode) programs {pins}, "
+                "expected (1.0, 1.0) — a hot-swap recompiled"
+            )
+        vids_stats = {
+            int(k.rsplit("/", 1)[1]) for k in snap
+            if k.startswith("serve/version/requests/")
+        }
+        vids_served = {int(r["version"]) for r in responses.values()
+                       if "version" in r}
+        if vids_stats != vids_served:
+            errors.append(
+                f"deploy: per-version stats families {sorted(vids_stats)}"
+                f" != versions in responses {sorted(vids_served)}"
+            )
+        if not {0, 2, 4} <= vids_served:
+            errors.append(
+                f"deploy: responses span versions {sorted(vids_served)} — "
+                "expected v0, v2 and v4 traffic across the two swaps"
+            )
+
+    # -- per-event flight records ------------------------------------------
+    n_flights = sum(
+        len(by_kind.get(k, []))
+        for k in ("canary_start", "promote", "rollback", "reject")
+    )
+    for k in range(n_flights):
+        path = os.path.join(workdir, f"flight_deploy_p0_{k}.json")
+        if not os.path.exists(path):
+            errors.append(f"deploy: event {k} left no flight record")
+        else:
+            _schema_check(path, "--flight-recorder", errors)
+
+    # -- report: deploy timeline + per-version table -----------------------
+    report_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "serving_report.py")
+    proc = subprocess.run(
+        [sys.executable, report_py, workdir, "--json"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        errors.append(f"deploy: serving_report failed: {proc.stderr}")
+    else:
+        report = json.loads(proc.stdout)
+        dep = report.get("deploy") or {}
+        if len(dep.get("events", [])) != len(events):
+            errors.append(
+                f"deploy: report timeline has "
+                f"{len(dep.get('events', []))} events, journal has "
+                f"{len(events)}"
+            )
+        table_vids = {int(r["version"]) for r in dep.get("versions", [])}
+        if not vids_served <= table_vids:
+            errors.append(
+                f"deploy: report version table covers {sorted(table_vids)}"
+                f", responses saw {sorted(vids_served)}"
+            )
+
+    # -- byte-identity: every response vs its version's solo run ----------
+    by_version: dict[str, list[dict]] = {}
+    for rid, resp in responses.items():
+        if "version" in resp:
+            by_version.setdefault(str(resp["version"]), []).append(
+                specs[rid]
+            )
+    ref_out = os.path.join(scratch, "solo_ref.json")
+    ref_spec = os.path.join(scratch, "solo_spec.json")
+    with open(ref_spec, "w") as f:
+        json.dump({
+            "ckpt_dir": ckpt_dir, "out": ref_out,
+            "versions": by_version,
+        }, f)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--deploy-helper", "solo-ref", "--helper-spec", ref_spec],
+        capture_output=True, text=True,
+        env={**os.environ, **_fleet_env()},
+    )
+    if proc.returncode != 0:
+        errors.append(f"deploy: solo-ref helper failed: {proc.stderr}")
+        return errors
+    with open(ref_out) as f:
+        refs = json.load(f)
+    diverged = 0
+    for rid, resp in sorted(responses.items()):
+        ref = refs.get(str(rid))
+        if ref is None:
+            errors.append(f"deploy: no solo reference for request {rid}")
+        elif resp["tokens"] != ref:
+            diverged += 1
+            if diverged <= 5:
+                errors.append(
+                    f"deploy: request {rid} (v{resp.get('version')}) "
+                    f"diverged from its version's solo generate: "
+                    f"{resp['tokens']} vs {ref}"
+                )
+    by_vid_count = {
+        v: len(rs) for v, rs in sorted(by_version.items(), key=lambda kv:
+                                       int(kv[0]))
+    }
+    print(
+        f"  deploy: {len(responses)} responses by version {by_vid_count}, "
+        f"{len(promoted)} promotes, {len(rolled)} rollback, "
+        f"{len(rejects)} rejects, {n_flights} flight records"
+    )
+    return errors
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=24)
@@ -1360,7 +1878,19 @@ def main(argv=None) -> int:
         help="skip the closed-loop autoscale arm and its unresized "
         "byte-identity reference run",
     )
+    p.add_argument(
+        "--no-deploy", action="store_true",
+        help="skip the continuous-deployment arm (hot-swap / canary / "
+        "SLO-gated promote-rollback against a followed checkpoint dir)",
+    )
+    # Child-process plumbing for the deploy arm (the parent never
+    # imports jax; staging saves and solo references run here).
+    p.add_argument("--deploy-helper", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--helper-spec", default=None, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
+
+    if args.deploy_helper:
+        return _deploy_helper_main(args.deploy_helper, args.helper_spec)
 
     # Pre-drill gate: the serving hot path is exactly what the new rule
     # packs police — a recompile hazard in prefill/decode turns the
@@ -1590,6 +2120,20 @@ def main(argv=None) -> int:
                         f"resize: {auto_resp[rid]['tokens']} vs "
                         f"{ref_resp[rid]['tokens']}"
                     )
+        if not args.no_deploy:
+            # Deploy arm: a staged trainer publishes checkpoints while
+            # the fleet follows them — two live hot-swaps (zero
+            # recompiles), NaN + torn candidates rejected pre-swap,
+            # one SLO-breach rollback, every stream byte-identical to
+            # its admitted version's solo run.
+            print(
+                "  deploy arm: follow-checkpoints timeline "
+                f"{[s for s, _, _ in DEPLOY_TIMELINE]}, canary "
+                f"fraction {DEPLOY_FRACTION}"
+            )
+            errors += run_deploy_arm(
+                os.path.join(scratch, "deploy"), port=PORT + 90
+            )
         failed = bool(errors)
         if errors:
             print("DRILL serve: FAIL", file=sys.stderr)

@@ -71,6 +71,13 @@ SUBMITTED_PREFIX = "serve/submitted/"
 SHED_PREFIX = "serve/shed/"
 BACKPRESSURE_GAUGE = "serve/backpressure"
 BACKPRESSURE_ENGAGED = "serve/backpressure_engaged"
+# Continuous-deployment artifacts (serving/deploy.py): the follower's
+# journal + its per-transition flight records, and the per-version
+# metric families the scheduler splits while a deploy is live.
+DEPLOY_EVENTS_NAME = "deploy_events.jsonl"
+VERSION_ACTIVE_GAUGE = "serve/version/active"
+VERSION_CANARY_GAUGE = "serve/version/canary"
+VERSION_REQUESTS_PREFIX = "serve/version/requests/"
 
 # |queue + prefill − ttft| must close within this (absolute floor;
 # scaled tolerance below for long requests).
@@ -142,6 +149,102 @@ def load_scale_events(workdir: str) -> list[dict]:
     except OSError:
         return []
     return events
+
+
+def load_deploy_events(workdir: str) -> tuple[list[dict], list[dict]]:
+    """The follower's ``deploy_events.jsonl`` rows (torn tail lines
+    skipped) plus the headline of every ``flight_deploy_p*_*.json``
+    record, both [] when the fleet never followed checkpoints."""
+    events: list[dict] = []
+    path = os.path.join(workdir, DEPLOY_EVENTS_NAME)
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    print(
+                        f"warning: skipping torn row in {path}",
+                        file=sys.stderr,
+                    )
+    except OSError:
+        pass
+    flights: list[dict] = []
+    for fpath in sorted(
+        glob.glob(os.path.join(workdir, "flight_deploy_p*_*.json"))
+    ):
+        obj = fleet_report._load_json(fpath)
+        if obj is not None:
+            flights.append(
+                {
+                    "file": os.path.basename(fpath),
+                    "reason": obj.get("reason"),
+                    "events": len(obj.get("events", [])),
+                }
+            )
+    return events, flights
+
+
+def version_table(
+    stats: dict[int, dict], deploy_events: list[dict]
+) -> list[dict]:
+    """Per (process, version) stat rows with the deploy verdict.
+
+    Stats come from the scheduler's ``serve/version/<stat>/<vid>``
+    splits; the verdict column merges the journal's transitions for
+    that version (terminal events win over ``canary_start``) with the
+    process's active/canary gauges at drain."""
+    outcomes: dict[str, str] = {}
+    for e in deploy_events:
+        step = e.get("step")
+        if step is None:
+            continue
+        vid = str(step)
+        kind = e.get("event")
+        if kind == "canary_start":
+            outcomes.setdefault(vid, "CANARYING")
+        elif kind == "promote":
+            outcomes[vid] = "PROMOTED"
+        elif kind == "rollback":
+            outcomes[vid] = "ROLLED_BACK"
+        elif kind == "reject":
+            outcomes[vid] = "REJECTED"
+        elif kind == "skip":
+            outcomes.setdefault(vid, "SKIPPED")
+    rows = []
+    for proc in sorted(stats):
+        m = stats[proc].get("metrics", {})
+        active = m.get(VERSION_ACTIVE_GAUGE)
+        canary = m.get(VERSION_CANARY_GAUGE)
+        vids = {
+            k[len(VERSION_REQUESTS_PREFIX):]
+            for k in m
+            if k.startswith(VERSION_REQUESTS_PREFIX)
+        }
+        for vid in sorted(vids, key=lambda v: (len(v), v)):
+            state = []
+            if active is not None and str(int(active)) == vid:
+                state.append("active@drain")
+            if canary is not None and canary >= 0 and str(int(canary)) == vid:
+                state.append("canary@drain")
+            rows.append(
+                {
+                    "proc": proc,
+                    "version": vid,
+                    "requests": m.get(f"{VERSION_REQUESTS_PREFIX}{vid}", 0),
+                    "tokens": m.get(f"serve/version/tokens/{vid}", 0),
+                    "shed": m.get(f"serve/version/shed/{vid}", 0),
+                    "ttft_p50_s": m.get(f"serve/version/ttft_s/{vid}/p50_s"),
+                    "ttft_p99_s": m.get(f"serve/version/ttft_s/{vid}/p99_s"),
+                    "tpot_p99_s": m.get(f"serve/version/tpot_s/{vid}/p99_s"),
+                    "verdict": outcomes.get(vid, "-"),
+                    "state": ",".join(state),
+                }
+            )
+    return rows
 
 
 def admission_summary(stats: dict[int, dict]) -> dict:
@@ -229,6 +332,7 @@ def build_waterfalls(
                 "tokens": None,
                 "finish_reason": None,
                 "ttft_s": None,
+                "version": None,
                 "done": False,
             },
         )
@@ -265,6 +369,8 @@ def build_waterfalls(
             w["tokens"] = args.get("tokens")
             w["finish_reason"] = args.get("reason")
             w["ttft_s"] = args.get("ttft_s")
+            # Weight version pinned at admission (deploy fleets only).
+            w["version"] = args.get("v")
 
     out = []
     for w in sorted(reqs.values(), key=lambda w: (w["t_first"] or 0.0)):
@@ -380,6 +486,7 @@ def build_report(
     events = fleet_report.merged_events(procs)
     stats = load_stats(workdir)
     timeseries = load_timeseries(workdir)
+    deploy_events, deploy_flights = load_deploy_events(workdir)
     waterfalls = build_waterfalls(events, tolerance_s)
     attributed = [w for w in waterfalls if w["attributed"]]
     sheds = [e for e in events if e["name"] == REQ_SHED]
@@ -407,6 +514,11 @@ def build_report(
             load_scale_events(workdir), timeseries
         ),
         "slo": slo_verdicts(stats, events),
+        "deploy": {
+            "events": align_scale_events(deploy_events, timeseries),
+            "flight_records": deploy_flights,
+            "versions": version_table(stats, deploy_events),
+        },
         "throughput": throughput_timeline(timeseries),
         "stats": {
             proc: stats[proc].get("metrics", {}) for proc in sorted(stats)
@@ -469,13 +581,16 @@ def format_report(report: dict) -> str:
                 f"{_fmt_ms(w['ship_s'])}" if w.get("ship_s") is not None
                 else "      -"
             )
+            ver = (
+                f"  v{w['version']}" if w.get("version") is not None else ""
+            )
             lines.append(
                 f"  p{w['proc']}/r{w['rid']:<6} {_fmt_ms(w['queue_s'])} "
                 f"{_fmt_ms(w['prefill_s'])} {ship}  "
                 f"{_fmt_ms(w['decode_s'])} "
                 f"{_fmt_ms(w['ttft_s'])} "
                 f"{w['tokens'] if w['tokens'] is not None else '?':>3} "
-                f"{w['finish_reason'] or '?':<6} {cache:>6} {ok}{shed}"
+                f"{w['finish_reason'] or '?':<6} {cache:>6} {ok}{ver}{shed}"
             )
     if report["sheds"]:
         lines.append(f"sheds: {len(report['sheds'])} shed instant(s)")
@@ -538,6 +653,51 @@ def format_report(report: dict) -> str:
             )
     else:
         lines.append("SLO verdicts: none (no serve/slo_* keys in stats)")
+    dep = report.get("deploy") or {}
+    if dep.get("events") or dep.get("versions"):
+        lines.append(
+            f"deploy timeline: {len(dep.get('events', []))} transition(s), "
+            f"{len(dep.get('flight_records', []))} flight record(s)"
+        )
+        for e in dep.get("events", []):
+            t = f"+{e['t_rel_s']:.1f}s" if "t_rel_s" in e else "t=?"
+            detail = ""
+            if e.get("event") == "reject":
+                reasons = e.get("reasons") or []
+                detail = f"  reasons={reasons}"
+            elif e.get("event") == "skip":
+                detail = f"  superseded_by={e.get('superseded_by')}"
+            elif e.get("event") in ("promote", "rollback"):
+                detail = (
+                    f"  samples={e.get('samples')} "
+                    f"breaches={e.get('breaches')}"
+                )
+            lines.append(
+                f"  {t:>8} p{e.get('proc', '?')} "
+                f"{e.get('event', '?'):<12} step={e.get('step', '?')}"
+                + detail
+            )
+        if dep.get("versions"):
+            lines.append("per-version stats (verdicts from the journal):")
+            lines.append(
+                "  proc  version  requests  tokens  shed  "
+                "ttft_p50/p99_ms  tpot_p99_ms  verdict"
+            )
+            for row in dep["versions"]:
+                ttft = (
+                    f"{(row['ttft_p50_s'] or 0.0) * 1e3:.1f}/"
+                    f"{(row['ttft_p99_s'] or 0.0) * 1e3:.1f}"
+                )
+                tpot = (
+                    f"{(row['tpot_p99_s'] or 0.0) * 1e3:.1f}"
+                )
+                state = f"  [{row['state']}]" if row.get("state") else ""
+                lines.append(
+                    f"  p{row['proc']}    v{row['version']:<6} "
+                    f"{row['requests']:>8.0f} {row['tokens']:>7.0f} "
+                    f"{row['shed']:>5.0f}  {ttft:>15}  {tpot:>11}  "
+                    f"{row['verdict']}{state}"
+                )
     thr = report["throughput"]
     if thr["series"]:
         t = thr["totals"]

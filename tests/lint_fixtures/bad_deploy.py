@@ -1,0 +1,18 @@
+"""Known-bad: swap reads a donated arena; wall-clock version pick."""
+import time
+
+
+class Swapper:
+    def __init__(self, fn):
+        self._decode = jax.jit(fn, donate_argnums=(1,))
+
+    def swap_and_step(self, params, arena, tok, new_params):
+        out = self._decode(params, arena, tok)
+        self.params = new_params
+        return out, arena.sum()
+
+
+def pick_version(primary, canary):
+    if time.time() % 2.0 < 1.0:
+        return canary
+    return primary

@@ -1,0 +1,25 @@
+"""Known-good twins: rebind-at-burst-boundary swap, seeded rid-hash."""
+import zlib
+
+
+class Swapper:
+    def __init__(self, fn, make_arena):
+        self._decode = jax.jit(fn, donate_argnums=(1,))
+        self._make = make_arena
+
+    def swap_and_step(self, params, arena, tok, new_params):
+        # Same-statement rebind: the dispatch returns the fresh arena,
+        # then the weight swap lands BETWEEN dispatches (the params
+        # argument is not donated, so rebinding it never retraces).
+        arena, out = self._decode(params, arena, tok)
+        self.params = new_params
+        return arena, out
+
+
+def pick_version(seed, rid, fraction, primary, canary):
+    # Deterministic canary routing: a seeded rid-hash, never a clock
+    # (and never builtins.hash, which is salted per process).
+    if canary is None:
+        return primary
+    score = zlib.crc32(f"{seed}:{rid}".encode()) / 2.0 ** 32
+    return canary if score < fraction else primary

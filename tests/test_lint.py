@@ -91,6 +91,8 @@ BAD_EXPECT = {
                         ("resource-lifecycle", 13)},
     "bad_autoscale.py": {("determinism-hazard", 7),
                          ("thread-discipline", 11)},
+    "bad_deploy.py": {("donation-safety", 12),
+                      ("determinism-hazard", 16)},
 }
 
 GOOD_FILES = [
@@ -112,6 +114,7 @@ GOOD_FILES = [
     "good_serving_obs.py",
     "good_shipping.py",
     "good_autoscale.py",
+    "good_deploy.py",
 ]
 
 
